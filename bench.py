@@ -1,0 +1,68 @@
+"""Headline benchmark: GPT-2 124M training throughput on the local chip(s).
+
+Prints ONE JSON line: {"metric", "value", "unit", "vs_baseline"}.
+The reference repo publishes no numbers (see BASELINE.md); vs_baseline is
+measured against the round-1 recorded value in BENCH_BASELINE.json when
+present, else 1.0.
+"""
+
+import json
+import os
+import time
+
+
+def main():
+    import jax
+
+    from oobleck_tpu.models import build_model
+    from oobleck_tpu.parallel.mesh import MeshShape, make_mesh
+    from oobleck_tpu.parallel.train import build_train_step, make_optimizer
+
+    n = len(jax.devices())
+    model_name = os.environ.get("BENCH_MODEL", "gpt2")
+    seq = int(os.environ.get("BENCH_SEQ", "1024"))
+    batch = int(os.environ.get("BENCH_BATCH", "8"))
+    steps = int(os.environ.get("BENCH_STEPS", "10"))
+
+    model = build_model(model_name)
+    mesh = make_mesh(MeshShape.infer(n))  # pure data-parallel across local chips
+    init_fn, step_fn = build_train_step(
+        model, mesh, num_microbatches=1, optimizer=make_optimizer()
+    )
+    state = init_fn(jax.random.PRNGKey(0))
+    tokens = model.sample_batch(batch, seq)["input_ids"]
+
+    # warmup (compile + 2 steps); float() forces a device->host readback,
+    # which is the only reliable synchronization under the axon relay
+    # (block_until_ready returns early there).
+    for _ in range(2):
+        state, metrics = step_fn(state, tokens)
+    float(metrics.loss)
+
+    t0 = time.perf_counter()
+    for _ in range(steps):
+        state, metrics = step_fn(state, tokens)
+    float(metrics.loss)
+    dt = time.perf_counter() - t0
+
+    tokens_per_step = batch * seq
+    tps_per_chip = tokens_per_step * steps / dt / n
+
+    baseline = None
+    try:
+        with open(os.path.join(os.path.dirname(__file__), "BENCH_BASELINE.json")) as f:
+            baseline = json.load(f).get("tokens_per_sec_per_chip")
+    except Exception:
+        pass
+    vs = tps_per_chip / baseline if baseline else 1.0
+
+    print(json.dumps({
+        "metric": f"tokens/sec/chip ({model_name} {seq=} {batch=})",
+        "value": round(tps_per_chip, 1),
+        "unit": "tokens/s/chip",
+        "vs_baseline": round(vs, 3),
+    }))
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,120 @@
+"""Job configuration schema.
+
+Capability match for the reference's config dataclasses
+(/root/reference/oobleck/elastic/training_util.py:8-39), re-shaped for TPU:
+`num_workers` means worker processes per *host* (a TPU host owns all its local
+chips — there is no per-GPU process pinning), and a TPU-specific `execution`
+section carries mesh / precision knobs the reference does not have.
+
+Serialization is plain-dict based (yaml / json safe) so configs can travel the
+elastic control plane's wire protocol without pickle.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from dataclasses import dataclass, field
+from typing import Any
+
+import yaml
+
+
+@dataclass
+class DistributedArguments:
+    """Cluster topology and control-plane addressing."""
+
+    master_ip: str = "127.0.0.1"
+    master_port: int = 19191
+    node_ips: list[str] = field(default_factory=lambda: ["127.0.0.1"])
+    node_port: int = 22
+    num_workers: int = 1
+    num_agents_per_node: int = 1
+    username: str | None = None
+
+
+@dataclass
+class JobArguments:
+    """Training-run hyperparameters used by the engine and planner."""
+
+    fault_threshold: int = 3
+    microbatch_size: int = 8
+    global_microbatch_size: int = 128
+    steps: int = 50
+    learning_rate: float = 1e-4
+    warmup_steps: int = 10
+    weight_decay: float = 0.01
+    max_grad_norm: float = 1.0
+
+    def __post_init__(self) -> None:
+        if self.global_microbatch_size % self.microbatch_size != 0:
+            raise ValueError(
+                "global_microbatch_size must be a multiple of microbatch_size: "
+                f"{self.global_microbatch_size} % {self.microbatch_size} != 0"
+            )
+
+    @property
+    def global_num_microbatch(self) -> int:
+        return self.global_microbatch_size // self.microbatch_size
+
+
+@dataclass
+class ModelArguments:
+    """Model family + dataset selection.
+
+    `model_name` follows HF naming (e.g. "gpt2", "gpt2-xl") resolved through
+    oobleck_tpu.models.registry; `model_args` overrides config fields the same
+    way the reference threads them into AutoConfig.
+    """
+
+    model_name: str = "gpt2"
+    model_tag: str = "default"
+    dataset_path: str = "synthetic"
+    dataset_name: str | None = None
+    model_args: dict[str, Any] = field(default_factory=dict)
+
+
+@dataclass
+class ExecutionArguments:
+    """TPU-specific execution knobs (no reference counterpart)."""
+
+    # Mesh axis sizes; -1 means "infer from device count".
+    num_stages: int = -1          # pipeline-parallel degree (per pipeline)
+    tensor_parallel: int = 1      # intra-op model sharding degree
+    fsdp: int = 1                 # parameter-sharding degree within a stage
+    sequence_parallel: int = 1    # ring-attention / context-parallel degree
+    precision: str = "bfloat16"   # activation/compute dtype
+    remat: bool = True            # rematerialize per-layer activations
+    attention_impl: str = "auto"  # auto | xla | pallas | ring
+    checkpoint_dir: str | None = None
+    checkpoint_interval: int = 0  # steps; 0 disables
+
+
+@dataclass
+class OobleckArguments:
+    dist: DistributedArguments = field(default_factory=DistributedArguments)
+    job: JobArguments = field(default_factory=JobArguments)
+    model: ModelArguments = field(default_factory=ModelArguments)
+    execution: ExecutionArguments = field(default_factory=ExecutionArguments)
+
+    # ---- plain-dict serialization (wire + yaml) ----
+
+    def to_dict(self) -> dict[str, Any]:
+        return dataclasses.asdict(self)
+
+    @classmethod
+    def from_dict(cls, d: dict[str, Any]) -> "OobleckArguments":
+        return cls(
+            dist=DistributedArguments(**d.get("dist", {})),
+            job=JobArguments(**d.get("job", {})),
+            model=ModelArguments(**d.get("model", {})),
+            execution=ExecutionArguments(**d.get("execution", {})),
+        )
+
+    @classmethod
+    def from_yaml(cls, path: str) -> "OobleckArguments":
+        with open(path) as f:
+            return cls.from_dict(yaml.safe_load(f) or {})
+
+    def to_yaml(self, path: str) -> None:
+        with open(path, "w") as f:
+            yaml.safe_dump(self.to_dict(), f, sort_keys=False)

@@ -1,0 +1,363 @@
+"""GPT-2 / GPT-3 family decoder as an explicit layer list.
+
+Capability match for the reference's gpt2 path (HF AutoModel + fx shard,
+/root/reference/oobleck/module/model.py:21-33, sharding.py:15-18), designed
+TPU-first: pure-functional params pytrees, bf16 compute / f32 params, static
+shapes, and explicit Megatron-style tensor parallelism + fsdp parameter
+gathering for full-manual shard_map execution.
+
+Pipeline layer list: [embed, block_0 .. block_{L-1}, head] — L+2 planning
+units, matching the reference's "one split point per transformer block + final
+norm/head" granularity (sharding.py:15-18).
+
+Parameter layout is chosen for manual TP:
+  wqkv [E, 3, H, D]   — heads on a dedicated dim, sharded over `tensor`
+  wo   [H, D, E]      — row-parallel output proj
+  wi   [E, F] / wo [F, E] — column/row-parallel MLP
+  wte  [Vp, E]        — vocab-parallel embedding (Vp = vocab padded to 128)
+Every apply function takes an optional ShardCtx; with ctx=None the same code
+runs as a plain single-device program (used by tests and the profiler).
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass, replace
+from typing import Any
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+from jax.sharding import PartitionSpec as P
+
+from oobleck_tpu.models.base import stack_layer_params
+from oobleck_tpu.ops.attention import causal_attention
+from oobleck_tpu.parallel.collectives import (
+    copy_to_tp,
+    reduce_from_tp,
+    unshard_fsdp,
+    vocab_parallel_embed,
+    vocab_parallel_logits_loss,
+)
+
+NEG_INF = -1e9
+
+
+@dataclass(frozen=True)
+class ShardCtx:
+    """Axis names for manual-collective execution; None member = skip."""
+
+    tensor: str | None = None
+    fsdp: str | None = None
+
+    def tp_size(self) -> int:
+        return lax.axis_size(self.tensor) if self.tensor else 1
+
+    def tp_rank(self):
+        return lax.axis_index(self.tensor) if self.tensor else 0
+
+
+@dataclass(frozen=True)
+class GPTConfig:
+    vocab_size: int = 50257
+    max_position_embeddings: int = 1024
+    hidden_size: int = 768
+    num_layers: int = 12
+    num_heads: int = 12
+    intermediate_size: int | None = None
+    layer_norm_epsilon: float = 1e-5
+    initializer_range: float = 0.02
+    dtype: Any = jnp.bfloat16      # compute/activation dtype
+    param_dtype: Any = jnp.float32  # parameter storage dtype
+    attention_impl: str = "auto"
+    remat: bool = True
+    vocab_pad_multiple: int = 128   # pad vocab so `tensor` can shard it
+
+    @property
+    def padded_vocab_size(self) -> int:
+        m = self.vocab_pad_multiple
+        return (self.vocab_size + m - 1) // m * m
+
+    @property
+    def ffn_dim(self) -> int:
+        return self.intermediate_size or 4 * self.hidden_size
+
+    @property
+    def head_dim(self) -> int:
+        assert self.hidden_size % self.num_heads == 0
+        return self.hidden_size // self.num_heads
+
+    def override(self, **kwargs) -> "GPTConfig":
+        # HF model_args names accepted for config-compat with the reference
+        # contract (training_util.py:27-32): n_embd/n_layer/n_head/n_positions.
+        alias = {
+            "n_embd": "hidden_size",
+            "n_layer": "num_layers",
+            "n_head": "num_heads",
+            "n_positions": "max_position_embeddings",
+            "n_inner": "intermediate_size",
+        }
+        kwargs = {alias.get(k, k): v for k, v in kwargs.items()}
+        unknown = [k for k in kwargs if k not in GPTConfig.__dataclass_fields__]
+        if unknown:
+            raise ValueError(
+                f"unknown model_args {unknown}; known fields: "
+                f"{sorted(GPTConfig.__dataclass_fields__)} (+ HF aliases {sorted(alias)})"
+            )
+        return replace(self, **kwargs)
+
+
+def _layer_norm(x: jax.Array, scale: jax.Array, bias: jax.Array, eps: float) -> jax.Array:
+    dtype = x.dtype
+    x = x.astype(jnp.float32)
+    mean = jnp.mean(x, axis=-1, keepdims=True)
+    var = jnp.mean(jnp.square(x - mean), axis=-1, keepdims=True)
+    y = (x - mean) * jax.lax.rsqrt(var + eps)
+    return (y * scale + bias).astype(dtype)
+
+
+def _maybe_copy_to_tp(x, axis):
+    return copy_to_tp(x, axis) if axis else x
+
+
+def _maybe_reduce_from_tp(x, axis):
+    return reduce_from_tp(x, axis) if axis else x
+
+
+def _maybe_unshard(p, axis, dim):
+    return unshard_fsdp(p, axis, dim) if axis else p
+
+
+class GPTModel:
+    """Layer-list GPT decoder. See module docstring for the pipeline layout."""
+
+    def __init__(self, config: GPTConfig):
+        self.config = config
+
+    # ------------------------------------------------------------------ #
+    # layer list view (planning / MPMD pipeline)                          #
+    # ------------------------------------------------------------------ #
+
+    @property
+    def num_pipeline_layers(self) -> int:
+        return self.config.num_layers + 2
+
+    def layer_name(self, index: int) -> str:
+        if index == 0:
+            return "embed"
+        if index == self.num_pipeline_layers - 1:
+            return "head"
+        return f"block_{index - 1}"
+
+    def init_layer(self, rng: jax.Array, index: int):
+        if index == 0:
+            return self._init_embed(rng)
+        if index == self.num_pipeline_layers - 1:
+            return self._init_head(rng)
+        return self._init_block(jax.random.fold_in(rng, index))
+
+    def apply_layer(self, index: int, params, carry, batch, ctx: ShardCtx | None = None):
+        if index == 0:
+            return self.embed(params, batch["input_ids"], ctx)
+        if index == self.num_pipeline_layers - 1:
+            return self.head(params, carry, ctx)
+        return self.apply_block(params, carry, ctx)
+
+    def loss_from_logits(self, logits: jax.Array, batch) -> jax.Array:
+        return cross_entropy_loss(logits, batch["input_ids"], self.config.vocab_size)
+
+    def sample_batch(self, batch_size: int, seq_len: int):
+        tokens = jax.random.randint(
+            jax.random.PRNGKey(0), (batch_size, seq_len), 0, self.config.vocab_size,
+            dtype=jnp.int32,
+        )
+        return {"input_ids": tokens}
+
+    # ------------------------------------------------------------------ #
+    # parameter init                                                      #
+    # ------------------------------------------------------------------ #
+
+    def _init_embed(self, rng: jax.Array):
+        c = self.config
+        k1, k2 = jax.random.split(rng)
+        std = c.initializer_range
+        return {
+            "wte": jax.random.normal(k1, (c.padded_vocab_size, c.hidden_size), c.param_dtype) * std,
+            "wpe": jax.random.normal(k2, (c.max_position_embeddings, c.hidden_size), c.param_dtype) * std,
+        }
+
+    def _init_block(self, rng: jax.Array):
+        c = self.config
+        ks = jax.random.split(rng, 4)
+        std = c.initializer_range
+        # GPT-2 residual-projection scaling: 1/sqrt(2*L) on the output projs.
+        res_std = std / (2 * c.num_layers) ** 0.5
+        e, f, h, d = c.hidden_size, c.ffn_dim, c.num_heads, c.head_dim
+        return {
+            "ln1": {"scale": jnp.ones((e,), c.param_dtype), "bias": jnp.zeros((e,), c.param_dtype)},
+            "attn": {
+                "wqkv": jax.random.normal(ks[0], (e, 3, h, d), c.param_dtype) * std,
+                "bqkv": jnp.zeros((3, h, d), c.param_dtype),
+                "wo": jax.random.normal(ks[1], (h, d, e), c.param_dtype) * res_std,
+                "bo": jnp.zeros((e,), c.param_dtype),
+            },
+            "ln2": {"scale": jnp.ones((e,), c.param_dtype), "bias": jnp.zeros((e,), c.param_dtype)},
+            "mlp": {
+                "wi": jax.random.normal(ks[2], (e, f), c.param_dtype) * std,
+                "bi": jnp.zeros((f,), c.param_dtype),
+                "wo": jax.random.normal(ks[3], (f, e), c.param_dtype) * res_std,
+                "bo": jnp.zeros((e,), c.param_dtype),
+            },
+        }
+
+    def _init_head(self, rng: jax.Array):
+        c = self.config
+        e = c.hidden_size
+        return {
+            "ln_f": {"scale": jnp.ones((e,), c.param_dtype), "bias": jnp.zeros((e,), c.param_dtype)},
+            # Untied lm head, matching the reference's behavior of not tying
+            # embeddings across first/last stages (README.md:99).
+            "w": jax.random.normal(rng, (e, c.padded_vocab_size), c.param_dtype) * c.initializer_range,
+        }
+
+    def init_params(self, rng: jax.Array):
+        """Fused view: blocks stacked on a leading [num_layers, ...] axis."""
+        ks = jax.random.split(rng, 3)
+        blocks = [self._init_block(jax.random.fold_in(ks[1], i + 1))
+                  for i in range(self.config.num_layers)]
+        return {
+            "embed": self._init_embed(ks[0]),
+            "blocks": stack_layer_params(blocks),
+            "head": self._init_head(ks[2]),
+        }
+
+    # ------------------------------------------------------------------ #
+    # forward (ctx=None: plain; ctx set: manual TP/fsdp collectives)      #
+    # ------------------------------------------------------------------ #
+
+    def embed(self, p, tokens: jax.Array, ctx: ShardCtx | None = None) -> jax.Array:
+        c = self.config
+        seq = tokens.shape[-1]
+        if ctx and ctx.tensor:
+            vlocal = p["wte"].shape[0]
+            offset = ctx.tp_rank() * vlocal
+            x = vocab_parallel_embed(p["wte"], tokens, offset, ctx.tensor)
+        else:
+            x = p["wte"][tokens]
+        x = x + p["wpe"][:seq]
+        return x.astype(c.dtype)
+
+    def apply_block(self, p, x: jax.Array, ctx: ShardCtx | None = None) -> jax.Array:
+        c = self.config
+        dt = c.dtype
+        t = ctx.tensor if ctx else None
+        f_ = ctx.fsdp if ctx else None
+
+        # --- attention ---
+        h = _maybe_copy_to_tp(x, t)
+        h = _layer_norm(h, p["ln1"]["scale"], p["ln1"]["bias"], c.layer_norm_epsilon)
+        wqkv = _maybe_unshard(p["attn"]["wqkv"], f_, 0).astype(dt)     # [E,3,Hl,D]
+        bqkv = p["attn"]["bqkv"].astype(dt)                             # [3,Hl,D]
+        qkv = jnp.einsum("bse,ethd->tbhsd", h, wqkv) + bqkv[:, None, :, None, :]
+        attn_out = causal_attention(qkv[0], qkv[1], qkv[2], impl=c.attention_impl)
+        wo = _maybe_unshard(p["attn"]["wo"], f_, 2).astype(dt)          # [Hl,D,E]
+        out = jnp.einsum("bhsd,hde->bse", attn_out, wo)
+        out = _maybe_reduce_from_tp(out, t) + p["attn"]["bo"].astype(dt)
+        x = x + out
+
+        # --- mlp ---
+        h = _maybe_copy_to_tp(x, t)
+        h = _layer_norm(h, p["ln2"]["scale"], p["ln2"]["bias"], c.layer_norm_epsilon)
+        wi = _maybe_unshard(p["mlp"]["wi"], f_, 0).astype(dt)           # [E,Fl]
+        h = jax.nn.gelu(h @ wi + p["mlp"]["bi"].astype(dt))
+        wo = _maybe_unshard(p["mlp"]["wo"], f_, 1).astype(dt)           # [Fl,E]
+        out = h @ wo
+        out = _maybe_reduce_from_tp(out, t) + p["mlp"]["bo"].astype(dt)
+        return x + out
+
+    def head(self, p, x: jax.Array, ctx: ShardCtx | None = None) -> jax.Array:
+        """Full (unsharded-output) logits in f32; masks vocab padding."""
+        c = self.config
+        x = _layer_norm(x, p["ln_f"]["scale"], p["ln_f"]["bias"], c.layer_norm_epsilon)
+        logits = (x @ p["w"].astype(c.dtype)).astype(jnp.float32)
+        if ctx and ctx.tensor:
+            logits = lax.all_gather(logits, ctx.tensor, axis=-1, tiled=True)
+        mask = jnp.arange(logits.shape[-1]) < c.vocab_size
+        return jnp.where(mask, logits, NEG_INF)
+
+    def head_loss(self, p, x: jax.Array, targets: jax.Array,
+                  ctx: ShardCtx | None = None) -> jax.Array:
+        """Mean next-token loss from final activations, vocab-parallel-safe."""
+        c = self.config
+        x = _layer_norm(x, p["ln_f"]["scale"], p["ln_f"]["bias"], c.layer_norm_epsilon)
+        local_logits = (x @ p["w"].astype(c.dtype)).astype(jnp.float32)
+        vlocal = local_logits.shape[-1]
+        offset = (ctx.tp_rank() * vlocal) if (ctx and ctx.tensor) else 0
+        # Mask vocab-padding columns so they don't contribute to sumexp.
+        col_ids = jnp.arange(vlocal) + offset
+        local_logits = jnp.where(col_ids < c.vocab_size, local_logits, NEG_INF)
+        per_pos = vocab_parallel_logits_loss(
+            local_logits[..., :-1, :], targets[..., 1:], offset,
+            ctx.tensor if ctx else None,
+        )
+        return jnp.mean(per_pos)
+
+    def forward(self, params, tokens: jax.Array) -> jax.Array:
+        """Fused single-program forward over stacked blocks (ctx-free)."""
+        c = self.config
+        x = self.embed(params["embed"], tokens)
+        block = self.apply_block
+        if c.remat:
+            block = jax.checkpoint(block)
+
+        def body(x, bp):
+            return block(bp, x), None
+
+        x, _ = jax.lax.scan(body, x, params["blocks"])
+        return self.head(params["head"], x)
+
+    def loss(self, params, batch) -> jax.Array:
+        return self.loss_from_logits(self.forward(params, batch["input_ids"]), batch)
+
+    # ------------------------------------------------------------------ #
+    # sharding + gradient-reduction rules                                 #
+    # ------------------------------------------------------------------ #
+
+    def param_specs(self, *, stacked: bool = True):
+        """PartitionSpecs for full-manual execution over mesh axes
+        (data, stage, fsdp, tensor). Blocks carry a leading layer dim sharded
+        over `stage` when stacked."""
+        s = ("stage",) if stacked else ()
+
+        block = {
+            "ln1": {"scale": P(*s), "bias": P(*s)},
+            "attn": {
+                "wqkv": P(*s, "fsdp", None, "tensor", None),
+                "bqkv": P(*s, None, "tensor", None),
+                "wo": P(*s, "tensor", None, "fsdp"),
+                "bo": P(*s),
+            },
+            "ln2": {"scale": P(*s), "bias": P(*s)},
+            "mlp": {
+                "wi": P(*s, "fsdp", "tensor"),
+                "bi": P(*s, "tensor"),
+                "wo": P(*s, "tensor", "fsdp"),
+                "bo": P(*s),
+            },
+        }
+        embed = {"wte": P("tensor", None), "wpe": P(None, None)}
+        head = {"ln_f": {"scale": P(), "bias": P()}, "w": P(None, "tensor")}
+        return {"embed": embed, "blocks": block, "head": head}
+
+
+def cross_entropy_loss(logits: jax.Array, tokens: jax.Array,
+                       vocab_size: int | None = None) -> jax.Array:
+    """Next-token LM loss: positions :-1 predict tokens 1:. Any leading dims.
+    `vocab_size` masks padded vocab columns when logits are padded."""
+    logits = logits[..., :-1, :].astype(jnp.float32)
+    if vocab_size is not None and logits.shape[-1] > vocab_size:
+        mask = jnp.arange(logits.shape[-1]) < vocab_size
+        logits = jnp.where(mask, logits, NEG_INF)
+    targets = tokens[..., 1:]
+    logz = jax.nn.logsumexp(logits, axis=-1)
+    gold = jnp.take_along_axis(logits, targets[..., None], axis=-1)[..., 0]
+    return jnp.mean(logz - gold)

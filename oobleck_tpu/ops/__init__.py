@@ -1,0 +1,5 @@
+"""TPU compute kernels: attention implementations (XLA, Pallas flash, ring)."""
+
+from oobleck_tpu.ops.attention import causal_attention, select_attention_impl
+
+__all__ = ["causal_attention", "select_attention_impl"]

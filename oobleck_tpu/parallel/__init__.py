@@ -1,0 +1,28 @@
+"""Parallelism layer: device meshes, sharding rules, and the SPMD pipeline.
+
+TPU-native replacement for the reference's NCCL process-group machinery
+(/root/reference/oobleck/execution/pipeline.py:565-617,
+engine.py:363-412): instead of dynamically created process groups, parallelism
+is expressed as a `jax.sharding.Mesh` with named axes
+
+    data   — data parallelism (grad psum; batch split)
+    stage  — pipeline parallelism (shard_map + ppermute)
+    tensor — tensor parallelism (Megatron-style param sharding, GSPMD)
+    fsdp   — parameter sharding within a stage (ZeRO-3 equivalent)
+
+and reconfiguration maps to *rebuilding the mesh* over surviving devices and
+re-lowering the step function (pre-compiled per template at startup).
+"""
+
+from oobleck_tpu.parallel.mesh import MeshShape, make_mesh
+
+__all__ = ["MeshShape", "make_mesh", "TrainState", "build_train_step", "make_optimizer"]
+
+
+def __getattr__(name):
+    # Lazy: parallel.train imports model code which imports parallel.collectives.
+    if name in ("TrainState", "build_train_step", "make_optimizer", "StepMetrics"):
+        from oobleck_tpu.parallel import train
+
+        return getattr(train, name)
+    raise AttributeError(name)

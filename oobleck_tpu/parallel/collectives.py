@@ -1,0 +1,125 @@
+"""Manual-mode collective helpers for full-manual shard_map programs.
+
+The fused train step runs with *every* mesh axis manual (scaling-book style):
+tensor parallelism, fsdp parameter gathering, and the Megatron f/g conjugate
+pair are written out explicitly here instead of relying on GSPMD propagation.
+
+TPU-native replacement for the reference's NCCL primitive usage
+(/root/reference/oobleck/execution/layer.py:127-217 — manual FSDP
+all_gather/reduce-scatter hooks; engine.py:404-412 — DP allreduce): the same
+operations expressed as XLA collectives over mesh axes.
+"""
+
+from __future__ import annotations
+
+import functools
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+from jax.sharding import PartitionSpec as P
+
+
+@functools.partial(jax.custom_vjp, nondiff_argnums=(1,))
+def copy_to_tp(x, axis: str):
+    """Megatron `f`: identity forward, psum backward over the tensor axis.
+
+    Placed where a replicated activation enters a column-parallel region so
+    the partial input-cotangents from each tensor rank get summed.
+    """
+    return x
+
+
+def _copy_to_tp_fwd(x, axis):
+    return x, None
+
+
+def _copy_to_tp_bwd(axis, _res, g):
+    return (lax.psum(g, axis),)
+
+
+copy_to_tp.defvjp(_copy_to_tp_fwd, _copy_to_tp_bwd)
+
+
+def reduce_from_tp(x, axis: str):
+    """Megatron `g`: psum forward (row-parallel output), identity backward."""
+    return lax.psum(x, axis)
+
+
+def unshard_fsdp(param: jax.Array, axis: str, dim: int) -> jax.Array:
+    """All-gather an fsdp-sharded parameter along `dim` for use.
+
+    The AD transpose of all_gather is psum_scatter, so gradients come back
+    already reduced *and* sharded — the ZeRO-3 reduce-scatter for free
+    (cf. reference layer.py:213-217 doing this by hand with NCCL).
+    """
+    return lax.all_gather(param, axis, axis=dim, tiled=True)
+
+
+def vocab_parallel_logits_loss(
+    local_logits: jax.Array,
+    targets: jax.Array,
+    vocab_offset: jax.Array | int,
+    tensor_axis: str | None,
+) -> jax.Array:
+    """Cross-entropy over vocab-sharded logits without materializing the full
+    vocab dimension on any device (Megatron-style three-psum construction).
+
+    local_logits: [..., seq, V_local] f32, this rank's vocab shard.
+    targets:      [..., seq] global token ids.
+    Returns per-position loss [..., seq].
+    """
+    local_logits = local_logits.astype(jnp.float32)
+    vlocal = local_logits.shape[-1]
+    # max for stability
+    local_max = jnp.max(local_logits, axis=-1)
+    if tensor_axis is not None:
+        gmax = lax.pmax(lax.stop_gradient(local_max), tensor_axis)
+    else:
+        gmax = local_max
+    # The max shift is for stability only; its gradient contribution cancels.
+    gmax = lax.stop_gradient(gmax)
+    shifted = local_logits - gmax[..., None]
+    sumexp = jnp.sum(jnp.exp(shifted), axis=-1)
+    # gold logit: only the owning rank contributes
+    local_ids = targets - vocab_offset
+    in_range = (local_ids >= 0) & (local_ids < vlocal)
+    safe_ids = jnp.clip(local_ids, 0, vlocal - 1)
+    gold = jnp.take_along_axis(shifted, safe_ids[..., None], axis=-1)[..., 0]
+    gold = jnp.where(in_range, gold, 0.0)
+    if tensor_axis is not None:
+        sumexp = lax.psum(sumexp, tensor_axis)
+        gold = lax.psum(gold, tensor_axis)
+    return jnp.log(sumexp) - gold
+
+
+def vocab_parallel_embed(
+    wte_local: jax.Array,
+    tokens: jax.Array,
+    vocab_offset: jax.Array | int,
+    tensor_axis: str | None,
+) -> jax.Array:
+    """Embedding lookup over a vocab-sharded table: masked local gather + psum."""
+    vlocal = wte_local.shape[0]
+    local_ids = tokens - vocab_offset
+    in_range = (local_ids >= 0) & (local_ids < vlocal)
+    safe_ids = jnp.clip(local_ids, 0, vlocal - 1)
+    out = wte_local[safe_ids]
+    out = jnp.where(in_range[..., None], out, 0.0)
+    if tensor_axis is not None:
+        out = lax.psum(out, tensor_axis)
+    return out
+
+
+def pvary_to(x, axes: tuple[str, ...]):
+    """pcast `x` to be varying over exactly the axes in `axes` it isn't yet.
+
+    lax.cond requires both branches to have identical varying-manual-axes
+    types; this normalizes a branch output (or pytree) to a superset target.
+    """
+    def one(v):
+        have = set(getattr(v.aval, "vma", ()) or ())
+        missing = tuple(a for a in axes if a not in have)
+        return lax.pcast(v, missing, to="varying") if missing else v
+
+    return jax.tree.map(one, x)

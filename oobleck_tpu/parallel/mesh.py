@@ -1,0 +1,79 @@
+"""Device mesh construction.
+
+The mesh is the TPU equivalent of the reference's rank grid
+(/root/reference/oobleck/csrc/planning/pipeline_template.h:57-84): a pipeline
+template's stage→device assignment becomes the `stage` axis of a Mesh, and
+FSDP/TP degrees within a stage become the `fsdp`/`tensor` axes.
+
+Axis order is chosen so that the innermost axes (tensor, fsdp) map to
+physically adjacent devices — on a real TPU slice, JAX's default device order
+follows the torus coordinates, so keeping high-bandwidth collectives (TP
+all-reduce, FSDP all-gather) on the fastest-varying axes rides ICI neighbor
+links, while `data` (pure grad allreduce, once per step) takes the outermost.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass
+
+import numpy as np
+import jax
+from jax.sharding import Mesh
+
+AXIS_DATA = "data"
+AXIS_STAGE = "stage"
+AXIS_FSDP = "fsdp"
+AXIS_TENSOR = "tensor"
+
+ALL_AXES = (AXIS_DATA, AXIS_STAGE, AXIS_FSDP, AXIS_TENSOR)
+
+
+@dataclass(frozen=True)
+class MeshShape:
+    data: int = 1
+    stage: int = 1
+    fsdp: int = 1
+    tensor: int = 1
+
+    @property
+    def num_devices(self) -> int:
+        return self.data * self.stage * self.fsdp * self.tensor
+
+    @classmethod
+    def infer(
+        cls,
+        num_devices: int,
+        *,
+        stage: int = 1,
+        tensor: int = 1,
+        fsdp: int = 1,
+        data: int = -1,
+    ) -> "MeshShape":
+        """Fill in data=-1 from the device count."""
+        denom = stage * tensor * fsdp
+        if data == -1:
+            if num_devices % denom != 0:
+                raise ValueError(
+                    f"{num_devices} devices not divisible by stage*tensor*fsdp={denom}"
+                )
+            data = num_devices // denom
+        shape = cls(data=data, stage=stage, fsdp=fsdp, tensor=tensor)
+        if shape.num_devices != num_devices:
+            raise ValueError(f"{shape} does not cover {num_devices} devices")
+        return shape
+
+
+def make_mesh(shape: MeshShape, devices: list | None = None) -> Mesh:
+    """Build a Mesh with axes (data, stage, fsdp, tensor) over `devices`.
+
+    `devices` defaults to all local devices; pipelines over device *subsets*
+    (heterogeneous instances) pass their own slice.
+    """
+    if devices is None:
+        devices = jax.devices()
+    if len(devices) < shape.num_devices:
+        raise ValueError(f"need {shape.num_devices} devices, have {len(devices)}")
+    grid = np.array(devices[: shape.num_devices]).reshape(
+        shape.data, shape.stage, shape.fsdp, shape.tensor
+    )
+    return Mesh(grid, ALL_AXES)

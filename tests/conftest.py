@@ -1,0 +1,40 @@
+"""Test harness: run everything on a virtual 8-device CPU mesh.
+
+Mirrors the reference's multi-process single-machine simulation strategy
+(/root/reference/tests/conftest.py:347-474, which fakes multi-node with
+CUDA_VISIBLE_DEVICES pinning): here a single process gets 8 virtual XLA CPU
+devices via --xla_force_host_platform_device_count, and multi-host scenarios
+are expressed as sub-meshes of those devices.
+
+This must run before any other module imports jax and triggers backend init.
+"""
+
+import os
+
+os.environ["XLA_FLAGS"] = (
+    os.environ.get("XLA_FLAGS", "") + " --xla_force_host_platform_device_count=8"
+)
+
+import jax
+
+jax.config.update("jax_platforms", "cpu")
+
+import numpy as np
+import pytest
+
+
+@pytest.fixture(scope="session")
+def devices8():
+    devs = jax.devices()
+    assert len(devs) >= 8, f"expected 8 virtual CPU devices, got {len(devs)}"
+    return devs[:8]
+
+
+@pytest.fixture(scope="session")
+def rng():
+    return jax.random.PRNGKey(42)
+
+
+@pytest.fixture
+def np_rng():
+    return np.random.default_rng(42)

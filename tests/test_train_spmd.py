@@ -1,0 +1,85 @@
+"""Fused SPMD train-step tests on the virtual 8-device CPU mesh.
+
+Covers the capability matrix the reference exercises through its pipeline
+tests (/root/reference/tests/execution/test_pipeline.py:20-400 — 1/2/4-stage
+train, FSDP+PP combo), expressed mesh-first: the same step function must
+produce the same loss trajectory for every mesh factorization.
+"""
+
+import jax
+import jax.numpy as jnp
+import pytest
+
+from oobleck_tpu.models import build_model
+from oobleck_tpu.parallel import MeshShape, build_train_step, make_mesh, make_optimizer
+
+
+def _run_steps(mesh_shape: MeshShape, num_microbatches=4, steps=3, seed=0):
+    model = build_model("gpt2-tiny", {"remat": True})
+    mesh = make_mesh(mesh_shape)
+    optimizer = make_optimizer(learning_rate=1e-3, warmup_steps=2)
+    init_fn, step_fn = build_train_step(
+        model, mesh, num_microbatches=num_microbatches, optimizer=optimizer
+    )
+    state = init_fn(jax.random.PRNGKey(seed))
+    tokens = jax.random.randint(
+        jax.random.PRNGKey(1), (32, 32), 0, model.config.vocab_size, dtype=jnp.int32
+    )
+    losses = []
+    for _ in range(steps):
+        state, metrics = step_fn(state, tokens)
+        losses.append(float(metrics.loss))
+    assert int(state.step) == steps
+    return losses
+
+
+_BASELINE_CACHE = []
+
+
+def _baseline_losses():
+    if not _BASELINE_CACHE:
+        _BASELINE_CACHE.append(_run_steps(MeshShape(data=1)))
+    return _BASELINE_CACHE[0]
+
+
+def test_single_device_baseline():
+    losses = _baseline_losses()
+    assert losses[-1] < losses[0]
+
+
+@pytest.mark.parametrize(
+    "shape",
+    [
+        MeshShape(data=8),
+        MeshShape(stage=4, data=2),
+        MeshShape(tensor=2, data=4),
+        MeshShape(fsdp=2, data=4),
+        MeshShape(stage=2, tensor=2, data=2),
+        MeshShape(stage=2, fsdp=2, tensor=2),
+        MeshShape(stage=4, tensor=2, data=1),
+    ],
+)
+def test_mesh_factorizations_match_baseline(shape):
+    """Every parallelism combo must match the single-device loss trajectory."""
+    base = _baseline_losses()
+    got = _run_steps(shape)
+    assert got == pytest.approx(base, rel=2e-2), (shape, base, got)
+
+
+def test_pipeline_degree_full(devices8):
+    # All 8 devices as pipeline stages (4 blocks would not divide 8; use tiny
+    # model with matching layer count via overrides).
+    model = build_model("gpt2-tiny", {"n_layer": 8})
+    mesh = make_mesh(MeshShape(stage=8))
+    init_fn, step_fn = build_train_step(model, mesh, num_microbatches=8)
+    state = init_fn(jax.random.PRNGKey(0))
+    tokens = model.sample_batch(8, 16)["input_ids"]
+    state, metrics = step_fn(state, tokens)
+    assert jnp.isfinite(metrics.loss)
+
+
+def test_indivisible_layers_raises():
+    model = build_model("gpt2-tiny")  # 4 layers
+    mesh = make_mesh(MeshShape(stage=8))
+    with pytest.raises(ValueError, match="not divisible"):
+        build_train_step(model, mesh, num_microbatches=2)
