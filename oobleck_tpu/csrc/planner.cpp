@@ -1,0 +1,378 @@
+// Pipeline-template generator: memoized divide-and-conquer over layer ranges.
+//
+// Native twin of oobleck_tpu/planning/templates.py (same semantics as the
+// reference planner, /root/reference/oobleck/csrc/planning/
+// pipeline_template.cpp:82-339 + execution_result.h:60-204, re-implemented
+// from its documented behavior): for every host count in [min,max] and every
+// stage count in [hosts, layers], find the stage partition minimizing the
+// t1+t2+t3 pipeline cost model. Work is spread over a std::thread pool with
+// a mutex-sharded memo table (the reference uses cppcoro+TBB); exposed as a
+// plain C API for ctypes (pybind11 is not available in this image).
+//
+// Build: oobleck_tpu/csrc/Makefile (g++ -O2 -std=c++20 -shared -fPIC).
+
+#include <algorithm>
+#include <atomic>
+#include <cmath>
+#include <condition_variable>
+#include <cstdint>
+#include <cstring>
+#include <functional>
+#include <map>
+#include <memory>
+#include <mutex>
+#include <queue>
+#include <sstream>
+#include <string>
+#include <thread>
+#include <tuple>
+#include <unordered_map>
+#include <vector>
+
+namespace {
+
+struct LayerCost {
+  double forward;
+  double backward;
+  std::map<int, double> allreduce_in_host;  // chips -> ms
+  int64_t mem_params;
+  int64_t mem_activation;
+};
+
+struct Stage {
+  int start, end;  // layer range [start, end)
+  int num_chips;
+  double forward = 0, backward = 0;
+  int64_t mem_required = 0;
+  double latency() const { return forward + backward; }
+};
+
+Stage build_stage(const std::vector<LayerCost>& layers, int start, int end,
+                  int num_chips) {
+  Stage s;
+  s.start = start;
+  s.end = end;
+  s.num_chips = num_chips;
+  for (int i = start; i < end; ++i) {
+    const auto& l = layers[i];
+    s.forward += l.forward / num_chips;
+    s.backward += l.backward / num_chips;
+    if (num_chips > 1) {
+      auto it = l.allreduce_in_host.find(num_chips);
+      double ar = it == l.allreduce_in_host.end() ? 0.0 : it->second;
+      s.forward += ar;
+      s.backward += ar;
+    }
+    s.mem_required += 6 * l.mem_params + l.mem_activation;
+  }
+  return s;
+}
+
+// Divide-and-conquer cost node; mirrors the t1/t2/t3 + kstar model.
+struct DCResult {
+  double t1 = 0, t2 = 0, t3 = 0;
+  int kstar = 0;
+  std::vector<Stage> stages;
+  double t() const { return t1 + t2 + t3; }
+  double kstar_latency() const { return stages[kstar].latency(); }
+};
+
+using DCPtr = std::shared_ptr<DCResult>;
+
+DCPtr make_base(Stage stage) {
+  auto r = std::make_shared<DCResult>();
+  double lat = stage.latency();
+  r->t1 = lat;
+  r->t2 = 2 * lat;
+  r->t3 = lat;
+  r->kstar = 0;
+  r->stages = {std::move(stage)};
+  return r;
+}
+
+DCPtr combine(const DCPtr& left, const DCPtr& right) {
+  auto r = std::make_shared<DCResult>();
+  if (left->kstar_latency() > right->kstar_latency()) {
+    r->kstar = left->kstar;
+  } else {
+    r->kstar = right->kstar + static_cast<int>(left->stages.size());
+  }
+  r->t1 = left->t1 + right->t1;
+  int num_stages =
+      static_cast<int>(left->stages.size() + right->stages.size());
+  int mb_factor = 2 * num_stages + r->kstar + 1;
+  double tail = 0;
+  if (r->kstar == left->kstar) {
+    r->t2 = mb_factor * left->kstar_latency();
+    for (size_t i = left->kstar; i < left->stages.size(); ++i)
+      tail += left->stages[i].latency();
+    for (const auto& s : right->stages) tail += s.latency();
+  } else {
+    r->t2 = mb_factor * right->kstar_latency();
+    for (size_t i = right->kstar; i < right->stages.size(); ++i)
+      tail += right->stages[i].latency();
+  }
+  r->t3 = tail;
+  r->stages = left->stages;
+  r->stages.insert(r->stages.end(), right->stages.begin(),
+                   right->stages.end());
+  return r;
+}
+
+// Memo key: (num_stages, start, end, num_hosts, chips_per_host)
+using Key = std::tuple<int, int, int, int, int>;
+struct KeyHash {
+  size_t operator()(const Key& k) const {
+    size_t h = 1469598103934665603ull;
+    auto mix = [&h](int v) {
+      h ^= static_cast<size_t>(v) + 0x9e3779b97f4a7c15ull + (h << 6) + (h >> 2);
+    };
+    mix(std::get<0>(k));
+    mix(std::get<1>(k));
+    mix(std::get<2>(k));
+    mix(std::get<3>(k));
+    mix(std::get<4>(k));
+    return h;
+  }
+};
+
+// Mutex-sharded memo table (the reference uses a TBB concurrent map).
+class Memo {
+ public:
+  static constexpr int kShards = 64;
+  bool lookup(const Key& k, DCPtr* out) {
+    auto& sh = shard(k);
+    std::lock_guard<std::mutex> g(sh.mu);
+    auto it = sh.map.find(k);
+    if (it == sh.map.end()) return false;
+    *out = it->second;
+    return true;
+  }
+  void insert(const Key& k, DCPtr v) {
+    auto& sh = shard(k);
+    std::lock_guard<std::mutex> g(sh.mu);
+    sh.map.emplace(k, std::move(v));
+  }
+
+ private:
+  struct Shard {
+    std::mutex mu;
+    std::unordered_map<Key, DCPtr, KeyHash> map;
+  };
+  Shard& shard(const Key& k) { return shards_[KeyHash{}(k) % kShards]; }
+  Shard shards_[kShards];
+};
+
+DCPtr divide_and_conquer(const std::vector<LayerCost>& layers, int start,
+                         int end, int num_stages, int num_hosts,
+                         int chips_per_host, Memo& memo) {
+  Key key{num_stages, start, end, num_hosts, chips_per_host};
+  DCPtr cached;
+  if (memo.lookup(key, &cached)) return cached;
+
+  // Feasibility rules (see templates.py:_dc and the reference
+  // pipeline_template.cpp:193-214).
+  bool infeasible = false;
+  if (num_stages > end - start) infeasible = true;
+  if (num_hosts == 1) {
+    if (chips_per_host < num_stages) infeasible = true;
+    if (num_stages == 1 && (chips_per_host & (chips_per_host - 1)) != 0)
+      infeasible = true;
+  } else if (num_hosts > num_stages) {
+    infeasible = true;
+  }
+  if (infeasible) {
+    memo.insert(key, nullptr);
+    return nullptr;
+  }
+
+  if (num_stages == 1) {
+    auto r = make_base(build_stage(layers, start, end, chips_per_host));
+    memo.insert(key, r);
+    return r;
+  }
+
+  DCPtr best;
+  for (int k = start + 1; k < end; ++k) {
+    if (num_hosts == 1) {
+      int half = chips_per_host / 2;  // even bisection only
+      if (half * 2 != chips_per_host || half == 0) continue;
+      for (int s_left = 1; s_left < num_stages; ++s_left) {
+        auto left = divide_and_conquer(layers, start, k, s_left, 1, half, memo);
+        auto right = divide_and_conquer(layers, k, end, num_stages - s_left, 1,
+                                        chips_per_host - half, memo);
+        if (!left || !right) continue;
+        auto cand = combine(left, right);
+        if (!best || cand->t() < best->t()) best = cand;
+      }
+    } else {
+      for (int h_left = 1; h_left < num_hosts; ++h_left) {
+        for (int s_left = 1; s_left < num_stages; ++s_left) {
+          auto left = divide_and_conquer(layers, start, k, s_left, h_left,
+                                         chips_per_host, memo);
+          auto right =
+              divide_and_conquer(layers, k, end, num_stages - s_left,
+                                 num_hosts - h_left, chips_per_host, memo);
+          if (!left || !right) continue;
+          auto cand = combine(left, right);
+          if (!best || cand->t() < best->t()) best = cand;
+        }
+      }
+    }
+  }
+  memo.insert(key, best);
+  return best;
+}
+
+// Tiny fixed thread pool for the top-level (host count x stage count) tasks.
+class ThreadPool {
+ public:
+  explicit ThreadPool(int n) {
+    for (int i = 0; i < n; ++i)
+      workers_.emplace_back([this] { loop(); });
+  }
+  ~ThreadPool() {
+    {
+      std::lock_guard<std::mutex> g(mu_);
+      done_ = true;
+    }
+    cv_.notify_all();
+    for (auto& w : workers_) w.join();
+  }
+  void submit(std::function<void()> f) {
+    {
+      std::lock_guard<std::mutex> g(mu_);
+      q_.push(std::move(f));
+    }
+    cv_.notify_one();
+  }
+  void wait_idle() {
+    std::unique_lock<std::mutex> g(mu_);
+    idle_cv_.wait(g, [this] { return q_.empty() && active_ == 0; });
+  }
+
+ private:
+  void loop() {
+    for (;;) {
+      std::function<void()> f;
+      {
+        std::unique_lock<std::mutex> g(mu_);
+        cv_.wait(g, [this] { return done_ || !q_.empty(); });
+        if (done_ && q_.empty()) return;
+        f = std::move(q_.front());
+        q_.pop();
+        ++active_;
+      }
+      f();
+      {
+        std::lock_guard<std::mutex> g(mu_);
+        --active_;
+        if (q_.empty() && active_ == 0) idle_cv_.notify_all();
+      }
+    }
+  }
+  std::vector<std::thread> workers_;
+  std::queue<std::function<void()>> q_;
+  std::mutex mu_;
+  std::condition_variable cv_, idle_cv_;
+  int active_ = 0;
+  bool done_ = false;
+};
+
+std::string to_json(const std::vector<std::pair<int, DCPtr>>& results,
+                    int chips_per_host) {
+  std::ostringstream os;
+  os.precision(17);
+  os << "[";
+  bool first_t = true;
+  for (const auto& [hosts, r] : results) {
+    if (!r) continue;
+    if (!first_t) os << ",";
+    first_t = false;
+    os << "{\"num_hosts\":" << hosts
+       << ",\"chips_per_host\":" << chips_per_host
+       << ",\"iteration_time\":" << r->t() << ",\"stages\":[";
+    for (size_t i = 0; i < r->stages.size(); ++i) {
+      const auto& s = r->stages[i];
+      if (i) os << ",";
+      os << "{\"layers\":[" << s.start << "," << s.end << "]"
+         << ",\"num_chips\":" << s.num_chips << ",\"forward\":" << s.forward
+         << ",\"backward\":" << s.backward
+         << ",\"mem_required\":" << s.mem_required << "}";
+    }
+    os << "]}";
+  }
+  os << "]";
+  return os.str();
+}
+
+std::string* g_result = nullptr;
+
+}  // namespace
+
+extern "C" {
+
+// Inputs are flat arrays over `num_layers` layers:
+//   fwd/bwd:        per-layer times (ms)
+//   ar_chips:       `num_ar` chip counts with in-host allreduce entries
+//   ar_in_host:     [num_layers x num_ar] times, row-major
+//   mem_params/mem_activation: per-layer bytes
+// Returns a malloc'd JSON string (caller frees via planner_free).
+const char* planner_create_templates(
+    int num_layers, const double* fwd, const double* bwd, int num_ar,
+    const int* ar_chips, const double* ar_in_host, const int64_t* mem_params,
+    const int64_t* mem_activation, int min_hosts, int max_hosts,
+    int chips_per_host, int num_threads) {
+  std::vector<LayerCost> layers(num_layers);
+  for (int i = 0; i < num_layers; ++i) {
+    layers[i].forward = fwd[i];
+    layers[i].backward = bwd[i];
+    layers[i].mem_params = mem_params[i];
+    layers[i].mem_activation = mem_activation[i];
+    for (int j = 0; j < num_ar; ++j)
+      layers[i].allreduce_in_host[ar_chips[j]] = ar_in_host[i * num_ar + j];
+  }
+
+  if (num_threads <= 0)
+    num_threads = std::max(1u, std::thread::hardware_concurrency());
+
+  std::vector<std::pair<int, DCPtr>> results;
+  for (int n = min_hosts; n <= max_hosts; ++n) results.push_back({n, nullptr});
+
+  {
+    // One memo shared across all host counts and tasks: keys include the
+    // host count, and multi-host splits recurse into smaller host counts, so
+    // sharing is safe and avoids recomputing overlapping subtrees.
+    Memo memo;
+    std::vector<std::unique_ptr<std::mutex>> best_mus;
+    ThreadPool pool(num_threads);
+    for (auto& [hosts, slot] : results) {
+      best_mus.push_back(std::make_unique<std::mutex>());
+      auto* best_mu = best_mus.back().get();
+      auto* slot_ptr = &slot;
+      int n = hosts;
+      for (int num_stages = n; num_stages <= num_layers; ++num_stages) {
+        pool.submit([&layers, &memo, slot_ptr, n, num_stages, chips_per_host,
+                     best_mu] {
+          auto r = divide_and_conquer(layers, 0, (int)layers.size(),
+                                      num_stages, n, chips_per_host, memo);
+          if (!r) return;
+          std::lock_guard<std::mutex> g(*best_mu);
+          if (!*slot_ptr || r->t() < (*slot_ptr)->t()) *slot_ptr = r;
+        });
+      }
+    }
+    pool.wait_idle();
+  }
+
+  delete g_result;
+  g_result = new std::string(to_json(results, chips_per_host));
+  return g_result->c_str();
+}
+
+void planner_free() {
+  delete g_result;
+  g_result = nullptr;
+}
+
+}  // extern "C"

@@ -1,0 +1,10 @@
+"""Elastic control plane (L4): master daemon ⇄ per-host agents ⇄ workers.
+
+Capability match for /root/reference/oobleck/elastic/: the master launches
+one agent per TPU host, detects host failure via TCP disconnect, and
+broadcasts reconfiguration to survivors; agents supervise one worker process
+per host (a TPU host owns all its local chips — no per-GPU pinning) and relay
+the JAX coordinator address the way the reference relays the rank-0 TCPStore
+port (master.py:137-154). Pure-Python networking; training data never crosses
+this plane.
+"""

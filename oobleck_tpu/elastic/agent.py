@@ -1,0 +1,212 @@
+"""Per-host agent: supervises this host's worker process.
+
+Capability match for the reference agent
+(/root/reference/oobleck/elastic/agent.py:27-302), with TPU process topology:
+ONE worker process per host (a TPU host drives all its local chips through a
+single JAX process) instead of one per GPU with CUDA_VISIBLE_DEVICES pinning
+(reference agent.py:148-174).
+
+Responsibilities:
+  * register with the master over TCP, receive the job args;
+  * ensure profile data exists for the model (runs the profiler on miss,
+    reference _run_profiler, agent.py:84-110);
+  * spawn the worker with a multiprocessing Pipe for control messages;
+  * relay the JAX coordinator address worker -> master and master -> worker
+    (the reference's rank-0 port chain, agent.py:181-194);
+  * on RECONFIGURATION: remove the lost ip, push it down the worker pipe; if
+    *we* are the lost host, self-terminate — the built-in fault-injection
+    kill switch (reference agent.py:217-232);
+  * heartbeat PING on an interval (the reference defines but never schedules
+    it, agent.py:280-288 — actually scheduled here).
+"""
+
+from __future__ import annotations
+
+import asyncio
+import logging
+import multiprocessing as mp
+from dataclasses import dataclass
+
+from oobleck_tpu.config import OobleckArguments
+from oobleck_tpu.elastic.message import (
+    RequestType,
+    ResponseType,
+    recv_msg,
+    send_request,
+)
+
+logger = logging.getLogger("oobleck.agent")
+
+PING_INTERVAL = 10.0
+
+
+@dataclass
+class Worker:
+    pipe: object  # mp.connection.Connection
+    process: object  # mp.Process
+
+
+class OobleckAgent:
+    def __init__(self, master_ip: str, master_port: int, agent_ip: str):
+        self.master_ip = master_ip
+        self.master_port = master_port
+        self.agent_ip = agent_ip
+        self.args: OobleckArguments | None = None
+        self.worker: Worker | None = None
+        self.node_ips: list[str] = []
+        self._reader: asyncio.StreamReader | None = None
+        self._writer: asyncio.StreamWriter | None = None
+        self._send_lock = asyncio.Lock()
+
+    # ------------------------------------------------------------------ #
+
+    async def run(self) -> None:
+        await self.connect_to_master()
+        await self.register()
+        self.ensure_profile()
+        self.launch_worker()
+        await asyncio.gather(self.response_loop(), self.ping_loop(),
+                             self.worker_port_loop(), self.worker_watch_loop())
+
+    async def worker_watch_loop(self) -> None:
+        """Worker death must surface as a host failure: drop the master
+        connection so disconnect-based detection fires (the reference treats
+        worker-level failure as out of scope, agent.py:171-173 — here the
+        agent exits with its worker so the cluster reconfigures)."""
+        while True:
+            await asyncio.sleep(1.0)
+            if self.worker is not None and not self.worker.process.is_alive():
+                logger.error("worker process died (exit=%s); terminating agent",
+                             self.worker.process.exitcode)
+                self.terminate()
+
+    async def connect_to_master(self) -> None:
+        self._reader, self._writer = await asyncio.open_connection(
+            self.master_ip, self.master_port
+        )
+
+    async def register(self) -> None:
+        """Reference _register_agent (agent.py:70-82)."""
+        async with self._send_lock:
+            await send_request(self._writer, RequestType.REGISTER_AGENT,
+                               {"ip": self.agent_ip})
+        msg = await recv_msg(self._reader)
+        if msg.get("kind") != ResponseType.SUCCESS.value:
+            raise RuntimeError(f"registration failed: {msg}")
+        self.args = OobleckArguments.from_dict(msg["args"])
+        self.node_ips = list(self.args.dist.node_ips)
+        logger.info("registered; job model=%s", self.args.model.model_name)
+
+    # ------------------------------------------------------------------ #
+
+    def ensure_profile(self) -> None:
+        """Profile-on-miss (reference _launch_workers, agent.py:112-134)."""
+        assert self.args is not None
+        from oobleck_tpu.planning.profiler import get_profile_path, profile
+
+        m = self.args.model
+        path = get_profile_path(m.model_name, m.model_tag)
+        if not (path / f"mb{self.args.job.microbatch_size}.json").exists():
+            logger.info("profile missing for %s; profiling now", m.model_name)
+            profile(m.model_name, m.model_args, model_tag=m.model_tag,
+                    microbatch_size=self.args.job.microbatch_size)
+
+    def launch_worker(self) -> None:
+        """One worker per host with a control pipe (reference agent.py:148-174)."""
+        from oobleck_tpu.elastic import worker as worker_mod
+
+        ctx = mp.get_context("spawn")
+        parent_pipe, child_pipe = ctx.Pipe()
+        proc = ctx.Process(
+            target=worker_mod.worker_main,
+            args=(child_pipe, self.agent_ip, self.args.to_dict()),
+            daemon=True,
+        )
+        proc.start()
+        self.worker = Worker(pipe=parent_pipe, process=proc)
+
+    # ------------------------------------------------------------------ #
+
+    async def response_loop(self) -> None:
+        """Dispatch master messages (reference on_receive_response,
+        agent.py:234-278)."""
+        while True:
+            try:
+                msg = await recv_msg(self._reader, timeout=None)
+            except (asyncio.IncompleteReadError, ConnectionError):
+                logger.error("master connection lost; exiting")
+                self.terminate()
+                return
+            kind = msg.get("kind")
+            if kind == ResponseType.PONG.value:
+                continue
+            if kind == ResponseType.RECONFIGURATION.value:
+                self.on_reconfiguration(msg["lost_ip"])
+            elif kind == ResponseType.FORWARD_COORDINATOR.value:
+                if self.worker is not None:
+                    self.worker.pipe.send(
+                        {"kind": "coordinator", "address": msg["address"]}
+                    )
+            elif kind == ResponseType.SUCCESS.value and "dist_info" in msg:
+                if self.worker is not None:
+                    self.worker.pipe.send(
+                        {"kind": "dist_info", "dist_info": msg["dist_info"]}
+                    )
+
+    def on_reconfiguration(self, lost_ip: str) -> None:
+        """Reference on_receive_reconfiguration (agent.py:217-232)."""
+        logger.warning("host %s lost", lost_ip)
+        if lost_ip == self.agent_ip:
+            # We are declared dead: the built-in failure-injection kill switch.
+            logger.warning("this host is the victim; terminating")
+            self.terminate()
+            return
+        if lost_ip in self.node_ips:
+            self.node_ips.remove(lost_ip)
+        if self.worker is not None:
+            self.worker.pipe.send({"kind": "reconfigure", "lost_ip": lost_ip})
+
+    async def ping_loop(self) -> None:
+        while True:
+            await asyncio.sleep(PING_INTERVAL)
+            try:
+                async with self._send_lock:
+                    await send_request(self._writer, RequestType.PING)
+            except ConnectionError:
+                return
+
+    async def worker_port_loop(self) -> None:
+        """Poll the worker pipe for the coordinator announcement and forward
+        it to the master (reference forward_worker_port, agent.py:181-188)."""
+        while True:
+            if self.worker is not None and self.worker.pipe.poll():
+                msg = self.worker.pipe.recv()
+                if msg.get("kind") == "coordinator":
+                    async with self._send_lock:
+                        await send_request(
+                            self._writer, RequestType.FORWARD_COORDINATOR,
+                            {"address": msg["address"]},
+                        )
+            await asyncio.sleep(0.05)
+
+    def terminate(self) -> None:
+        if self.worker is not None and self.worker.process.is_alive():
+            self.worker.process.terminate()
+        raise SystemExit(1)
+
+
+def main() -> None:
+    import argparse
+
+    p = argparse.ArgumentParser()
+    p.add_argument("--master-ip", required=True)
+    p.add_argument("--master-port", type=int, required=True)
+    p.add_argument("--agent-ip", required=True)
+    a = p.parse_args()
+    logging.basicConfig(level=logging.INFO)
+    agent = OobleckAgent(a.master_ip, a.master_port, a.agent_ip)
+    asyncio.run(agent.run())
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,256 @@
+"""Master daemon: single-job cluster manager.
+
+Capability match for the reference master
+(/root/reference/oobleck/elastic/master.py:22-274):
+
+  * accepts one job (LAUNCH_JOB) and launches one agent per host — over SSH
+    when an ssh client is available, else as local subprocesses (the test
+    harness injects a mock launcher, like the reference's mocked asyncssh,
+    tests/elastic/test_master.py:46-49);
+  * registers agents and serves DistributionInfo;
+  * detects host failure by TCP disconnect (master.py:214-231) and broadcasts
+    (RECONFIGURATION, lost_ip) to survivors (close_agent, master.py:192-203);
+  * relays the JAX coordinator address from the first agent to all agents
+    (the reference's rank0-port chain, master.py:137-154);
+  * answers PING (the reference defines ping but never schedules it,
+    agent.py:54-61 — here the agent actually pings, see agent.py).
+
+Max cluster size mirrors the reference's 32 (master.py:19).
+"""
+
+from __future__ import annotations
+
+import asyncio
+import logging
+import shutil
+import subprocess
+import sys
+from dataclasses import dataclass, field
+
+from oobleck_tpu.config import OobleckArguments
+from oobleck_tpu.elastic.message import (
+    DistributionInfo,
+    RequestType,
+    ResponseType,
+    recv_msg,
+    send_response,
+)
+
+MAX_NUM_HOSTS = 32
+
+logger = logging.getLogger("oobleck.master")
+
+
+@dataclass
+class AgentInfo:
+    ip: str
+    reader: asyncio.StreamReader
+    writer: asyncio.StreamWriter
+
+
+class LocalLauncher:
+    """Spawn agents as local subprocesses (single-host / test deployments)."""
+
+    def __init__(self):
+        self.procs: list[subprocess.Popen] = []
+
+    async def launch(self, ip: str, master_ip: str, master_port: int,
+                     args: OobleckArguments) -> None:
+        self.procs.append(subprocess.Popen(
+            [sys.executable, "-m", "oobleck_tpu.elastic.agent",
+             "--master-ip", master_ip, "--master-port", str(master_port),
+             "--agent-ip", ip],
+        ))
+
+
+class SSHLauncher:
+    """Launch agents over ssh (reference run_node_agents, master.py:60-91,
+    which uses asyncssh + conda; here: the system ssh client)."""
+
+    def __init__(self, username: str | None, node_port: int = 22):
+        self.username = username
+        self.node_port = node_port
+        if shutil.which("ssh") is None:
+            raise RuntimeError("no ssh client available; use LocalLauncher")
+
+    async def launch(self, ip: str, master_ip: str, master_port: int,
+                     args: OobleckArguments) -> None:
+        target = f"{self.username}@{ip}" if self.username else ip
+        cmd = (
+            f"{sys.executable} -m oobleck_tpu.elastic.agent "
+            f"--master-ip {master_ip} --master-port {master_port} "
+            f"--agent-ip {ip}"
+        )
+        proc = await asyncio.create_subprocess_exec(
+            "ssh", "-p", str(self.node_port), target, cmd,
+            stdout=asyncio.subprocess.DEVNULL, stderr=asyncio.subprocess.DEVNULL,
+        )
+        logger.info("launched agent on %s (ssh pid %s)", ip, proc.pid)
+
+
+class OobleckMasterDaemon:
+    def __init__(self, port: int = 0, launcher=None):
+        self._requested_port = port
+        self.port: int | None = None
+        self.launcher = launcher
+        self.job: OobleckArguments | None = None
+        self.agents: dict[str, AgentInfo] = {}
+        self.coordinator: str | None = None  # "ip:port" of the JAX coordinator
+        self._server: asyncio.Server | None = None
+        self._pending_ips: list[str] = []
+
+    # ------------------------------------------------------------------ #
+
+    async def start(self) -> None:
+        self._server = await asyncio.start_server(
+            self._on_connected, host="0.0.0.0", port=self._requested_port
+        )
+        self.port = self._server.sockets[0].getsockname()[1]
+        logger.info("master listening on :%d", self.port)
+
+    async def serve_forever(self) -> None:
+        assert self._server is not None
+        # NOT `async with self._server`: its __aexit__ awaits wait_closed(),
+        # which on Python 3.12 blocks until every connection handler returns —
+        # agent loops are intentionally long-lived, so cancellation would hang.
+        await self._server.serve_forever()
+
+    async def stop(self) -> None:
+        if self._server is not None:
+            self._server.close()
+            await self._server.wait_closed()
+
+    # ------------------------------------------------------------------ #
+
+    async def _on_connected(self, reader: asyncio.StreamReader,
+                            writer: asyncio.StreamWriter) -> None:
+        try:
+            msg = await recv_msg(reader, timeout=None)
+        except (asyncio.IncompleteReadError, ConnectionError):
+            writer.close()
+            return
+        kind = msg.get("kind")
+        if kind == RequestType.LAUNCH_JOB.value:
+            await self._handle_launch_job(msg, reader, writer)
+        elif kind == RequestType.REGISTER_AGENT.value:
+            await self._handle_register_agent(msg, reader, writer)
+        else:
+            await send_response(writer, ResponseType.FAILURE,
+                                {"error": f"unexpected first message {kind}"})
+            writer.close()
+
+    async def _handle_launch_job(self, msg, reader, writer) -> None:
+        """Reference request_job_handler (master.py:93-135)."""
+        if self.job is not None:
+            await send_response(writer, ResponseType.FAILURE,
+                                {"error": "job already running"})
+            return
+        try:
+            args = OobleckArguments.from_dict(msg["args"])
+        except Exception as e:
+            await send_response(writer, ResponseType.FAILURE, {"error": str(e)})
+            return
+        if len(args.dist.node_ips) > MAX_NUM_HOSTS:
+            await send_response(writer, ResponseType.FAILURE,
+                                {"error": f"too many hosts (max {MAX_NUM_HOSTS})"})
+            return
+        if args.dist.num_agents_per_node != 1:
+            # The registry is keyed by host IP; multiple agents per host would
+            # alias each other (and a TPU host needs exactly one JAX process).
+            await send_response(writer, ResponseType.FAILURE,
+                                {"error": "num_agents_per_node must be 1"})
+            return
+        self.job = args
+        self._pending_ips = list(args.dist.node_ips)
+        await send_response(writer, ResponseType.SUCCESS)
+        if self.launcher is not None:
+            for ip in args.dist.node_ips:
+                for _ in range(args.dist.num_agents_per_node):
+                    await self.launcher.launch(
+                        ip, args.dist.master_ip, self.port, args
+                    )
+
+    async def _handle_register_agent(self, msg, reader, writer) -> None:
+        """Reference register_agent_handler (master.py:156-190)."""
+        ip = msg.get("ip") or writer.get_extra_info("peername")[0]
+        if self.job is None:
+            await send_response(writer, ResponseType.FAILURE,
+                                {"error": "no job configured"})
+            writer.close()
+            return
+        self.agents[ip] = AgentInfo(ip, reader, writer)
+        await send_response(writer, ResponseType.SUCCESS,
+                            {"args": self.job.to_dict()})
+        if self.coordinator is not None:
+            # Late registrant: replay the coordinator announcement it missed.
+            await send_response(writer, ResponseType.FORWARD_COORDINATOR,
+                                {"address": self.coordinator})
+        # Keep the channel open: this connection is the liveness signal.
+        try:
+            await self._agent_loop(self.agents[ip])
+        finally:
+            if ip in self.agents:
+                await self._close_agent(ip)
+
+    async def _agent_loop(self, agent: AgentInfo) -> None:
+        """Serve requests from one agent until it disconnects
+        (reference agent_handler, master.py:214-231)."""
+        while True:
+            try:
+                msg = await recv_msg(agent.reader, timeout=None)
+            except (asyncio.IncompleteReadError, ConnectionError):
+                logger.warning("agent %s disconnected", agent.ip)
+                return
+            kind = msg.get("kind")
+            if kind == RequestType.PING.value:
+                await send_response(agent.writer, ResponseType.PONG)
+            elif kind == RequestType.GET_DIST_INFO.value:
+                info = DistributionInfo(
+                    agent_ips=list(self.agents.keys()),
+                    world_size=len(self.agents) * (
+                        self.job.dist.num_workers if self.job else 1
+                    ),
+                )
+                await send_response(agent.writer, ResponseType.SUCCESS,
+                                    {"dist_info": info.to_dict()})
+            elif kind == RequestType.FORWARD_COORDINATOR.value:
+                # First agent's worker announces the JAX coordinator address;
+                # relay to everyone (reference forward_rank0_port_handler,
+                # master.py:137-154).
+                self.coordinator = msg["address"]
+                for other in list(self.agents.values()):
+                    await send_response(
+                        other.writer, ResponseType.FORWARD_COORDINATOR,
+                        {"address": self.coordinator},
+                    )
+            else:
+                await send_response(agent.writer, ResponseType.FAILURE,
+                                    {"error": f"unknown request {kind}"})
+
+    async def _close_agent(self, ip: str) -> None:
+        """Reference close_agent (master.py:192-203): drop the agent and
+        broadcast the loss to survivors."""
+        agent = self.agents.pop(ip, None)
+        if agent is not None:
+            agent.writer.close()
+        for other in list(self.agents.values()):
+            try:
+                await send_response(other.writer, ResponseType.RECONFIGURATION,
+                                    {"lost_ip": ip})
+            except ConnectionError:
+                pass
+
+
+async def _amain(port: int) -> None:
+    daemon = OobleckMasterDaemon(port=port, launcher=LocalLauncher())
+    await daemon.start()
+    await daemon.serve_forever()
+
+
+if __name__ == "__main__":
+    import argparse
+
+    p = argparse.ArgumentParser()
+    p.add_argument("--port", type=int, default=19191)
+    logging.basicConfig(level=logging.INFO)
+    asyncio.run(_amain(p.parse_args().port))

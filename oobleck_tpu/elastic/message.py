@@ -1,0 +1,84 @@
+"""Wire protocol for the elastic control plane.
+
+Capability match for the reference protocol
+(/root/reference/oobleck/elastic/message_util.py:10-93) with one deliberate
+change: messages are length-prefixed JSON, not pickle — the control plane
+crosses trust boundaries (SSH-launched agents, job clients), and pickle
+deserialization is code execution. Layout per message:
+
+    [4-byte big-endian length][UTF-8 JSON body]
+
+Body always carries "kind" (request/response tag). Timeouts mirror the
+reference's 5 s default (message_util.py:7).
+"""
+
+from __future__ import annotations
+
+import asyncio
+import json
+from dataclasses import asdict, dataclass, field
+from enum import Enum
+
+TIMEOUT = 5.0
+MAX_MSG_BYTES = 64 * 1024 * 1024
+
+
+class RequestType(str, Enum):
+    LAUNCH_JOB = "launch_job"
+    GET_DIST_INFO = "get_dist_info"
+    REGISTER_AGENT = "register_agent"
+    PING = "ping"
+    FORWARD_COORDINATOR = "forward_coordinator"  # reference: FORWARD_RANK0_PORT
+
+
+class ResponseType(str, Enum):
+    SUCCESS = "success"
+    FAILURE = "failure"
+    PONG = "pong"
+    RECONFIGURATION = "reconfiguration"
+    FORWARD_COORDINATOR = "forward_coordinator"
+
+
+@dataclass
+class DistributionInfo:
+    """Cluster membership snapshot (reference message_util.py:10-13)."""
+
+    agent_ips: list[str] = field(default_factory=list)
+    world_size: int = 0
+
+    def to_dict(self) -> dict:
+        return asdict(self)
+
+    @classmethod
+    def from_dict(cls, d: dict) -> "DistributionInfo":
+        return cls(agent_ips=list(d["agent_ips"]), world_size=int(d["world_size"]))
+
+
+async def send_msg(writer: asyncio.StreamWriter, body: dict) -> None:
+    data = json.dumps(body).encode()
+    if len(data) > MAX_MSG_BYTES:
+        raise ValueError(f"message too large: {len(data)}")
+    writer.write(len(data).to_bytes(4, "big") + data)
+    await writer.drain()
+
+
+async def recv_msg(reader: asyncio.StreamReader, timeout: float | None = TIMEOUT
+                   ) -> dict:
+    async def _read():
+        header = await reader.readexactly(4)
+        length = int.from_bytes(header, "big")
+        if length > MAX_MSG_BYTES:
+            raise ValueError(f"message too large: {length}")
+        return json.loads(await reader.readexactly(length))
+
+    if timeout is None:
+        return await _read()
+    return await asyncio.wait_for(_read(), timeout)
+
+
+async def send_request(writer, req: RequestType, payload: dict | None = None) -> None:
+    await send_msg(writer, {"kind": req.value, **(payload or {})})
+
+
+async def send_response(writer, resp: ResponseType, payload: dict | None = None) -> None:
+    await send_msg(writer, {"kind": resp.value, **(payload or {})})

@@ -1,0 +1,76 @@
+"""Job-submission CLI: `python -m oobleck_tpu.elastic.run --config-path job.yaml`.
+
+Capability match for /root/reference/oobleck/run.py:18-72: parse yaml + CLI
+overrides into OobleckArguments, connect to the master, request the launch.
+"""
+
+from __future__ import annotations
+
+import argparse
+import asyncio
+import logging
+
+from oobleck_tpu.config import OobleckArguments
+from oobleck_tpu.elastic.message import (
+    RequestType,
+    ResponseType,
+    recv_msg,
+    send_request,
+)
+
+logger = logging.getLogger("oobleck.run")
+
+
+class OobleckClient:
+    """Reference OobleckClient (run.py:18-41)."""
+
+    def __init__(self, args: OobleckArguments):
+        self.args = args
+        self._reader = None
+        self._writer = None
+
+    async def connect_to_master(self) -> None:
+        self._reader, self._writer = await asyncio.open_connection(
+            self.args.dist.master_ip, self.args.dist.master_port
+        )
+
+    async def request_job_launch(self) -> None:
+        await send_request(self._writer, RequestType.LAUNCH_JOB,
+                           {"args": self.args.to_dict()})
+        msg = await recv_msg(self._reader)
+        if msg.get("kind") != ResponseType.SUCCESS.value:
+            raise RuntimeError(f"job launch failed: {msg}")
+        logger.info("job launched")
+
+
+def parse_args(argv=None) -> OobleckArguments:
+    p = argparse.ArgumentParser(description=__doc__)
+    p.add_argument("--config-path", required=True, help="yaml job config")
+    p.add_argument("--node-ips", nargs="*", default=None,
+                   help="override dist.node_ips")
+    p.add_argument("--master-ip", default=None)
+    p.add_argument("--master-port", type=int, default=None)
+    a = p.parse_args(argv)
+    args = OobleckArguments.from_yaml(a.config_path)
+    if a.node_ips:
+        args.dist.node_ips = a.node_ips
+    if a.master_ip:
+        args.dist.master_ip = a.master_ip
+    if a.master_port:
+        args.dist.master_port = a.master_port
+    return args
+
+
+async def amain(args: OobleckArguments) -> None:
+    client = OobleckClient(args)
+    await client.connect_to_master()
+    await client.request_job_launch()
+
+
+def main(argv=None) -> None:
+    logging.basicConfig(level=logging.INFO)
+    asyncio.run(amain(parse_args(argv)))
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,29 @@
+"""Worker entry point: one process per TPU host.
+
+Capability match for /root/reference/oobleck/elastic/worker.py:13-34. The
+worker owns every local chip (no per-device pinning) and drives the engine:
+build -> initialize distributed -> instantiate pipelines -> train.
+"""
+
+from __future__ import annotations
+
+import logging
+
+from oobleck_tpu.config import OobleckArguments
+
+logger = logging.getLogger("oobleck.worker")
+
+
+def worker_main(pipe, agent_ip: str, args_dict: dict) -> None:
+    args = OobleckArguments.from_dict(args_dict)
+    job = args.job
+    # Sanity mirrored from the reference (worker.py:27-28); JobArguments also
+    # enforces this at construction.
+    assert job.global_microbatch_size % job.microbatch_size == 0
+
+    from oobleck_tpu.execution.engine import OobleckEngine
+
+    engine = OobleckEngine(args, agent_ip=agent_ip, agent_pipe=pipe)
+    engine.initialize_distributed()
+    engine.instantiate_pipelines(job.global_num_microbatch)
+    engine.train()

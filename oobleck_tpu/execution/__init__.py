@@ -1,0 +1,1 @@
+"""Execution runtime (L1/L2): datasets, dataloaders, pipelines, engine."""

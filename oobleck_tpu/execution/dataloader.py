@@ -1,0 +1,130 @@
+"""Heterogeneity-aware sampler + dataloader.
+
+Capability match for the reference's OobleckSampler/OobleckDataLoader
+(/root/reference/oobleck/execution/dataloader.py:13-147): heterogeneous
+pipelines consume different microbatch counts per iteration, and no two
+pipelines may see the same sample. Each iteration covers one contiguous
+"bucket" of sum(num_microbatches)·mb_size shuffled indices; pipeline p reads
+its contiguous slice at offset sum(num_microbatches[:p])·mb_size; the next
+iteration jumps a whole bucket.
+
+Differences from the reference (quirks §7.4 not replicated):
+  * iteration/epoch state is advanced by `advance()` rather than mutated
+    mid-iteration inside __iter__ (the reference mutates shared state while
+    iterating, dataloader.py:81-97);
+  * numpy RNG, no torch dependency; deterministic seed+epoch shuffle kept.
+
+Resume-after-reconfiguration works the same way: construct with the saved
+(num_iterations_done, epoch) and the index stream continues where it left off
+(reference engine.py:203-214).
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass
+from enum import Enum
+
+import numpy as np
+
+
+class LoaderType(Enum):
+    TRAINING = 0
+    EVALUATION = 1
+
+
+class OobleckSampler:
+    """Yields microbatch index lists for one pipeline of a heterogeneous set."""
+
+    def __init__(
+        self,
+        num_samples: int,
+        microbatch_size: int,
+        pipeline_index: int,
+        num_microbatches: list[int],
+        num_iterations_done: int = 0,
+        epoch: int = 0,
+        shuffle: bool = True,
+        seed: int = 0,
+    ):
+        assert pipeline_index < len(num_microbatches)
+        self.num_samples = num_samples
+        self.microbatch_size = microbatch_size
+        self.pipeline_index = pipeline_index
+        self.num_microbatches = list(num_microbatches)
+        self.num_iterations_done = num_iterations_done
+        self.epoch = epoch
+        self.shuffle = shuffle
+        self.seed = seed
+        self.bucket_size = microbatch_size * sum(num_microbatches)
+
+    def iterations_per_epoch(self) -> int:
+        return self.num_samples // self.bucket_size
+
+    def __len__(self) -> int:
+        return self.iterations_per_epoch()
+
+    def _epoch_indices(self) -> np.ndarray:
+        if self.shuffle:
+            rng = np.random.default_rng(self.seed + self.epoch)
+            return rng.permutation(self.num_samples)
+        return np.arange(self.num_samples)
+
+    def next_iteration(self) -> list[np.ndarray]:
+        """Index lists for this pipeline's microbatches of the next iteration.
+
+        Advances (num_iterations_done, epoch) *after* producing the batch, so
+        a crash/reconfiguration between iterations resumes exactly here.
+        """
+        if self.num_iterations_done >= self.iterations_per_epoch():
+            # Incomplete trailing bucket is dropped (reference behavior).
+            self.epoch += 1
+            self.num_iterations_done = 0
+        indices = self._epoch_indices()
+        base = self.num_iterations_done * self.bucket_size
+        offset = (
+            sum(self.num_microbatches[: self.pipeline_index]) * self.microbatch_size
+        )
+        mbs = []
+        for mb in range(self.num_microbatches[self.pipeline_index]):
+            start = base + offset + mb * self.microbatch_size
+            mbs.append(indices[start: start + self.microbatch_size])
+        self.num_iterations_done += 1
+        return mbs
+
+    def __iter__(self):
+        while True:
+            start_epoch = self.epoch
+            for mb in self.next_iteration():
+                yield mb
+            if self.epoch != start_epoch:
+                return
+
+
+class OobleckDataLoader:
+    """Assembles sampler microbatches into numpy token arrays.
+
+    One `next_batch()` call returns ALL of this pipeline's microbatches for
+    one iteration, stacked [num_mb, mb_size, seq] — matching the fused train
+    step's input contract (the reference loads one microbatch per schedule
+    instruction instead, pipeline.py:158-167).
+    """
+
+    def __init__(self, dataset, sampler: OobleckSampler):
+        self.dataset = dataset
+        self.sampler = sampler
+
+    @property
+    def num_iterations_done(self) -> int:
+        return self.sampler.num_iterations_done
+
+    @property
+    def epoch(self) -> int:
+        return self.sampler.epoch
+
+    def next_batch(self) -> np.ndarray:
+        mbs = self.sampler.next_iteration()
+        batches = []
+        for idx_list in mbs:
+            rows = [self.dataset[int(i)]["input_ids"] for i in idx_list]
+            batches.append(np.stack(rows))
+        return np.stack(batches)

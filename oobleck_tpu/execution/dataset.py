@@ -1,0 +1,101 @@
+"""Datasets.
+
+Capability match for the reference dataset wrapper
+(/root/reference/oobleck/execution/dataset.py:25-208): HF `load_dataset` +
+tokenize + concat-and-chunk for language models, with a synthetic deterministic
+token stream as the default/fallback — this environment has zero egress, and
+the planner/trainer only need token arrays, so `dataset_path="synthetic"`
+(config.py default) produces an offline-reproducible corpus.
+
+All arrays are numpy int32 [seq_length]; batching is the dataloader's job.
+"""
+
+from __future__ import annotations
+
+import hashlib
+
+import numpy as np
+
+
+class SyntheticTextDataset:
+    """Deterministic pseudo-corpus: sample i is a seeded random token block.
+
+    Deterministic across processes (rank-independent), so the heterogeneous
+    sampler's disjointness guarantees are testable without real data.
+    """
+
+    def __init__(self, vocab_size: int, seq_length: int, num_samples: int = 8192,
+                 seed: int = 42):
+        self.vocab_size = vocab_size
+        self.seq_length = seq_length
+        self.num_samples = num_samples
+        self.seed = seed
+
+    def __len__(self) -> int:
+        return self.num_samples
+
+    def __getitem__(self, idx: int) -> dict:
+        if not 0 <= idx < self.num_samples:
+            raise IndexError(idx)
+        rng = np.random.default_rng(self.seed * 1_000_003 + idx)
+        return {
+            "input_ids": rng.integers(
+                0, self.vocab_size, size=(self.seq_length,), dtype=np.int32
+            )
+        }
+
+
+class HFTextDataset:
+    """HF datasets + tokenizer path (reference create_language_dataset,
+    dataset.py:150-208): tokenize, concatenate, chunk to seq_length.
+
+    Requires the dataset/tokenizer to be locally cached (zero-egress env);
+    raises a clear error otherwise.
+    """
+
+    def __init__(self, dataset_path: str, dataset_name: str | None,
+                 tokenizer_name: str, seq_length: int):
+        import os
+
+        # Fail fast from the local cache: without these, a cache miss burns
+        # ~30s in HEAD-request retries before erroring (zero-egress env).
+        os.environ.setdefault("HF_HUB_OFFLINE", "1")
+        os.environ.setdefault("HF_DATASETS_OFFLINE", "1")
+        try:
+            from datasets import load_dataset
+            from transformers import AutoTokenizer
+        except ImportError as e:
+            raise RuntimeError(f"HF libraries unavailable: {e}") from e
+        try:
+            raw = load_dataset(dataset_path, dataset_name, split="train")
+            tok = AutoTokenizer.from_pretrained(tokenizer_name)
+        except Exception as e:
+            raise RuntimeError(
+                f"could not load {dataset_path}/{dataset_name} or tokenizer "
+                f"{tokenizer_name} from local cache (offline env): {e}"
+            ) from e
+        text_col = "text" if "text" in raw.column_names else raw.column_names[0]
+        ids: list[int] = []
+        for row in raw:
+            ids.extend(tok(row[text_col])["input_ids"])
+        n = len(ids) // seq_length
+        self._chunks = np.array(ids[: n * seq_length], dtype=np.int32).reshape(
+            n, seq_length
+        )
+        self.seq_length = seq_length
+
+    def __len__(self) -> int:
+        return len(self._chunks)
+
+    def __getitem__(self, idx: int) -> dict:
+        return {"input_ids": self._chunks[idx]}
+
+
+def build_dataset(dataset_path: str, dataset_name: str | None, *,
+                  model_name: str, vocab_size: int, seq_length: int,
+                  num_samples: int = 8192):
+    """Resolve config (dataset_path/dataset_name per the reference's
+    ModelArguments contract, training_util.py:27-32) to a dataset object."""
+    if dataset_path in ("synthetic", "", None):
+        return SyntheticTextDataset(vocab_size, seq_length, num_samples)
+    return HFTextDataset(dataset_path, dataset_name, model_name, seq_length)

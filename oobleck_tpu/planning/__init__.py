@@ -1,0 +1,39 @@
+"""Planning layer: profiler → pipeline-template generator → instantiator.
+
+Capability match for the reference's L3 (/root/reference/oobleck/planning/ +
+oobleck/csrc/planning/): a per-layer profiler measures TPU costs, a
+divide-and-conquer generator (C++ with a pure-Python twin) computes one
+optimal pipeline template per feasible host count, and the instantiator picks
+the best multiset of templates for the current cluster plus the per-pipeline
+microbatch distribution.
+"""
+
+from oobleck_tpu.planning.templates import (
+    LayerProfile,
+    PipelineTemplate,
+    StageSpec,
+    TemplateGenerator,
+)
+from oobleck_tpu.planning.profiler import (
+    get_profile_path,
+    load_profile,
+    profile,
+    validate_model_args,
+)
+from oobleck_tpu.planning.instantiator import (
+    HeterogeneousPlan,
+    PipelineInstantiator,
+)
+
+__all__ = [
+    "LayerProfile",
+    "PipelineTemplate",
+    "StageSpec",
+    "TemplateGenerator",
+    "profile",
+    "load_profile",
+    "get_profile_path",
+    "validate_model_args",
+    "PipelineInstantiator",
+    "HeterogeneousPlan",
+]

@@ -1,0 +1,219 @@
+"""Pipeline instantiation: which templates to run, and the microbatch split.
+
+Capability match for the reference instantiator (paper §4.2;
+/root/reference/oobleck/planning/instantiator.py:155-329):
+
+  * `_enumerate_instantiation_options` — knapsack-style DP over all multisets
+    of templates whose host counts sum to the cluster size (ref :224-252);
+  * `_distribute_batch` — the reference solves a Pyomo MINLP (glpk+ipopt
+    subprocesses, ref :254-329) minimizing the variance of per-pipeline
+    iteration time T_i/s_i · nb_i subject to Σ nb_i·x_i = B. Here the same
+    objective is solved exactly with a continuous-relaxation-guided window
+    search (nb_i are small integers) — no solver dependency, deterministic,
+    and ~µs instead of subprocess round-trips (SURVEY §7.3.6);
+  * `HeterogeneousPlan` — plan selection by estimated iteration time =
+    max_i(T_i · nb_i) + first-layer cross-host allreduce overhead
+    (ref HeterogeneousPipelinesExecutionPlan.iteration_time, :54-68).
+"""
+
+from __future__ import annotations
+
+import itertools
+from dataclasses import dataclass, field
+
+from oobleck_tpu.planning.templates import LayerProfile, PipelineTemplate
+
+
+@dataclass(frozen=True)
+class PipelineAssignment:
+    """One concrete pipeline instance: a template + its global chip ranks."""
+
+    pipeline_index: int
+    template: PipelineTemplate
+    ranks: tuple[int, ...]
+    num_microbatches: int
+
+
+@dataclass
+class HeterogeneousPlan:
+    """A chosen multiset of templates + per-template microbatch counts."""
+
+    num_instances: dict[PipelineTemplate, int]
+    num_microbatches: dict[PipelineTemplate, int]
+    allreduce_across_hosts: list[dict[int, float]]
+
+    @property
+    def templates(self) -> list[PipelineTemplate]:
+        return sorted(self.num_instances, key=lambda t: t.num_hosts)
+
+    @property
+    def total_num_pipelines(self) -> int:
+        return sum(self.num_instances.values())
+
+    @property
+    def total_num_microbatches(self) -> int:
+        return sum(
+            self.num_instances[t] * self.num_microbatches[t]
+            for t in self.num_instances
+        )
+
+    @property
+    def iteration_time(self) -> float:
+        longest = max(
+            t.iteration_time * self.num_microbatches[t] for t in self.num_instances
+        )
+        # Only the first layer's cross-host grad allreduce is charged; the
+        # rest overlaps with backward compute (reference instantiator.py:61-66).
+        sync = self.allreduce_across_hosts[0].get(self.total_num_pipelines, 0.0)
+        return longest + sync
+
+    def assignments(self, ranks: list[list[int]] | None = None
+                    ) -> list[PipelineAssignment]:
+        """Materialize pipeline instances with contiguous rank blocks (or the
+        explicit per-pipeline `ranks` used after reconfiguration;
+        reference instantiate(), instantiator.py:103-152)."""
+        out: list[PipelineAssignment] = []
+        cursor = 0
+        index = 0
+        for template in self.templates:
+            for _ in range(self.num_instances[template]):
+                n = template.num_chips
+                if ranks is not None:
+                    block = tuple(ranks[index])
+                    assert len(block) == n, (len(block), n)
+                else:
+                    block = tuple(range(cursor, cursor + n))
+                out.append(PipelineAssignment(
+                    pipeline_index=index,
+                    template=template,
+                    ranks=block,
+                    num_microbatches=self.num_microbatches[template],
+                ))
+                cursor += n
+                index += 1
+        return out
+
+    def pipeline_index_of_rank(self, rank: int) -> int:
+        for a in self.assignments():
+            if rank in a.ranks:
+                return a.pipeline_index
+        raise RuntimeError(f"rank {rank} is not in any pipeline")
+
+    def __repr__(self) -> str:
+        parts = [
+            f"{self.num_instances[t]} x {t.num_hosts}-host/{t.num_stages}-stage "
+            f"(nb={self.num_microbatches[t]})"
+            for t in self.templates
+        ]
+        return f"HeterogeneousPlan[{', '.join(parts)}; B={self.total_num_microbatches}]"
+
+
+class PipelineInstantiator:
+    def get_best_execution_plan(
+        self,
+        templates: list[PipelineTemplate],
+        allreduce_across_hosts: list[dict[int, float]],
+        num_hosts: int,
+        global_num_microbatch: int,
+    ) -> HeterogeneousPlan:
+        """Enumerate feasible instance sets, distribute the batch over each,
+        pick the min-iteration-time plan (reference :156-200)."""
+        options = self._enumerate_instantiation_options(templates, num_hosts)
+        plans: list[HeterogeneousPlan] = []
+        for num_instances in options:
+            nb = self._distribute_batch(global_num_microbatch, num_instances)
+            if nb is None:
+                continue
+            plans.append(HeterogeneousPlan(num_instances, nb, allreduce_across_hosts))
+        if not plans:
+            raise RuntimeError(
+                f"No feasible execution plan for {num_hosts} hosts / "
+                f"{global_num_microbatch} microbatches"
+            )
+        return min(plans, key=lambda p: p.iteration_time)
+
+    def get_new_execution_plan(
+        self,
+        new_num_instances: dict[PipelineTemplate, int],
+        allreduce_across_hosts: list[dict[int, float]],
+        global_num_microbatch: int,
+    ) -> HeterogeneousPlan:
+        """Redistribute the batch for a fixed instance set (reconfiguration
+        path, reference :202-222)."""
+        nb = self._distribute_batch(global_num_microbatch, new_num_instances)
+        if nb is None:
+            raise RuntimeError("batch cannot be distributed over the new instances")
+        return HeterogeneousPlan(new_num_instances, nb, allreduce_across_hosts)
+
+    # ------------------------------------------------------------------ #
+
+    def _enumerate_instantiation_options(
+        self, templates: list[PipelineTemplate], num_hosts: int
+    ) -> list[dict[PipelineTemplate, int]]:
+        """All multisets {template: count} with Σ count·hosts == num_hosts
+        (reference DP, instantiator.py:224-252)."""
+        dp: list[list[list[dict]]] = [
+            [[] for _ in range(num_hosts + 1)] for _ in range(len(templates) + 1)
+        ]
+        for i in range(1, len(templates) + 1):
+            dp[i][0] = [dict()]
+            t = templates[i - 1]
+            for j in range(1, num_hosts + 1):
+                dp[i][j] = [dict(c) for c in dp[i - 1][j]]
+                if t.num_hosts <= j:
+                    for combo in dp[i][j - t.num_hosts]:
+                        new_combo = dict(combo)
+                        new_combo[t] = new_combo.get(t, 0) + 1
+                        dp[i][j].append(new_combo)
+        return dp[-1][-1]
+
+    def _distribute_batch(
+        self,
+        global_num_microbatch: int,
+        num_instances: dict[PipelineTemplate, int],
+        window: int = 3,
+    ) -> dict[PipelineTemplate, int] | None:
+        """min variance of (T_i/s_i)·nb_i  s.t.  Σ nb_i·x_i = B, nb_i ≥ 1.
+
+        Continuous relaxation: (T_i/s_i)·nb_i = c ⟹ nb_i = c·s_i/T_i with c
+        from the budget constraint. Search an integer window of ±`window`
+        around the relaxed nb_i for all but the last template; the last
+        template's nb is determined by the constraint. Exact for the small
+        integer ranges involved (reference uses a Pyomo MINLP here).
+        """
+        templates = list(num_instances.keys())
+        k = len(templates)
+        B = global_num_microbatch
+        x = [num_instances[t] for t in templates]
+        w = [t.iteration_time / t.num_stages for t in templates]
+
+        if sum(x) > B:
+            return None  # cannot give every pipeline ≥1 microbatch
+        if k == 1:
+            if B % x[0] != 0:
+                return None
+            return {templates[0]: B // x[0]}
+
+        c = B / sum(x[i] / w[i] for i in range(k))
+        relaxed = [max(1.0, c / w[i]) for i in range(k)]
+
+        best: tuple[float, list[int]] | None = None
+        ranges = [
+            range(max(1, int(relaxed[i]) - window), int(relaxed[i]) + window + 1)
+            for i in range(k - 1)
+        ]
+        for combo in itertools.product(*ranges):
+            used = sum(nb * xi for nb, xi in zip(combo, x[:-1]))
+            rem = B - used
+            if rem <= 0 or rem % x[-1] != 0:
+                continue
+            nb_last = rem // x[-1]
+            nbs = list(combo) + [nb_last]
+            times = [w[i] * nbs[i] for i in range(k)]
+            mean = sum(times) / k
+            var = sum((t - mean) ** 2 for t in times)
+            if best is None or var < best[0]:
+                best = (var, nbs)
+        if best is None:
+            return None
+        return {t: nb for t, nb in zip(templates, best[1])}

@@ -1,0 +1,331 @@
+"""Per-layer profiler: the planner's input.
+
+Capability match for the reference profiler
+(/root/reference/oobleck/planning/profiler.py:241-323), TPU-native:
+
+  * forward latency: each layer jitted and timed on the local device with a
+    host readback barrier (the axon relay makes block_until_ready unreliable);
+  * backward latency: the layer's VJP jitted and timed the same way —
+    *measured*, not the reference's 3x-forward estimate (profiler.py:104);
+  * memory: exact parameter bytes + activation output bytes from abstract
+    evaluation (no allocation);
+  * collective latencies (allreduce within a host / across hosts): measured
+    with a real psum when multiple devices are visible, otherwise an
+    ICI/DCN bandwidth-latency model — a single tunneled chip cannot measure
+    multi-chip collectives.
+
+Results are cached as JSON with the reference's file layout
+(profiler.py:255-257, 290-319): {cache}/{model}-{tag}/mb{N}.json,
+allreduce_in_node.json, allreduce_across_nodes.json, model_args.json,
+so the planner is fully decoupled from profiling.
+"""
+
+from __future__ import annotations
+
+import json
+import math
+import os
+import time
+from pathlib import Path
+
+import jax
+import jax.numpy as jnp
+
+from oobleck_tpu.models.base import param_bytes
+from oobleck_tpu.planning.templates import LayerProfile
+
+WARMUP = 2
+ITERS = 3  # matches reference profiler.py:18-19
+# In-graph repetitions per timed call: a single dispatch over the axon relay
+# costs ~80ms round-trip, far above a layer's real latency, so each timed
+# call scans the layer REPS times on-device and the overhead (measured with a
+# trivial program) is subtracted before dividing.
+REPS = 16
+
+# Bandwidth-latency model constants for unmeasurable collectives.
+# ICI (intra-host, chip-to-chip): ~1e11 B/s effective allreduce bandwidth,
+# ~10us base latency per hop; DCN (cross-host): ~2.5e10 B/s, ~50us base.
+ICI_BW = 1.0e11
+ICI_LAT_MS = 0.01
+DCN_BW = 2.5e10
+DCN_LAT_MS = 0.05
+
+
+def default_cache_dir() -> Path:
+    return Path(
+        os.environ.get("OOBLECK_TPU_CACHE", "/tmp/oobleck_tpu")
+    ) / "profiles"
+
+
+def get_profile_path(model_name: str, model_tag: str) -> Path:
+    return default_cache_dir() / f"{model_name}-{model_tag}"
+
+
+def _sync(x) -> float:
+    """Force completion; returns a value to defeat DCE."""
+    return float(jnp.sum(jax.tree.leaves(x)[0].ravel()[0]))
+
+
+def _time_call(fn, *args) -> float:
+    """Median wall-time of fn(*args) in ms with warmup + readback sync."""
+    for _ in range(WARMUP):
+        _sync(fn(*args))
+    times = []
+    for _ in range(ITERS):
+        t0 = time.perf_counter()
+        _sync(fn(*args))
+        times.append((time.perf_counter() - t0) * 1e3)
+    times.sort()
+    return times[len(times) // 2]
+
+
+_overhead_cache: list[float] = []
+
+
+def _dispatch_overhead_ms() -> float:
+    """Round-trip cost of a trivial dispatch+readback (axon relay ~80ms)."""
+    if not _overhead_cache:
+        f = jax.jit(lambda x: x + 1.0)
+        _overhead_cache.append(_time_call(f, jnp.float32(0.0)))
+    return _overhead_cache[0]
+
+
+def _time_repeated(fn_once, x0, reps: int = REPS) -> float:
+    """Time `fn_once(x)` by scanning it `reps` times inside one jit call.
+
+    Each iteration's input is data-perturbed by 0 derived from the previous
+    output, forcing a sequential chain XLA cannot hoist or CSE (a float*0 is
+    not folded). Returns per-iteration ms with dispatch overhead removed.
+    """
+    def perturb(x, leaf):
+        zero = leaf * 0.0
+        return jax.tree.map(
+            lambda v: v + zero.astype(v.dtype), x
+        )
+
+    def run(x):
+        def body(carry, _):
+            x, acc = carry
+            out = fn_once(x)
+            leaf = jax.tree.leaves(out)[0].ravel()[0].astype(jnp.float32)
+            return (perturb(x, leaf), acc + leaf), None
+
+        (_, acc), _ = jax.lax.scan(body, (x, jnp.float32(0.0)), None, length=reps)
+        return acc
+
+    total = _time_call(jax.jit(run), x0)
+    return max((total - _dispatch_overhead_ms()) / reps, 1e-4)
+
+
+def allreduce_time_model(nbytes: int, n: int, *, cross_host: bool) -> float:
+    """Ring-allreduce time estimate in ms for n participants."""
+    if n <= 1:
+        return 0.0
+    bw, lat = (DCN_BW, DCN_LAT_MS) if cross_host else (ICI_BW, ICI_LAT_MS)
+    volume = 2 * (n - 1) / n * nbytes
+    return lat * math.ceil(math.log2(n)) + volume / bw * 1e3
+
+
+def _measure_allreduce(nbytes: int, devices: list) -> float:
+    """Measured psum across `devices` in ms (when hardware is available)."""
+    n = len(devices)
+    from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+    mesh = Mesh(devices, ("x",))
+    elems = max(nbytes // 4, n)
+    arr = jnp.ones((elems,), jnp.float32)
+    arr = jax.device_put(arr, NamedSharding(mesh, P("x")))
+
+    def psum_fn(a):
+        return jax.shard_map(
+            lambda v: jax.lax.psum(v, "x"), mesh=mesh,
+            in_specs=P("x"), out_specs=P(None), axis_names={"x"},
+        )(a)
+
+    fn = jax.jit(psum_fn)
+    return _time_call(fn, arr)
+
+
+def profile_execution_layers(model, microbatch_size: int, seq_len: int | None = None
+                             ) -> list[dict]:
+    """Time each pipeline layer's forward and backward on the local device.
+
+    Returns the reference's mb{N}.json rows: {forward, backward,
+    mem_required: [param_bytes, activation_bytes]} per layer
+    (cf. reference profile_execution_layers, profiler.py:41-123).
+    """
+    c = model.config
+    if seq_len is None:
+        seq_len = min(c.max_position_embeddings, 1024)
+    rng = jax.random.PRNGKey(0)
+    tokens = model.sample_batch(microbatch_size, seq_len)["input_ids"]
+    results = []
+    carry_shape = (microbatch_size, seq_len, c.hidden_size)
+    block_row: dict | None = None
+    for idx in range(model.num_pipeline_layers):
+        # Transformer blocks are structurally identical: measure the first
+        # one and reuse (the reference times each fx-split layer because its
+        # shards can differ; our layer list is homogeneous by construction).
+        if model.layer_name(idx).startswith("block_") and block_row is not None:
+            results.append(dict(block_row))
+            continue
+        params = model.init_layer(rng, idx)
+        pbytes = param_bytes(params)
+
+        # Uniform layer signature: x is the layer's input (tokens for embed,
+        # activations otherwise) so the repeated-scan timer can chain it.
+        if idx == 0:
+            def fwd(x, p=params):
+                return model.embed(p, x)
+            x0 = tokens
+        else:
+            def fwd(x, p=params, i=idx):
+                return model.apply_layer(i, p, x, None)
+            x0 = jnp.ones(carry_shape, c.dtype)
+
+        fwd_ms = _time_repeated(fwd, x0)
+
+        out_shape = jax.eval_shape(fwd, x0)
+        ct0 = jnp.ones(out_shape.shape, out_shape.dtype)
+
+        if idx == 0:
+            # Embed backward is a scatter-add wrt wte; int tokens provide no
+            # differentiable input to chain on — approximate as 2x forward.
+            bwd_ms = fwd_ms * 2
+        else:
+            # VJP wrt (activations, params) — both cotangent paths, like the
+            # real backward. jax.vjp re-runs the forward inside, so this cost
+            # includes recompute, matching execution under jax.checkpoint.
+            def bwd(ct, x=x0, p=params, i=idx):
+                _, vjp = jax.vjp(
+                    lambda x_, p_: model.apply_layer(i, p_, x_, None), x, p
+                )
+                return vjp(ct)
+
+            bwd_ms = _time_repeated(bwd, ct0)
+
+        act_bytes = math.prod(out_shape.shape) * out_shape.dtype.itemsize
+        row = {
+            "forward": fwd_ms,
+            "backward": bwd_ms,
+            "mem_required": [int(pbytes), int(act_bytes)],
+        }
+        if model.layer_name(idx).startswith("block_"):
+            block_row = row
+        results.append(row)
+    return results
+
+
+def profile_allreduce_in_node(model, chips_per_host: int) -> list[dict]:
+    """Per-layer allreduce time for 1,2,4.. chips within a host (ICI).
+
+    Measured when the chips are actually visible, modeled otherwise
+    (cf. reference profile_allreduce_in_node, profiler.py:187-234).
+    """
+    devices = jax.devices()
+    rng = jax.random.PRNGKey(0)
+    rows = []
+    for idx in range(model.num_pipeline_layers):
+        pbytes = param_bytes(model.init_layer(rng, idx))
+        row = {}
+        n = 1
+        while n <= chips_per_host:
+            if n == 1:
+                row["1"] = 0.0
+            elif len(devices) >= n:
+                row[str(n)] = _measure_allreduce(pbytes, devices[:n])
+            else:
+                row[str(n)] = allreduce_time_model(pbytes, n, cross_host=False)
+            n *= 2
+        rows.append(row)
+    return rows
+
+
+def profile_allreduce_across_nodes(model, max_hosts: int) -> list[dict]:
+    """Per-layer allreduce time across 1..max_hosts hosts (DCN model;
+    cf. reference profiler.py:141-185)."""
+    rng = jax.random.PRNGKey(0)
+    rows = []
+    for idx in range(model.num_pipeline_layers):
+        pbytes = param_bytes(model.init_layer(rng, idx))
+        row = {"1": 0.0}
+        for n in range(2, max_hosts + 1):
+            row[str(n)] = allreduce_time_model(pbytes, n, cross_host=True)
+        rows.append(row)
+    return rows
+
+
+def profile(model_name: str, model_args: dict, *, model_tag: str = "default",
+            microbatch_size: int = 1, seq_len: int | None = None,
+            chips_per_host: int = 4, max_hosts: int = 32,
+            force: bool = False) -> Path:
+    """Run all profiles and write the JSON cache; returns the cache dir.
+
+    File layout matches the reference (profiler.py:290-319) so the planner's
+    loader is schema-compatible.
+    """
+    from oobleck_tpu.models import build_model
+
+    path = get_profile_path(model_name, model_tag)
+    files = [f"mb{microbatch_size}.json", "allreduce_in_node.json",
+             "allreduce_across_nodes.json", "model_args.json"]
+    if all((path / f).exists() for f in files) and not force:
+        # Cache hit requires ALL files: a killed run may have written some.
+        validate_model_args(path, model_args)
+        return path
+    path.mkdir(parents=True, exist_ok=True)
+    model = build_model(model_name, model_args)
+
+    contents = {
+        f"mb{microbatch_size}.json":
+            json.dumps(profile_execution_layers(model, microbatch_size, seq_len)),
+        "allreduce_in_node.json":
+            json.dumps(profile_allreduce_in_node(model, chips_per_host)),
+        "allreduce_across_nodes.json":
+            json.dumps(profile_allreduce_across_nodes(model, max_hosts)),
+        "model_args.json": json.dumps(model_args),
+    }
+    # Atomic publish: write temps, then rename — a crash mid-profile never
+    # leaves a partial cache that later runs mistake for a hit.
+    for fname, text in contents.items():
+        tmp = path / (fname + ".tmp")
+        tmp.write_text(text)
+    for fname in contents:
+        (path / (fname + ".tmp")).rename(path / fname)
+    return path
+
+
+def validate_model_args(path: Path, model_args: dict) -> None:
+    """Cached profile must match the requested model shape
+    (cf. reference validate_model_args, profiler.py:326-340)."""
+    f = path / "model_args.json"
+    if not f.exists():
+        return
+    cached = json.loads(f.read_text())
+    if cached != model_args:
+        raise ValueError(
+            f"cached profile at {path} was made with model_args={cached}, "
+            f"requested {model_args}; use force=True to re-profile"
+        )
+
+
+def load_profile(model_name: str, model_tag: str, microbatch_size: int
+                 ) -> list[LayerProfile]:
+    """Load the JSON cache into LayerProfiles (reference get_profile_results,
+    pipeline_template.cpp:29-80)."""
+    path = get_profile_path(model_name, model_tag)
+    mb = json.loads((path / f"mb{microbatch_size}.json").read_text())
+    ar_in = json.loads((path / "allreduce_in_node.json").read_text())
+    ar_across = json.loads((path / "allreduce_across_nodes.json").read_text())
+    profiles = []
+    for i, row in enumerate(mb):
+        profiles.append(LayerProfile(
+            layer_index=i,
+            forward=row["forward"],
+            backward=row["backward"],
+            allreduce_in_host={int(k): v for k, v in ar_in[i].items()},
+            allreduce_across_hosts={int(k): v for k, v in ar_across[i].items()},
+            mem_params=row["mem_required"][0],
+            mem_activation=row["mem_required"][1],
+        ))
+    return profiles

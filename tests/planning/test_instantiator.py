@@ -1,0 +1,94 @@
+"""Instantiator tests, mirroring the reference's coverage
+(/root/reference/tests/planning/test_instantiator.py:32-138): node-budget
+exhaustion, microbatch conservation, plan selection."""
+
+import pytest
+
+from oobleck_tpu.planning.instantiator import PipelineInstantiator
+from oobleck_tpu.planning.templates import TemplateGenerator
+
+from tests.planning.test_templates import dummy_profiles
+
+
+@pytest.fixture(scope="module")
+def templates():
+    profiles = dummy_profiles(num_layers=8, chips_per_host=1, max_hosts=8)
+    return TemplateGenerator(engine="python").create_pipeline_templates(
+        profiles, (1, 4), 1
+    )
+
+
+@pytest.fixture(scope="module")
+def ar_across():
+    profiles = dummy_profiles(num_layers=8, chips_per_host=1, max_hosts=8)
+    return [p.allreduce_across_hosts for p in profiles]
+
+
+def test_enumeration_exhausts_budget(templates):
+    inst = PipelineInstantiator()
+    options = inst._enumerate_instantiation_options(templates, 7)
+    assert options
+    for combo in options:
+        assert sum(t.num_hosts * n for t, n in combo.items()) == 7
+
+
+def test_enumeration_counts(templates):
+    # partitions of 4 into parts {1,2,3,4}: 1+1+1+1, 1+1+2, 2+2, 1+3, 4 -> 5
+    inst = PipelineInstantiator()
+    options = inst._enumerate_instantiation_options(templates, 4)
+    assert len(options) == 5
+
+
+def test_batch_distribution_conservation(templates):
+    inst = PipelineInstantiator()
+    options = inst._enumerate_instantiation_options(templates, 6)
+    B = 48
+    for combo in options:
+        nb = inst._distribute_batch(B, combo)
+        if nb is None:
+            continue
+        total = sum(nb[t] * x for t, x in combo.items())
+        assert total == B
+        assert all(v >= 1 for v in nb.values())
+
+
+def test_batch_distribution_balances_time(templates):
+    """Slower (fewer-host) pipelines must get fewer microbatches."""
+    inst = PipelineInstantiator()
+    t1 = next(t for t in templates if t.num_hosts == 1)
+    t3 = next(t for t in templates if t.num_hosts == 3)
+    nb = inst._distribute_batch(64, {t1: 1, t3: 1})
+    assert nb is not None
+    assert nb[t1] * t1.iteration_time / t1.num_stages == pytest.approx(
+        nb[t3] * t3.iteration_time / t3.num_stages,
+        rel=0.6,
+    )
+    assert nb[t3] >= nb[t1]
+
+
+def test_best_plan(templates, ar_across):
+    inst = PipelineInstantiator()
+    plan = inst.get_best_execution_plan(templates, ar_across, 4, 32)
+    assert plan.total_num_microbatches == 32
+    assert sum(t.num_hosts * n for t, n in plan.num_instances.items()) == 4
+    # assignments give disjoint contiguous rank blocks covering all chips
+    assignments = plan.assignments()
+    ranks = [r for a in assignments for r in a.ranks]
+    assert ranks == list(range(4))
+
+
+def test_new_plan_for_reconfiguration(templates, ar_across):
+    inst = PipelineInstantiator()
+    t1 = next(t for t in templates if t.num_hosts == 1)
+    t2 = next(t for t in templates if t.num_hosts == 2)
+    plan = inst.get_new_execution_plan({t1: 1, t2: 1}, ar_across, 24)
+    assert plan.total_num_microbatches == 24
+    assert plan.total_num_pipelines == 2
+
+
+def test_pipeline_index_of_rank(templates, ar_across):
+    inst = PipelineInstantiator()
+    plan = inst.get_best_execution_plan(templates, ar_across, 4, 32)
+    for a in plan.assignments():
+        for r in a.ranks:
+            assert plan.pipeline_index_of_rank(r) == a.pipeline_index
