@@ -26,6 +26,8 @@ class SyntheticTextDataset:
 
     def __init__(self, vocab_size: int, seq_length: int, num_samples: int = 8192,
                  seed: int = 42):
+        if vocab_size < 2:
+            raise ValueError("vocab_size must be >= 2 for a learnable stream")
         self.vocab_size = vocab_size
         self.seq_length = seq_length
         self.num_samples = num_samples
@@ -38,11 +40,18 @@ class SyntheticTextDataset:
         if not 0 <= idx < self.num_samples:
             raise IndexError(idx)
         rng = np.random.default_rng(self.seed * 1_000_003 + idx)
-        return {
-            "input_ids": rng.integers(
-                0, self.vocab_size, size=(self.seq_length,), dtype=np.int32
-            )
-        }
+        # Learnable structure (uniform-random tokens would sit at the
+        # irreducible loss log V, making convergence tests meaningless):
+        # each sample is an arithmetic progression mod V whose stride is
+        # inferable from the first two tokens, with 10% uniform noise.
+        start = rng.integers(0, self.vocab_size)
+        stride = rng.integers(1, min(self.vocab_size, 17))
+        ids = (start + stride * np.arange(self.seq_length)) % self.vocab_size
+        noise = rng.random(self.seq_length) < 0.1
+        ids = np.where(
+            noise, rng.integers(0, self.vocab_size, self.seq_length), ids
+        )
+        return {"input_ids": ids.astype(np.int32)}
 
 
 class HFTextDataset:

@@ -1,0 +1,357 @@
+"""MPMD pipeline instance: one heterogeneous pipeline over a chip subset.
+
+Capability match for the reference's OobleckPipeline
+(/root/reference/oobleck/execution/pipeline.py:430-617) re-designed for the
+single-controller JAX runtime:
+
+  * each stage is a contiguous layer range on its own sub-`Mesh` (the chips
+    the template assigned); stage programs are jit-compiled with GSPMD
+    shardings — fsdp parameter sharding within a stage replaces the
+    reference's manual FlatParamHandle hooks (layer.py:96-225), and the
+    microbatch is *split* over the stage's chips (true ZeRO-style DP) rather
+    than redundantly computed as the reference does;
+  * stage-to-stage activations/gradients move with `jax.device_put` between
+    sub-meshes (ICI path on TPU) instead of NCCL p2p with a metadata header
+    (pipeline.py:288-427) — shapes are static, no protocol needed;
+  * the 1F1B instruction streams (execution.schedule) are interpreted by a
+    dependency-driven loop; backward recomputes the stage forward inside the
+    jitted VJP (activation-checkpoint discipline), so only per-microbatch
+    stage *inputs* are stashed, as in 1F1B;
+  * compiled stage executables are cached by stage signature so
+    re-instantiation after a failure reuses them — the pre-compile-per-
+    template idea from SURVEY §7.3.1.
+"""
+
+from __future__ import annotations
+
+import logging
+from collections import deque
+from dataclasses import dataclass, field
+from typing import Any, Callable
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import optax
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+from oobleck_tpu.execution.schedule import Instruction, Op, all_instructions
+from oobleck_tpu.models.gpt import cross_entropy_loss
+from oobleck_tpu.planning.templates import PipelineTemplate
+
+logger = logging.getLogger("oobleck.pipeline")
+
+
+def _map_spec_fsdp(spec: P, use_fsdp: bool) -> P:
+    """Project a model PartitionSpec onto a stage mesh with only an `fsdp`
+    axis: `tensor` entries become replicated (MPMD stages run TP-free in v1),
+    `fsdp` kept when the stage batch allows it."""
+    out = []
+    for entry in spec:
+        names = entry if isinstance(entry, tuple) else (entry,)
+        names = tuple(n for n in names if n == "fsdp" and use_fsdp)
+        out.append(names[0] if len(names) == 1 else (tuple(names) or None))
+    return P(*out)
+
+
+@dataclass
+class StageRuntime:
+    stage_index: int
+    layer_ids: tuple[int, ...]
+    ranks: tuple[int, ...]
+    mesh: Mesh
+    batch_sharding: NamedSharding          # [mb, seq(, emb)] layouts
+    param_shardings: dict[int, Any]        # layer -> sharding tree
+    fwd: Callable | None = None
+    bwd: Callable | None = None
+
+
+class PipelineInstance:
+    """One pipeline: stages over chip subsets, 1F1B interpreter, grads."""
+
+    def __init__(
+        self,
+        pipeline_id: int,
+        template: PipelineTemplate,
+        ranks: list[int],
+        model,
+        devices: list,
+        num_microbatches: int,
+        total_num_microbatches: int,
+        microbatch_size: int,
+        seq_len: int,
+        params: dict[int, Any] | None = None,
+        exec_cache: dict | None = None,
+    ):
+        assert len(ranks) == template.num_chips, (len(ranks), template.num_chips)
+        self.pipeline_id = pipeline_id
+        self.template = template
+        self.ranks = list(ranks)
+        self.model = model
+        self.num_microbatches = num_microbatches
+        self.total_num_microbatches = total_num_microbatches
+        self.microbatch_size = microbatch_size
+        self.seq_len = seq_len
+        self._exec_cache = exec_cache if exec_cache is not None else {}
+
+        self.stages: list[StageRuntime] = []
+        cursor = 0
+        for si, stage in enumerate(template.stages):
+            stage_ranks = tuple(self.ranks[cursor:cursor + stage.num_chips])
+            cursor += stage.num_chips
+            stage_devices = np.array([devices[r] for r in stage_ranks])
+            use_fsdp = (
+                stage.num_chips > 1 and microbatch_size % stage.num_chips == 0
+            )
+            mesh = Mesh(stage_devices.reshape(-1), ("fsdp",))
+            batch_spec = P("fsdp") if use_fsdp else P(None)
+            specs = model.param_specs(stacked=False)
+            param_shardings: dict[int, Any] = {}
+            for li in stage.layer_indices:
+                name = model.layer_name(li)
+                tree = (
+                    specs["embed"] if name == "embed"
+                    else specs["head"] if name == "head"
+                    else specs["blocks"]
+                )
+                param_shardings[li] = jax.tree.map(
+                    lambda s: NamedSharding(mesh, _map_spec_fsdp(s, use_fsdp)),
+                    tree,
+                    is_leaf=lambda x: isinstance(x, P),
+                )
+            self.stages.append(StageRuntime(
+                stage_index=si,
+                layer_ids=tuple(stage.layer_indices),
+                ranks=stage_ranks,
+                mesh=mesh,
+                batch_sharding=NamedSharding(mesh, batch_spec),
+                param_shardings=param_shardings,
+            ))
+
+        # Parameters: dict layer -> pytree placed on the owning stage's mesh.
+        self.params: dict[int, Any] = {}
+        rng = jax.random.PRNGKey(42)  # reference fixes seed 42 (model.py:18)
+        for st in self.stages:
+            for li in st.layer_ids:
+                if params is not None and li in params:
+                    src = params[li]
+                else:
+                    src = self.model.init_layer(rng, li)
+                self.params[li] = jax.device_put(src, st.param_shardings[li])
+
+        self.grads: dict[int, Any] = {}
+        self._build_stage_fns()
+
+    # ------------------------------------------------------------------ #
+
+    @property
+    def num_stages(self) -> int:
+        return len(self.stages)
+
+    def stage_of_layer(self, layer_idx: int) -> int:
+        for st in self.stages:
+            if layer_idx in st.layer_ids:
+                return st.stage_index
+        raise KeyError(layer_idx)
+
+    def owns_layer(self, layer_idx: int) -> bool:
+        return layer_idx in self.params
+
+    # ------------------------------------------------------------------ #
+
+    def _stage_apply(self, st: StageRuntime):
+        model = self.model
+        last_layer = model.num_pipeline_layers - 1
+
+        def apply(params_tuple, x, tokens):
+            carry = x
+            for li, p in zip(st.layer_ids, params_tuple):
+                if li == 0:
+                    carry = model.embed(p, tokens)
+                elif li == last_layer:
+                    logits = model.head(p, carry)
+                    return cross_entropy_loss(
+                        logits, tokens, model.config.vocab_size
+                    )
+                else:
+                    carry = model.apply_block(p, carry)
+            return carry
+
+        return apply
+
+    def _build_stage_fns(self) -> None:
+        """jit each stage's forward and (recomputing) backward, with caching
+        keyed by the stage signature so reconfiguration reuses executables."""
+        S = self.num_stages
+        scale = 1.0 / self.total_num_microbatches
+        for st in self.stages:
+            is_first = st.stage_index == 0
+            is_last = st.stage_index == S - 1
+            key = (
+                st.layer_ids, len(st.ranks), tuple(st.ranks),
+                self.microbatch_size, self.seq_len, is_first, is_last,
+                self.total_num_microbatches,
+            )
+            if key in self._exec_cache:
+                st.fwd, st.bwd = self._exec_cache[key]
+                continue
+            apply = self._stage_apply(st)
+            shardings = tuple(st.param_shardings[li] for li in st.layer_ids)
+
+            def fwd(params_tuple, x, tokens, _apply=apply):
+                return _apply(params_tuple, x, tokens)
+
+            if is_last:
+                # Backward from the loss: d(loss·scale)/d(params, x).
+                def bwd(params_tuple, x, tokens, _apply=apply):
+                    def loss_fn(pt, x_):
+                        return _apply(pt, x_, tokens) * scale
+
+                    if x is None:
+                        grads = jax.grad(lambda pt: loss_fn(pt, None))(params_tuple)
+                        return grads, None
+                    grads, dx = jax.grad(loss_fn, argnums=(0, 1))(params_tuple, x)
+                    return grads, dx
+            else:
+                def bwd(params_tuple, x, tokens, dy, _apply=apply):
+                    if x is None:
+                        # First stage: differentiate wrt params only.
+                        _, vjp = jax.vjp(lambda pt: _apply(pt, None, tokens),
+                                         params_tuple)
+                        (grads,) = vjp(dy)
+                        return grads, None
+                    _, vjp = jax.vjp(lambda pt, x_: _apply(pt, x_, tokens),
+                                     params_tuple, x)
+                    grads, dx = vjp(dy)
+                    return grads, dx
+
+            st.fwd = jax.jit(fwd)
+            st.bwd = jax.jit(bwd)
+            self._exec_cache[key] = (st.fwd, st.bwd)
+
+    # ------------------------------------------------------------------ #
+
+    def train_step(self, batch: np.ndarray):
+        """One iteration over this pipeline's microbatches.
+
+        batch: [num_microbatches, microbatch_size, seq] int32 tokens.
+        Fills self.grads (sum over microbatches, scaled by 1/total global
+        microbatches) and returns the mean loss over this pipeline's
+        microbatches as a device scalar.
+        """
+        assert batch.shape[0] == self.num_microbatches, batch.shape
+        S, M = self.num_stages, self.num_microbatches
+        streams = [deque(s) for s in all_instructions(S, M)]
+        first_st, last_st = self.stages[0], self.stages[-1]
+
+        # Tokens live where they are consumed: stage 0 (embed) + last (loss).
+        tokens_first = [
+            jax.device_put(batch[m], first_st.batch_sharding) for m in range(M)
+        ]
+        tokens_last = (
+            tokens_first if S == 1 else
+            [jax.device_put(batch[m], last_st.batch_sharding) for m in range(M)]
+        )
+
+        acts: dict[tuple[int, int], Any] = {}    # (stage, mb) -> input act
+        gacts: dict[tuple[int, int], Any] = {}   # (stage, mb) -> output grad
+        stash: dict[tuple[int, int], Any] = {}   # forward input stash for bwd
+        losses: list[Any] = []
+        grads: dict[int, Any] = {}
+
+        def params_of(st):
+            return tuple(self.params[li] for li in st.layer_ids)
+
+        def accumulate(st, stage_grads):
+            for li, g in zip(st.layer_ids, stage_grads):
+                if li in grads:
+                    grads[li] = jax.tree.map(jnp.add, grads[li], g)
+                else:
+                    grads[li] = g
+
+        def ready(ins: Instruction) -> bool:
+            if ins.op == Op.RECV_ACTIVATION:
+                return (ins.stage, ins.microbatch) in acts
+            if ins.op == Op.RECV_GRAD:
+                return (ins.stage, ins.microbatch) in gacts
+            return True
+
+        def execute(ins: Instruction) -> None:
+            st = self.stages[ins.stage]
+            m = ins.microbatch
+            key = (ins.stage, m)
+            is_first = ins.stage == 0
+            is_last = ins.stage == S - 1
+            if ins.op in (Op.LOAD_MICROBATCH, Op.RECV_ACTIVATION):
+                pass  # inputs materialize at FORWARD
+            elif ins.op == Op.FORWARD:
+                x = None if is_first else acts[key]
+                tokens = tokens_first[m] if is_first else (
+                    tokens_last[m] if is_last else None
+                )
+                out = st.fwd(params_of(st), x, tokens)
+                stash[key] = x
+                if is_last:
+                    losses.append(out)
+                else:
+                    stash[(ins.stage, m, "out")] = out
+            elif ins.op == Op.SEND_ACTIVATION:
+                nxt = self.stages[ins.stage + 1]
+                y = stash.pop((ins.stage, m, "out"))
+                acts[(ins.stage + 1, m)] = jax.device_put(y, nxt.batch_sharding)
+            elif ins.op == Op.BACKWARD:
+                x = stash.pop(key)
+                tokens = tokens_first[m] if is_first else (
+                    tokens_last[m] if is_last else None
+                )
+                if is_last:
+                    stage_grads, dx = st.bwd(params_of(st), x, tokens)
+                else:
+                    dy = gacts.pop(key)
+                    stage_grads, dx = st.bwd(params_of(st), x, tokens, dy)
+                accumulate(st, stage_grads)
+                if dx is not None:
+                    stash[(ins.stage, m, "dx")] = dx
+                acts.pop(key, None)
+            elif ins.op == Op.SEND_GRAD:
+                prev = self.stages[ins.stage - 1]
+                dx = stash.pop((ins.stage, m, "dx"))
+                gacts[(ins.stage - 1, m)] = jax.device_put(
+                    dx, prev.batch_sharding
+                )
+            elif ins.op == Op.RECV_GRAD:
+                pass
+
+        # Dependency-driven interpretation of the 1F1B streams.
+        progress = True
+        while any(streams):
+            if not progress:
+                pending = [(s[0].op, s[0].stage, s[0].microbatch)
+                           for s in streams if s]
+                raise RuntimeError(f"pipeline schedule deadlock: {pending}")
+            progress = False
+            for q in streams:
+                while q and ready(q[0]):
+                    execute(q.popleft())
+                    progress = True
+
+        self.grads = grads
+        loss = sum(losses[1:], start=losses[0]) / len(losses)
+        return loss
+
+    # ------------------------------------------------------------------ #
+
+    def apply_updates(self, optimizer, opt_state: dict[int, Any],
+                      synced_grads: dict[int, Any]) -> dict[int, Any]:
+        """Per-layer optimizer step with (possibly DP-synced) grads."""
+        new_state = dict(opt_state)
+        for li in self.params:
+            g = synced_grads[li]
+            updates, new_state[li] = optimizer.update(
+                g, opt_state[li], self.params[li]
+            )
+            self.params[li] = optax.apply_updates(self.params[li], updates)
+        return new_state
+
+    def init_opt_state(self, optimizer) -> dict[int, Any]:
+        return {li: optimizer.init(p) for li, p in self.params.items()}

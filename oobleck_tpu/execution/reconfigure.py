@@ -1,0 +1,80 @@
+"""Reconfiguration rank algebra: pure host-set arithmetic.
+
+Capability match for the reference ReconfigurationEngine's core
+(/root/reference/oobleck/execution/engine.py:91-180, 311-360), extracted as
+pure functions (the reference intermixes it with NCCL rebuild; the backend-
+agnostic algebra is what its 22 table-driven tests exercise,
+tests/execution/test_reconfiguration.py):
+
+  (a) strip lost hosts from every pipeline;
+  (b) pipelines still >= min_hosts keep going;
+  (c) undersized pipelines borrow hosts from the biggest pipeline while it
+      can yield without dropping below min_hosts;
+  (d) if nobody can yield, merge undersized pipelines (and fold a final
+      remainder into the smallest surviving pipeline).
+
+Hosts (not chips) are the unit, as in the reference where multiple hosts
+never share a stage (pipeline_template.cpp:205-208); the engine expands a
+host to its chips_per_host chip ranks.
+"""
+
+from __future__ import annotations
+
+
+def reconfigure_hosts(
+    pipelines: list[list[int]],
+    lost_hosts: set[int],
+    min_hosts: int,
+) -> list[list[int]]:
+    """New per-pipeline host lists after losing `lost_hosts`.
+
+    Returns a list of host lists, each of size >= min_hosts (unless the whole
+    cluster is smaller than min_hosts, which raises).
+    """
+    stripped = [[h for h in p if h not in lost_hosts] for p in pipelines]
+    stripped = [p for p in stripped if p]
+    total = sum(len(p) for p in stripped)
+    if total < min_hosts:
+        raise RuntimeError(
+            f"only {total} hosts survive; the smallest template needs {min_hosts}"
+        )
+
+    ok = [p for p in stripped if len(p) >= min_hosts]
+    small = sorted((p for p in stripped if len(p) < min_hosts), key=len)
+
+    # (c) borrow from the biggest while it can spare.
+    still_small: list[list[int]] = []
+    for p in small:
+        while len(p) < min_hosts:
+            donor = max(ok, key=len, default=None)
+            if donor is None or len(donor) <= min_hosts:
+                break
+            p.append(donor.pop())
+        if len(p) >= min_hosts:
+            ok.append(p)
+        else:
+            still_small.append(p)
+
+    # (d) merge the leftovers.
+    if still_small:
+        merged: list[int] = []
+        for p in still_small:
+            merged.extend(p)
+        if len(merged) >= min_hosts:
+            ok.append(merged)
+        elif ok:
+            # Fold the remainder into the smallest surviving pipeline.
+            min(ok, key=len).extend(merged)
+        else:
+            raise RuntimeError(
+                f"cannot form any pipeline of {min_hosts} hosts from {merged}"
+            )
+    return ok
+
+
+def hosts_to_ranks(hosts: list[int], chips_per_host: int) -> list[int]:
+    """Expand host ids to global chip ranks (rank = host*chips + local)."""
+    out = []
+    for h in hosts:
+        out.extend(range(h * chips_per_host, (h + 1) * chips_per_host))
+    return out

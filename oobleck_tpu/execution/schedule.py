@@ -1,0 +1,77 @@
+"""1F1B pipeline instruction schedule.
+
+Capability match for the reference's OobleckPipelineSchedule
+(/root/reference/oobleck/execution/pipeline.py:24-84, a deepspeed
+TrainSchedule subclass): the schedule is an explicit per-stage instruction
+stream with gradient-allreduce and optimizer-step decoupled from it. The
+engine interprets these instructions; on TPU each Forward/Backward dispatches
+a jitted stage program, and send/recv become cross-mesh device transfers.
+
+Stage i of S with M microbatches runs the canonical 1F1B order:
+  warmup  = min(S-1-i, M) forwards,
+  steady  = alternating forward/backward,
+  cooldown = remaining backwards.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass
+from enum import Enum
+
+
+class Op(Enum):
+    LOAD_MICROBATCH = "load_microbatch"
+    RECV_ACTIVATION = "recv_activation"
+    FORWARD = "forward"
+    SEND_ACTIVATION = "send_activation"
+    RECV_GRAD = "recv_grad"
+    BACKWARD = "backward"
+    SEND_GRAD = "send_grad"
+
+
+@dataclass(frozen=True)
+class Instruction:
+    op: Op
+    stage: int
+    microbatch: int
+
+
+def stage_instructions(stage: int, num_stages: int, num_microbatches: int
+                       ) -> list[Instruction]:
+    """The 1F1B instruction stream for one stage."""
+    S, M, i = num_stages, num_microbatches, stage
+    first, last = i == 0, i == S - 1
+    warmup = min(S - 1 - i, M)
+
+    out: list[Instruction] = []
+
+    def fwd(m):
+        if first:
+            out.append(Instruction(Op.LOAD_MICROBATCH, i, m))
+        else:
+            out.append(Instruction(Op.RECV_ACTIVATION, i, m))
+        out.append(Instruction(Op.FORWARD, i, m))
+        if not last:
+            out.append(Instruction(Op.SEND_ACTIVATION, i, m))
+
+    def bwd(m):
+        if not last:
+            out.append(Instruction(Op.RECV_GRAD, i, m))
+        out.append(Instruction(Op.BACKWARD, i, m))
+        if not first:
+            out.append(Instruction(Op.SEND_GRAD, i, m))
+
+    for m in range(warmup):
+        fwd(m)
+    for m in range(warmup, M):
+        fwd(m)
+        bwd(m - warmup)
+    for m in range(M - warmup, M):
+        bwd(m)
+    return out
+
+
+def all_instructions(num_stages: int, num_microbatches: int
+                     ) -> list[list[Instruction]]:
+    return [stage_instructions(i, num_stages, num_microbatches)
+            for i in range(num_stages)]

@@ -149,11 +149,14 @@ class GPTModel:
         return f"block_{index - 1}"
 
     def init_layer(self, rng: jax.Array, index: int):
+        # Same key derivation as init_params so the layer-list and fused
+        # views of one seed produce identical weights.
+        ks = jax.random.split(rng, 3)
         if index == 0:
-            return self._init_embed(rng)
+            return self._init_embed(ks[0])
         if index == self.num_pipeline_layers - 1:
-            return self._init_head(rng)
-        return self._init_block(jax.random.fold_in(rng, index))
+            return self._init_head(ks[2])
+        return self._init_block(jax.random.fold_in(ks[1], index))
 
     def apply_layer(self, index: int, params, carry, batch, ctx: ShardCtx | None = None):
         if index == 0:

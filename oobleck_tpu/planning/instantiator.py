@@ -7,10 +7,13 @@ Capability match for the reference instantiator (paper §4.2;
     of templates whose host counts sum to the cluster size (ref :224-252);
   * `_distribute_batch` — the reference solves a Pyomo MINLP (glpk+ipopt
     subprocesses, ref :254-329) minimizing the variance of per-pipeline
-    iteration time T_i/s_i · nb_i subject to Σ nb_i·x_i = B. Here the same
-    objective is solved exactly with a continuous-relaxation-guided window
-    search (nb_i are small integers) — no solver dependency, deterministic,
-    and ~µs instead of subprocess round-trips (SURVEY §7.3.6);
+    iteration time (T_i/s_i)·nb_i subject to Σ nb_i = B. Here the same
+    objective is solved per *instance* (the reference solves per template,
+    which makes e.g. B=8 over three identical pipelines infeasible since all
+    instances of a template share one nb; per-instance counts are strictly
+    more flexible and the heterogeneous sampler already takes a per-pipeline
+    list) with a relaxation-guided window search + greedy fallback — no
+    solver dependency (SURVEY §7.3.6);
   * `HeterogeneousPlan` — plan selection by estimated iteration time =
     max_i(T_i · nb_i) + first-layer cross-host allreduce overhead
     (ref HeterogeneousPipelinesExecutionPlan.iteration_time, :54-68).
@@ -19,9 +22,9 @@ Capability match for the reference instantiator (paper §4.2;
 from __future__ import annotations
 
 import itertools
-from dataclasses import dataclass, field
+from dataclasses import dataclass
 
-from oobleck_tpu.planning.templates import LayerProfile, PipelineTemplate
+from oobleck_tpu.planning.templates import PipelineTemplate
 
 
 @dataclass(frozen=True)
@@ -36,31 +39,40 @@ class PipelineAssignment:
 
 @dataclass
 class HeterogeneousPlan:
-    """A chosen multiset of templates + per-template microbatch counts."""
+    """A chosen list of pipeline instances + per-instance microbatch counts."""
 
-    num_instances: dict[PipelineTemplate, int]
-    num_microbatches: dict[PipelineTemplate, int]
+    instances: list[PipelineTemplate]       # one entry per pipeline
+    num_microbatches: list[int]             # parallel to `instances`
     allreduce_across_hosts: list[dict[int, float]]
 
+    def __post_init__(self):
+        assert len(self.instances) == len(self.num_microbatches)
+        # Canonical order: by host count, so rank blocks are deterministic.
+        order = sorted(range(len(self.instances)),
+                       key=lambda i: (self.instances[i].num_hosts, i))
+        self.instances = [self.instances[i] for i in order]
+        self.num_microbatches = [self.num_microbatches[i] for i in order]
+
     @property
-    def templates(self) -> list[PipelineTemplate]:
-        return sorted(self.num_instances, key=lambda t: t.num_hosts)
+    def num_instances(self) -> dict[PipelineTemplate, int]:
+        out: dict[PipelineTemplate, int] = {}
+        for t in self.instances:
+            out[t] = out.get(t, 0) + 1
+        return out
 
     @property
     def total_num_pipelines(self) -> int:
-        return sum(self.num_instances.values())
+        return len(self.instances)
 
     @property
     def total_num_microbatches(self) -> int:
-        return sum(
-            self.num_instances[t] * self.num_microbatches[t]
-            for t in self.num_instances
-        )
+        return sum(self.num_microbatches)
 
     @property
     def iteration_time(self) -> float:
         longest = max(
-            t.iteration_time * self.num_microbatches[t] for t in self.num_instances
+            t.iteration_time * nb
+            for t, nb in zip(self.instances, self.num_microbatches)
         )
         # Only the first layer's cross-host grad allreduce is charged; the
         # rest overlaps with backward compute (reference instantiator.py:61-66).
@@ -74,23 +86,17 @@ class HeterogeneousPlan:
         reference instantiate(), instantiator.py:103-152)."""
         out: list[PipelineAssignment] = []
         cursor = 0
-        index = 0
-        for template in self.templates:
-            for _ in range(self.num_instances[template]):
-                n = template.num_chips
-                if ranks is not None:
-                    block = tuple(ranks[index])
-                    assert len(block) == n, (len(block), n)
-                else:
-                    block = tuple(range(cursor, cursor + n))
-                out.append(PipelineAssignment(
-                    pipeline_index=index,
-                    template=template,
-                    ranks=block,
-                    num_microbatches=self.num_microbatches[template],
-                ))
-                cursor += n
-                index += 1
+        for index, (template, nb) in enumerate(
+            zip(self.instances, self.num_microbatches)
+        ):
+            n = template.num_chips
+            if ranks is not None:
+                block = tuple(ranks[index])
+                assert len(block) == n, (len(block), n)
+            else:
+                block = tuple(range(cursor, cursor + n))
+            out.append(PipelineAssignment(index, template, block, nb))
+            cursor += n
         return out
 
     def pipeline_index_of_rank(self, rank: int) -> int:
@@ -101,9 +107,8 @@ class HeterogeneousPlan:
 
     def __repr__(self) -> str:
         parts = [
-            f"{self.num_instances[t]} x {t.num_hosts}-host/{t.num_stages}-stage "
-            f"(nb={self.num_microbatches[t]})"
-            for t in self.templates
+            f"{t.num_hosts}-host/{t.num_stages}-stage(nb={nb})"
+            for t, nb in zip(self.instances, self.num_microbatches)
         ]
         return f"HeterogeneousPlan[{', '.join(parts)}; B={self.total_num_microbatches}]"
 
@@ -121,10 +126,13 @@ class PipelineInstantiator:
         options = self._enumerate_instantiation_options(templates, num_hosts)
         plans: list[HeterogeneousPlan] = []
         for num_instances in options:
-            nb = self._distribute_batch(global_num_microbatch, num_instances)
-            if nb is None:
+            instances = [t for t, n in num_instances.items() for _ in range(n)]
+            nbs = self._distribute_batch(global_num_microbatch, instances)
+            if nbs is None:
                 continue
-            plans.append(HeterogeneousPlan(num_instances, nb, allreduce_across_hosts))
+            plans.append(
+                HeterogeneousPlan(instances, nbs, allreduce_across_hosts)
+            )
         if not plans:
             raise RuntimeError(
                 f"No feasible execution plan for {num_hosts} hosts / "
@@ -140,10 +148,14 @@ class PipelineInstantiator:
     ) -> HeterogeneousPlan:
         """Redistribute the batch for a fixed instance set (reconfiguration
         path, reference :202-222)."""
-        nb = self._distribute_batch(global_num_microbatch, new_num_instances)
-        if nb is None:
-            raise RuntimeError("batch cannot be distributed over the new instances")
-        return HeterogeneousPlan(new_num_instances, nb, allreduce_across_hosts)
+        instances = [t for t, n in new_num_instances.items() for _ in range(n)]
+        nbs = self._distribute_batch(global_num_microbatch, instances)
+        if nbs is None:
+            raise RuntimeError(
+                f"batch of {global_num_microbatch} microbatches cannot cover "
+                f"{len(instances)} pipelines"
+            )
+        return HeterogeneousPlan(instances, nbs, allreduce_across_hosts)
 
     # ------------------------------------------------------------------ #
 
@@ -170,50 +182,76 @@ class PipelineInstantiator:
     def _distribute_batch(
         self,
         global_num_microbatch: int,
-        num_instances: dict[PipelineTemplate, int],
+        instances: list[PipelineTemplate],
         window: int = 3,
-    ) -> dict[PipelineTemplate, int] | None:
-        """min variance of (T_i/s_i)·nb_i  s.t.  Σ nb_i·x_i = B, nb_i ≥ 1.
+    ) -> list[int] | None:
+        """min variance of (T_i/s_i)·nb_i  s.t.  Σ nb_i = B, nb_i ≥ 1.
 
         Continuous relaxation: (T_i/s_i)·nb_i = c ⟹ nb_i = c·s_i/T_i with c
-        from the budget constraint. Search an integer window of ±`window`
-        around the relaxed nb_i for all but the last template; the last
-        template's nb is determined by the constraint. Exact for the small
-        integer ranges involved (reference uses a Pyomo MINLP here).
+        from the budget; search an integer window around the relaxed point
+        for all but the last instance (constraint fixes the last), widening
+        the window until feasible, with a greedy fill as the backstop.
         """
-        templates = list(num_instances.keys())
-        k = len(templates)
+        k = len(instances)
         B = global_num_microbatch
-        x = [num_instances[t] for t in templates]
-        w = [t.iteration_time / t.num_stages for t in templates]
+        w = [t.iteration_time / t.num_stages for t in instances]
 
-        if sum(x) > B:
+        if k > B:
             return None  # cannot give every pipeline ≥1 microbatch
         if k == 1:
-            if B % x[0] != 0:
+            return [B]
+
+        c = B / sum(1.0 / wi for wi in w)
+        relaxed = [max(1.0, c / wi) for wi in w]
+
+        def search(win: int) -> tuple[float, list[int]] | None:
+            best = None
+            ranges = []
+            for i in range(k - 1):
+                lo = max(1, int(relaxed[i]) - win)
+                hi = min(B - (k - 1), int(relaxed[i]) + win)
+                if hi < lo:
+                    return None
+                ranges.append(range(lo, hi + 1))
+            size = 1
+            for r in ranges:
+                size *= len(r)
+            if size > 2_000_000:
                 return None
-            return {templates[0]: B // x[0]}
+            for combo in itertools.product(*ranges):
+                rem = B - sum(combo)
+                if rem < 1:
+                    continue
+                nbs = list(combo) + [rem]
+                times = [w[i] * nbs[i] for i in range(k)]
+                mean = sum(times) / k
+                var = sum((t - mean) ** 2 for t in times)
+                if best is None or var < best[0]:
+                    best = (var, nbs)
+            return best
 
-        c = B / sum(x[i] / w[i] for i in range(k))
-        relaxed = [max(1.0, c / w[i]) for i in range(k)]
-
-        best: tuple[float, list[int]] | None = None
-        ranges = [
-            range(max(1, int(relaxed[i]) - window), int(relaxed[i]) + window + 1)
-            for i in range(k - 1)
-        ]
-        for combo in itertools.product(*ranges):
-            used = sum(nb * xi for nb, xi in zip(combo, x[:-1]))
-            rem = B - used
-            if rem <= 0 or rem % x[-1] != 0:
-                continue
-            nb_last = rem // x[-1]
-            nbs = list(combo) + [nb_last]
-            times = [w[i] * nbs[i] for i in range(k)]
-            mean = sum(times) / k
-            var = sum((t - mean) ** 2 for t in times)
-            if best is None or var < best[0]:
-                best = (var, nbs)
+        best = None
+        for win in (window, 4 * window, 16 * window, B):
+            best = search(win)
+            if best is not None:
+                break
+        if best is None:
+            best = self._greedy_fill(B, w)
         if best is None:
             return None
-        return {t: nb for t, nb in zip(templates, best[1])}
+        return best[1]
+
+    @staticmethod
+    def _greedy_fill(B: int, w: list[float]) -> tuple[float, list[int]] | None:
+        """Every pipeline gets 1; each further unit goes to the pipeline whose
+        resulting time stays smallest (LPT-style)."""
+        k = len(w)
+        if k > B:
+            return None
+        nbs = [1] * k
+        for _ in range(B - k):
+            i = min(range(k), key=lambda j: w[j] * (nbs[j] + 1))
+            nbs[i] += 1
+        times = [w[i] * nbs[i] for i in range(k)]
+        mean = sum(times) / k
+        return (sum((t - mean) ** 2 for t in times), nbs)

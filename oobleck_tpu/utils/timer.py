@@ -1,0 +1,62 @@
+"""Step timing.
+
+Capability match for the reference's measure_time decorator around
+deepspeed's SynchronizedWallClockTimer (/root/reference/oobleck/utils/
+timer.py:8-21): wall-clock accumulation per named region, reported by the
+engine every 10 steps. No deepspeed here — a plain monotonic-clock
+accumulator; device-side sync is the caller's readback (see
+profiler._sync / SKILL.md note on the axon relay).
+"""
+
+from __future__ import annotations
+
+import functools
+import time
+from collections import defaultdict
+from dataclasses import dataclass, field
+
+
+@dataclass
+class TimerStats:
+    count: int = 0
+    total_s: float = 0.0
+    last_s: float = 0.0
+
+    @property
+    def mean_s(self) -> float:
+        return self.total_s / self.count if self.count else 0.0
+
+    def __repr__(self) -> str:
+        return (f"TimerStats(n={self.count}, last={self.last_s*1e3:.1f}ms, "
+                f"mean={self.mean_s*1e3:.1f}ms)")
+
+
+_timers: dict[str, TimerStats] = defaultdict(TimerStats)
+
+
+def measure_time(name: str):
+    """Decorator: accumulate wall time of each call under `name`."""
+
+    def deco(fn):
+        @functools.wraps(fn)
+        def wrapper(*args, **kwargs):
+            t0 = time.perf_counter()
+            try:
+                return fn(*args, **kwargs)
+            finally:
+                dt = time.perf_counter() - t0
+                st = _timers[name]
+                st.count += 1
+                st.total_s += dt
+                st.last_s = dt
+        return wrapper
+
+    return deco
+
+
+def sync_timers() -> dict[str, TimerStats]:
+    return dict(_timers)
+
+
+def reset_timers() -> None:
+    _timers.clear()

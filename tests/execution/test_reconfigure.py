@@ -1,0 +1,71 @@
+"""Table-driven rank-algebra tests, mirroring the reference's pure-logic
+reconfiguration scenarios (/root/reference/tests/execution/
+test_reconfiguration.py:151-447 — exact expected rank lists over multi-
+pipeline clusters)."""
+
+import pytest
+
+from oobleck_tpu.execution.reconfigure import hosts_to_ranks, reconfigure_hosts
+
+
+def flat(pipelines):
+    return sorted(h for p in pipelines for h in p)
+
+
+# Scenarios: (pipelines, lost, min_hosts, expected-ish)
+def test_simple_strip():
+    # 2 pipelines of 3 hosts; lose one host of pipeline 1; min=2.
+    out = reconfigure_hosts([[0, 1, 2], [3, 4, 5]], {4}, 2)
+    assert sorted(map(sorted, out)) == [[0, 1, 2], [3, 5]]
+
+
+def test_borrow_from_biggest():
+    # lose 2 hosts of pipeline 1 -> it drops below min=2 and borrows from
+    # pipeline 0 (4 hosts, can spare one).
+    out = reconfigure_hosts([[0, 1, 2, 3], [4, 5, 6]], {5, 6}, 2)
+    out = sorted(map(sorted, out))
+    assert flat(out) == [0, 1, 2, 3, 4]
+    sizes = sorted(len(p) for p in out)
+    assert sizes == [2, 3]
+    assert any(4 in p and len(p) == 2 for p in out)  # borrowed a host
+
+
+def test_merge_when_no_donor():
+    # two pipelines at exactly min size each lose a host -> nobody can
+    # donate -> the two undersized pipelines merge.
+    out = reconfigure_hosts([[0, 1], [2, 3]], {1, 3}, 2)
+    assert sorted(map(sorted, out)) == [[0, 2]]
+
+
+def test_fold_remainder_into_smallest():
+    # one pipeline dies almost completely; remainder can't reach min and
+    # no donor can spare -> folded into the surviving pipeline.
+    out = reconfigure_hosts([[0, 1], [2, 3]], {3}, 2)
+    assert sorted(map(sorted, out)) == [[0, 1, 2]]
+
+
+def test_whole_pipeline_lost():
+    out = reconfigure_hosts([[0, 1, 2], [3, 4]], {3, 4}, 2)
+    assert sorted(map(sorted, out)) == [[0, 1, 2]]
+
+
+def test_cluster_too_small_raises():
+    with pytest.raises(RuntimeError, match="survive"):
+        reconfigure_hosts([[0, 1]], {0}, 2)
+
+
+def test_14_host_4_pipeline_scenarios():
+    """Larger cluster sweep in the spirit of the reference's 4-pipeline
+    14-node matrix: every outcome keeps all pipelines >= min and exactly
+    partitions the survivors."""
+    pipelines = [[0, 1, 2, 3], [4, 5, 6, 7], [8, 9, 10], [11, 12, 13]]
+    for lost in [{0}, {3, 7}, {8, 9}, {11, 12, 13}, {0, 4, 8, 11},
+                 {1, 2, 3}, {4, 5, 6, 7}, {9, 10, 12, 13}, {0, 1, 2, 3, 4, 5}]:
+        out = reconfigure_hosts([list(p) for p in pipelines], lost, 3)
+        survivors = sorted(h for p in pipelines for h in p if h not in lost)
+        assert flat(out) == survivors, (lost, out)
+        assert all(len(p) >= 3 for p in out), (lost, out)
+
+
+def test_hosts_to_ranks():
+    assert hosts_to_ranks([1, 3], 4) == [4, 5, 6, 7, 12, 13, 14, 15]

@@ -44,12 +44,12 @@ def test_batch_distribution_conservation(templates):
     options = inst._enumerate_instantiation_options(templates, 6)
     B = 48
     for combo in options:
-        nb = inst._distribute_batch(B, combo)
-        if nb is None:
+        instances = [t for t, n in combo.items() for _ in range(n)]
+        nbs = inst._distribute_batch(B, instances)
+        if nbs is None:
             continue
-        total = sum(nb[t] * x for t, x in combo.items())
-        assert total == B
-        assert all(v >= 1 for v in nb.values())
+        assert sum(nbs) == B
+        assert all(v >= 1 for v in nbs)
 
 
 def test_batch_distribution_balances_time(templates):
@@ -57,13 +57,13 @@ def test_batch_distribution_balances_time(templates):
     inst = PipelineInstantiator()
     t1 = next(t for t in templates if t.num_hosts == 1)
     t3 = next(t for t in templates if t.num_hosts == 3)
-    nb = inst._distribute_batch(64, {t1: 1, t3: 1})
-    assert nb is not None
-    assert nb[t1] * t1.iteration_time / t1.num_stages == pytest.approx(
-        nb[t3] * t3.iteration_time / t3.num_stages,
+    nbs = inst._distribute_batch(64, [t1, t3])
+    assert nbs is not None
+    assert nbs[0] * t1.iteration_time / t1.num_stages == pytest.approx(
+        nbs[1] * t3.iteration_time / t3.num_stages,
         rel=0.6,
     )
-    assert nb[t3] >= nb[t1]
+    assert nbs[1] >= nbs[0]
 
 
 def test_best_plan(templates, ar_across):
