@@ -218,8 +218,23 @@ class OobleckEngine:
             self.templates, ar_across, len(self.host_ips), global_num_microbatch
         )
         logger.info("execution plan: %s", self.plan)
+        old_params = old_opt = None
+        restored = self.try_restore_checkpoint()
+        if restored is not None:
+            old_params = restored["params"]
+            # Optimizer leaves were stored flat; rebuild the optax structure.
+            old_opt = {}
+            for li, leaves in restored["opt"].items():
+                struct = jax.tree.structure(
+                    jax.eval_shape(self.optimizer.init, old_params[li])
+                )
+                old_opt[li] = jax.tree.unflatten(struct, leaves)
+            meta = restored["meta"]
+            self.step = int(meta["step"])
+            num_iterations_done = int(meta["num_iterations_done"])
+            epoch = int(meta["epoch"])
         self._materialize_plan(self.plan, num_iterations_done, epoch,
-                               old_params=None, old_opt=None)
+                               old_params=old_params, old_opt=old_opt)
 
     def _materialize_plan(self, plan: HeterogeneousPlan, num_iterations_done,
                           epoch, old_params, old_opt,
@@ -297,8 +312,10 @@ class OobleckEngine:
         return loss
 
     def train(self) -> None:
-        """Reference train loop (engine.py:651-668) + loss reporting."""
+        """Reference train loop (engine.py:651-668) + loss reporting and
+        periodic checkpointing (capability the reference lacks)."""
         max_steps = self.args.job.steps
+        interval = self.args.execution.checkpoint_interval
         while self.step < max_steps:
             self._maybe_reconfigure()
             loss = self._train_step()
@@ -306,6 +323,50 @@ class OobleckEngine:
             if self.step % 10 == 0:
                 timers = sync_timers()
                 logger.info("step timer: %s", timers.get("step"))
+            if interval and self.step % interval == 0:
+                self.save_checkpoint()
+        if interval and self.step % interval != 0:
+            self.save_checkpoint()
+
+    # ------------------------------------------------------------------ #
+
+    def _collect_layer_state(self):
+        params: dict[int, Any] = {}
+        opt: dict[int, Any] = {}
+        for pipe in self.pipelines:
+            for li, p in pipe.params.items():
+                params.setdefault(li, p)
+                opt.setdefault(li, self.opt_states[pipe.pipeline_id][li])
+        return params, opt
+
+    def save_checkpoint(self) -> None:
+        from oobleck_tpu.execution.checkpoint import save_checkpoint
+
+        ckpt_dir = self.args.execution.checkpoint_dir
+        if not ckpt_dir:
+            return
+        params, opt = self._collect_layer_state()
+        save_checkpoint(
+            ckpt_dir, step=self.step, params=params, opt_state=opt,
+            num_iterations_done=self.dataloaders[0].num_iterations_done,
+            epoch=self.dataloaders[0].epoch,
+            extra={"model_name": self.args.model.model_name},
+        )
+
+    def try_restore_checkpoint(self) -> dict | None:
+        """Load the newest checkpoint from execution.checkpoint_dir, if any.
+        Returns the payload for instantiate_pipelines-time consumption."""
+        from oobleck_tpu.execution.checkpoint import latest_checkpoint, load_checkpoint
+
+        ckpt_dir = self.args.execution.checkpoint_dir
+        if not ckpt_dir:
+            return None
+        target = latest_checkpoint(ckpt_dir)
+        if target is None:
+            return None
+        payload = load_checkpoint(target)
+        logger.info("restoring from %s (step %s)", target, payload["meta"]["step"])
+        return payload
 
     # ------------------------------------------------------------------ #
 
@@ -369,12 +430,7 @@ class OobleckEngine:
         # Surviving weights + optimizer state by layer (reference
         # _copy_model_states, engine.py:238-309: broadcast from an owner —
         # single-controller, a device_put from any survivor).
-        old_params: dict[int, Any] = {}
-        old_opt: dict[int, Any] = {}
-        for pipe in self.pipelines:
-            for li, p in pipe.params.items():
-                old_params.setdefault(li, p)
-                old_opt.setdefault(li, self.opt_states[pipe.pipeline_id][li])
+        old_params, old_opt = self._collect_layer_state()
 
         # Data position carries over (reference engine.py:203-214).
         it_done = self.dataloaders[0].num_iterations_done

@@ -48,12 +48,16 @@ class ShardCtx:
 
     tensor: str | None = None
     fsdp: str | None = None
+    seq: str | None = None   # sequence parallelism: ring attention + offsets
 
     def tp_size(self) -> int:
         return lax.axis_size(self.tensor) if self.tensor else 1
 
     def tp_rank(self):
         return lax.axis_index(self.tensor) if self.tensor else 0
+
+    def seq_rank(self):
+        return lax.axis_index(self.seq) if self.seq else 0
 
 
 @dataclass(frozen=True)
@@ -246,7 +250,12 @@ class GPTModel:
             x = vocab_parallel_embed(p["wte"], tokens, offset, ctx.tensor)
         else:
             x = p["wte"][tokens]
-        x = x + p["wpe"][:seq]
+        if ctx and ctx.seq:
+            # Sequence-parallel: this shard holds positions [r*seq, (r+1)*seq).
+            pos0 = ctx.seq_rank() * seq
+            x = x + lax.dynamic_slice_in_dim(p["wpe"], pos0, seq, axis=0)
+        else:
+            x = x + p["wpe"][:seq]
         return x.astype(c.dtype)
 
     def apply_block(self, p, x: jax.Array, ctx: ShardCtx | None = None) -> jax.Array:
@@ -261,7 +270,12 @@ class GPTModel:
         wqkv = _maybe_unshard(p["attn"]["wqkv"], f_, 0).astype(dt)     # [E,3,Hl,D]
         bqkv = p["attn"]["bqkv"].astype(dt)                             # [3,Hl,D]
         qkv = jnp.einsum("bse,ethd->tbhsd", h, wqkv) + bqkv[:, None, :, None, :]
-        attn_out = causal_attention(qkv[0], qkv[1], qkv[2], impl=c.attention_impl)
+        if ctx and ctx.seq:
+            from oobleck_tpu.ops.ring_attention import ring_attention
+
+            attn_out = ring_attention(qkv[0], qkv[1], qkv[2], axis_name=ctx.seq)
+        else:
+            attn_out = causal_attention(qkv[0], qkv[1], qkv[2], impl=c.attention_impl)
         wo = _maybe_unshard(p["attn"]["wo"], f_, 2).astype(dt)          # [Hl,D,E]
         out = jnp.einsum("bhsd,hde->bse", attn_out, wo)
         out = _maybe_reduce_from_tp(out, t) + p["attn"]["bo"].astype(dt)
@@ -287,22 +301,25 @@ class GPTModel:
         mask = jnp.arange(logits.shape[-1]) < c.vocab_size
         return jnp.where(mask, logits, NEG_INF)
 
-    def head_loss(self, p, x: jax.Array, targets: jax.Array,
-                  ctx: ShardCtx | None = None) -> jax.Array:
-        """Mean next-token loss from final activations, vocab-parallel-safe."""
+    def head_loss_shifted(self, p, x: jax.Array, targets: jax.Array,
+                          mask: jax.Array, ctx: ShardCtx | None = None) -> jax.Array:
+        """SUM of masked per-position losses with *pre-shifted* targets
+        (targets[t] = token[t+1], mask 0 on invalid positions).
+
+        Used by the sequence-parallel fused path: the next-token shift
+        crosses shard boundaries when the sequence dim is sharded, so the
+        caller shifts globally before sharding instead."""
         c = self.config
         x = _layer_norm(x, p["ln_f"]["scale"], p["ln_f"]["bias"], c.layer_norm_epsilon)
         local_logits = (x @ p["w"].astype(c.dtype)).astype(jnp.float32)
         vlocal = local_logits.shape[-1]
         offset = (ctx.tp_rank() * vlocal) if (ctx and ctx.tensor) else 0
-        # Mask vocab-padding columns so they don't contribute to sumexp.
         col_ids = jnp.arange(vlocal) + offset
         local_logits = jnp.where(col_ids < c.vocab_size, local_logits, NEG_INF)
         per_pos = vocab_parallel_logits_loss(
-            local_logits[..., :-1, :], targets[..., 1:], offset,
-            ctx.tensor if ctx else None,
+            local_logits, targets, offset, ctx.tensor if ctx else None
         )
-        return jnp.mean(per_pos)
+        return jnp.sum(per_pos * mask)
 
     def forward(self, params, tokens: jax.Array) -> jax.Array:
         """Fused single-program forward over stacked blocks (ctx-free)."""

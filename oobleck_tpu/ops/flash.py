@@ -1,0 +1,148 @@
+"""Blockwise (flash) causal attention — Pallas TPU kernel.
+
+The reference has no custom kernels (all GPU compute goes through torch
+modules); on TPU the attention inner loop is the one op worth hand-writing:
+the naive path materializes the [S, S] score matrix in HBM, while this kernel
+streams K/V blocks through VMEM with the online-softmax recurrence, keeping
+HBM traffic linear in S.
+
+Layout: grid (batch*heads, q_blocks, kv_blocks); the kv dimension is the
+innermost sequential grid axis, so the f32 VMEM scratch (acc, m, l) carries
+across kv steps and is finalized on the last one. Head dim is padded to the
+128-lane width and sequence to the block size outside the kernel.
+
+Backward: the VJP recomputes attention through the XLA path (exact same math)
+— a dedicated backward kernel is a later optimization; under jax.checkpoint
+the backward dominates memory anyway and stays O(S·D) resident either way.
+"""
+
+from __future__ import annotations
+
+import functools
+
+import jax
+import jax.numpy as jnp
+from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu
+
+NEG_INF = -1e9
+
+BLOCK_Q = 128
+BLOCK_K = 128
+LANE = 128
+
+
+def _flash_kernel(q_ref, k_ref, v_ref, o_ref, acc_ref, m_ref, l_ref,
+                  *, scale: float, blocks_k: int):
+    qi = pl.program_id(1)
+    ki = pl.program_id(2)
+
+    @pl.when(ki == 0)
+    def _():
+        acc_ref[:] = jnp.zeros_like(acc_ref)
+        m_ref[:] = jnp.full_like(m_ref, NEG_INF)
+        l_ref[:] = jnp.zeros_like(l_ref)
+
+    q = q_ref[0].astype(jnp.float32)          # [Bq, D]
+    k = k_ref[0].astype(jnp.float32)          # [Bk, D]
+    v = v_ref[0].astype(jnp.float32)          # [Bk, D]
+
+    s = jax.lax.dot_general(
+        q, k, (((1,), (1,)), ((), ())), preferred_element_type=jnp.float32
+    ) * scale                                  # [Bq, Bk]
+
+    # causal mask on global positions
+    q_pos = qi * BLOCK_Q + jax.lax.broadcasted_iota(jnp.int32, s.shape, 0)
+    k_pos = ki * BLOCK_K + jax.lax.broadcasted_iota(jnp.int32, s.shape, 1)
+    s = jnp.where(q_pos >= k_pos, s, NEG_INF)
+
+    m_prev = m_ref[:, :1]                      # [Bq, 1]
+    m_cur = jnp.max(s, axis=-1, keepdims=True)
+    m_new = jnp.maximum(m_prev, m_cur)
+    p = jnp.exp(s - m_new)                     # [Bq, Bk]
+    correction = jnp.exp(m_prev - m_new)       # [Bq, 1]
+
+    l_new = l_ref[:, :1] * correction + jnp.sum(p, axis=-1, keepdims=True)
+    acc_ref[:] = acc_ref[:] * correction + jax.lax.dot_general(
+        p, v, (((1,), (0,)), ((), ())), preferred_element_type=jnp.float32
+    )
+    m_ref[:] = jnp.broadcast_to(m_new, m_ref.shape)
+    l_ref[:] = jnp.broadcast_to(l_new, l_ref.shape)
+
+    @pl.when(ki == blocks_k - 1)
+    def _():
+        # Padded-out rows can have l == 0; guard the divide.
+        l = jnp.maximum(l_ref[:, :1], 1e-30)
+        o_ref[0] = (acc_ref[:] / l).astype(o_ref.dtype)
+
+
+def _flash_forward(q: jax.Array, k: jax.Array, v: jax.Array, scale: float
+                   ) -> jax.Array:
+    b, h, s_len, d = q.shape
+    # Pad head dim to the lane width and seq to the block size; zero padding
+    # is exact (padded dims contribute nothing to scores / outputs).
+    d_pad = (LANE - d % LANE) % LANE
+    s_pad = (BLOCK_Q - s_len % BLOCK_Q) % BLOCK_Q
+    if d_pad or s_pad:
+        pad = ((0, 0), (0, 0), (0, s_pad), (0, d_pad))
+        q, k, v = (jnp.pad(x, pad) for x in (q, k, v))
+    bh = b * h
+    sp, dp = q.shape[2], q.shape[3]
+    q, k, v = (x.reshape(bh, sp, dp) for x in (q, k, v))
+    blocks_q = sp // BLOCK_Q
+    blocks_k = sp // BLOCK_K
+
+    kernel = functools.partial(_flash_kernel, scale=scale, blocks_k=blocks_k)
+    # Interpreter mode off-TPU: tests validate kernel math on the CPU mesh.
+    interpret = jax.default_backend() != "tpu"
+    out = pl.pallas_call(
+        kernel,
+        out_shape=jax.ShapeDtypeStruct((bh, sp, dp), q.dtype),
+        grid=(bh, blocks_q, blocks_k),
+        in_specs=[
+            pl.BlockSpec((1, BLOCK_Q, dp), lambda b_, qi, ki: (b_, qi, 0)),
+            pl.BlockSpec((1, BLOCK_K, dp), lambda b_, qi, ki: (b_, ki, 0)),
+            pl.BlockSpec((1, BLOCK_K, dp), lambda b_, qi, ki: (b_, ki, 0)),
+        ],
+        out_specs=pl.BlockSpec((1, BLOCK_Q, dp), lambda b_, qi, ki: (b_, qi, 0)),
+        scratch_shapes=[
+            pltpu.VMEM((BLOCK_Q, dp), jnp.float32),
+            pltpu.VMEM((BLOCK_Q, LANE), jnp.float32),
+            pltpu.VMEM((BLOCK_Q, LANE), jnp.float32),
+        ],
+        interpret=interpret,
+    )(q, k, v)
+
+    out = out.reshape(b, h, sp, dp)
+    return out[:, :, :s_len, :d]
+
+
+@functools.partial(jax.custom_vjp, nondiff_argnums=(3,))
+def _flash(q, k, v, scale):
+    return _flash_forward(q, k, v, scale)
+
+
+def _flash_fwd(q, k, v, scale):
+    return _flash_forward(q, k, v, scale), (q, k, v)
+
+
+def _flash_bwd(scale, res, g):
+    from oobleck_tpu.ops.attention import _xla_causal_attention
+
+    q, k, v = res
+    _, vjp = jax.vjp(
+        lambda q_, k_, v_: _xla_causal_attention(q_, k_, v_, scale=scale),
+        q, k, v,
+    )
+    return vjp(g)
+
+
+_flash.defvjp(_flash_fwd, _flash_bwd)
+
+
+def flash_attention(q: jax.Array, k: jax.Array, v: jax.Array, *,
+                    scale: float | None = None) -> jax.Array:
+    """Causal flash attention. [B, H, S, D] -> [B, H, S, D]."""
+    if scale is None:
+        scale = q.shape[-1] ** -0.5
+    return _flash(q, k, v, scale)

@@ -23,9 +23,10 @@ from jax.sharding import Mesh
 AXIS_DATA = "data"
 AXIS_STAGE = "stage"
 AXIS_FSDP = "fsdp"
+AXIS_SEQ = "seq"
 AXIS_TENSOR = "tensor"
 
-ALL_AXES = (AXIS_DATA, AXIS_STAGE, AXIS_FSDP, AXIS_TENSOR)
+ALL_AXES = (AXIS_DATA, AXIS_STAGE, AXIS_FSDP, AXIS_SEQ, AXIS_TENSOR)
 
 
 @dataclass(frozen=True)
@@ -33,11 +34,12 @@ class MeshShape:
     data: int = 1
     stage: int = 1
     fsdp: int = 1
+    seq: int = 1      # sequence/context parallelism (ring attention)
     tensor: int = 1
 
     @property
     def num_devices(self) -> int:
-        return self.data * self.stage * self.fsdp * self.tensor
+        return self.data * self.stage * self.fsdp * self.seq * self.tensor
 
     @classmethod
     def infer(
@@ -47,24 +49,26 @@ class MeshShape:
         stage: int = 1,
         tensor: int = 1,
         fsdp: int = 1,
+        seq: int = 1,
         data: int = -1,
     ) -> "MeshShape":
         """Fill in data=-1 from the device count."""
-        denom = stage * tensor * fsdp
+        denom = stage * tensor * fsdp * seq
         if data == -1:
             if num_devices % denom != 0:
                 raise ValueError(
-                    f"{num_devices} devices not divisible by stage*tensor*fsdp={denom}"
+                    f"{num_devices} devices not divisible by "
+                    f"stage*tensor*fsdp*seq={denom}"
                 )
             data = num_devices // denom
-        shape = cls(data=data, stage=stage, fsdp=fsdp, tensor=tensor)
+        shape = cls(data=data, stage=stage, fsdp=fsdp, seq=seq, tensor=tensor)
         if shape.num_devices != num_devices:
             raise ValueError(f"{shape} does not cover {num_devices} devices")
         return shape
 
 
 def make_mesh(shape: MeshShape, devices: list | None = None) -> Mesh:
-    """Build a Mesh with axes (data, stage, fsdp, tensor) over `devices`.
+    """Build a Mesh with axes (data, stage, fsdp, seq, tensor) over `devices`.
 
     `devices` defaults to all local devices; pipelines over device *subsets*
     (heterogeneous instances) pass their own slice.
@@ -74,6 +78,6 @@ def make_mesh(shape: MeshShape, devices: list | None = None) -> Mesh:
     if len(devices) < shape.num_devices:
         raise ValueError(f"need {shape.num_devices} devices, have {len(devices)}")
     grid = np.array(devices[: shape.num_devices]).reshape(
-        shape.data, shape.stage, shape.fsdp, shape.tensor
+        shape.data, shape.stage, shape.fsdp, shape.seq, shape.tensor
     )
     return Mesh(grid, ALL_AXES)

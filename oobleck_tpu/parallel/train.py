@@ -43,6 +43,7 @@ from oobleck_tpu.parallel.mesh import (
     ALL_AXES,
     AXIS_DATA,
     AXIS_FSDP,
+    AXIS_SEQ,
     AXIS_STAGE,
     AXIS_TENSOR,
 )
@@ -120,6 +121,7 @@ def build_train_step(model, mesh, *, num_microbatches: int, optimizer=None,
         remat = model.config.remat
     S = mesh.shape[AXIS_STAGE]
     tp = mesh.shape[AXIS_TENSOR]
+    sp = mesh.shape[AXIS_SEQ]
     num_mb = num_microbatches
     if model.config.num_layers % S != 0:
         raise ValueError(
@@ -134,15 +136,17 @@ def build_train_step(model, mesh, *, num_microbatches: int, optimizer=None,
             f"num_microbatches={num_mb} not divisible by stage={S}: the embed "
             "and head phases shard microbatches over the stage axis"
         )
-    ctx = ShardCtx(tensor=AXIS_TENSOR, fsdp=AXIS_FSDP)
+    ctx = ShardCtx(tensor=AXIS_TENSOR, fsdp=AXIS_FSDP,
+                   seq=AXIS_SEQ if sp > 1 else None)
     specs = model.param_specs(stacked=True)
     batch_shards = mesh.shape[AXIS_DATA] * mesh.shape[AXIS_FSDP]
 
     # Batch layouts: microbatch index over `stage` (phases A/C) or replicated
-    # (phase B input); sample dim over (data, fsdp) everywhere.
-    tok_stage = P(AXIS_STAGE, (AXIS_DATA, AXIS_FSDP), None)
-    x_stage = P(AXIS_STAGE, (AXIS_DATA, AXIS_FSDP), None, None)
-    x_repl = P(None, (AXIS_DATA, AXIS_FSDP), None, None)
+    # (phase B input); sample dim over (data, fsdp) and sequence dim over
+    # `seq` (ring attention) everywhere.
+    tok_stage = P(AXIS_STAGE, (AXIS_DATA, AXIS_FSDP), AXIS_SEQ)
+    x_stage = P(AXIS_STAGE, (AXIS_DATA, AXIS_FSDP), AXIS_SEQ, None)
+    x_repl = P(None, (AXIS_DATA, AXIS_FSDP), AXIS_SEQ, None)
 
     def embed_fn(embed_params, tokens_loc):
         return model.embed(embed_params, tokens_loc, ctx)
@@ -187,11 +191,13 @@ def build_train_step(model, mesh, *, num_microbatches: int, optimizer=None,
         )
         return outputs[None]
 
-    def head_fn(head_params, ys_loc, tokens_loc):
-        loss_local = model.head_loss(head_params, ys_loc, tokens_loc, ctx)
-        # Local mean over an equal slice everywhere -> global mean by psum.
-        loss = lax.psum(loss_local, (AXIS_STAGE, AXIS_DATA, AXIS_FSDP))
-        return loss / (S * batch_shards)
+    def head_fn(head_params, ys_loc, targets_loc, mask_loc):
+        # Pre-shifted targets: the next-token shift crosses seq-shard
+        # boundaries, so the caller shifts globally (see wrapped_step).
+        loss_sum = model.head_loss_shifted(
+            head_params, ys_loc, targets_loc, mask_loc, ctx
+        )
+        return lax.psum(loss_sum, (AXIS_STAGE, AXIS_DATA, AXIS_FSDP, AXIS_SEQ))
 
     embed_sm = jax.shard_map(
         embed_fn, mesh=mesh, in_specs=(specs["embed"], tok_stage),
@@ -199,20 +205,33 @@ def build_train_step(model, mesh, *, num_microbatches: int, optimizer=None,
     )
     pipe_sm = jax.shard_map(
         pipeline_fn, mesh=mesh, in_specs=(specs["blocks"], x_repl),
-        out_specs=P(AXIS_STAGE, None, (AXIS_DATA, AXIS_FSDP), None, None),
+        out_specs=P(AXIS_STAGE, None, (AXIS_DATA, AXIS_FSDP), AXIS_SEQ, None),
         axis_names=set(ALL_AXES),
     )
     head_sm = jax.shard_map(
-        head_fn, mesh=mesh, in_specs=(specs["head"], x_stage, tok_stage),
+        head_fn, mesh=mesh,
+        in_specs=(specs["head"], x_stage, tok_stage, tok_stage),
         out_specs=P(), axis_names=set(ALL_AXES),
     )
 
     def loss_fn(params, tokens_mb):
+        # Global next-token shift happens HERE, inside jit, where tokens are
+        # still a global (logically unsharded) array — so the shift is
+        # seq-shard-safe and no extra host->device inputs are needed.
+        targets_mb = jnp.concatenate(
+            [tokens_mb[:, :, 1:], jnp.zeros_like(tokens_mb[:, :, :1])], axis=-1
+        )
+        seq = tokens_mb.shape[2]
+        mask_mb = jnp.broadcast_to(
+            (jnp.arange(seq) < seq - 1).astype(jnp.float32), tokens_mb.shape
+        )
         x = embed_sm(params["embed"], tokens_mb)
         ys = pipe_sm(params["blocks"], x)[S - 1]
-        return head_sm(params["head"], ys, tokens_mb)
+        loss_sum = head_sm(params["head"], ys, targets_mb, mask_mb)
+        valid = num_mb * tokens_mb.shape[1] * (seq - 1)
+        return loss_sum / valid
 
-    def step_fn(state: TrainState, tokens_mb: jax.Array):
+    def step_fn(state: TrainState, tokens_mb):
         loss, grads = jax.value_and_grad(loss_fn)(state.params, tokens_mb)
         updates, new_opt = optimizer.update(grads, state.opt_state, state.params)
         new_params = optax.apply_updates(state.params, updates)
@@ -225,7 +244,7 @@ def build_train_step(model, mesh, *, num_microbatches: int, optimizer=None,
 
     state_specs = state_partition_specs(model, optimizer)
     state_shardings = _to_shardings(mesh, state_specs)
-    token_sharding = NamedSharding(mesh, P(None, (AXIS_DATA, AXIS_FSDP), None))
+    token_sharding = NamedSharding(mesh, P(None, (AXIS_DATA, AXIS_FSDP), AXIS_SEQ))
 
     jit_init = jax.jit(init_fn, out_shardings=state_shardings)
     jit_step = jax.jit(
@@ -238,6 +257,7 @@ def build_train_step(model, mesh, *, num_microbatches: int, optimizer=None,
     def wrapped_step(state, tokens):
         b, seq = tokens.shape
         assert b % num_mb == 0, f"batch {b} not divisible by {num_mb} microbatches"
+        assert seq % sp == 0, f"seq {seq} not divisible by seq-parallel {sp}"
         tokens_mb = tokens.reshape(num_mb, b // num_mb, seq)
         return jit_step(state, tokens_mb)
 

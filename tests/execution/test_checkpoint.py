@@ -1,0 +1,73 @@
+"""Checkpoint/resume tests — a capability the reference lacks entirely
+(README.md:103), so the coverage model is: save mid-training, restart a fresh
+engine (even with a different cluster size), and confirm exact state
+continuity."""
+
+import numpy as np
+import pytest
+
+import jax
+
+from oobleck_tpu.execution.checkpoint import (
+    latest_checkpoint,
+    load_checkpoint,
+    save_checkpoint,
+)
+
+from tests.execution.test_engine import cache_env, make_engine  # noqa: F401
+
+
+def test_save_load_roundtrip(tmp_path):
+    params = {0: {"w": np.arange(6.0).reshape(2, 3)},
+              3: {"b": np.ones((4,))}}
+    opt = {0: ({"mu": np.zeros((2, 3))},), 3: ({"mu": np.ones((4,))},)}
+    save_checkpoint(tmp_path, step=7, params=params, opt_state=opt,
+                    num_iterations_done=5, epoch=1)
+    assert latest_checkpoint(tmp_path).name == "step_7"
+    payload = load_checkpoint(latest_checkpoint(tmp_path))
+    assert payload["meta"]["step"] == 7
+    assert payload["meta"]["epoch"] == 1
+    np.testing.assert_array_equal(payload["params"][0]["w"], params[0]["w"])
+    # opt leaves stored flat
+    assert len(payload["opt"][3]) == 1
+
+
+def test_latest_picks_max_step(tmp_path):
+    for s in (3, 10, 7):
+        save_checkpoint(tmp_path, step=s, params={0: {"w": np.ones(2)}},
+                        opt_state={0: ()}, num_iterations_done=0, epoch=0)
+    assert latest_checkpoint(tmp_path).name == "step_10"
+    assert latest_checkpoint(tmp_path / "missing") is None
+
+
+def test_engine_checkpoint_resume(cache_env, devices8, tmp_path):
+    """Train 2 steps -> checkpoint -> fresh engine with FEWER hosts restores
+    step/params/data position and continues."""
+    engine = make_engine(num_hosts=4, steps=4, devices=devices8)
+    engine.args.execution.checkpoint_dir = str(tmp_path)
+    engine.args.execution.checkpoint_interval = 2
+    engine.initialize_distributed()
+    engine.instantiate_pipelines(engine.args.job.global_num_microbatch)
+    engine._train_step()
+    engine._train_step()
+    engine.save_checkpoint()
+    params_before, _ = engine._collect_layer_state()
+    saved = {li: np.asarray(jax.tree.leaves(p)[0], np.float32)
+             for li, p in params_before.items()}
+    it_before = engine.dataloaders[0].num_iterations_done
+
+    # Fresh engine on a smaller cluster restores from the same directory.
+    engine2 = make_engine(num_hosts=2, steps=4, devices=devices8[:4])
+    engine2.args.execution.checkpoint_dir = str(tmp_path)
+    engine2.initialize_distributed()
+    engine2.instantiate_pipelines(engine2.args.job.global_num_microbatch)
+
+    assert engine2.step == 2
+    assert engine2.dataloaders[0].num_iterations_done == it_before
+    for pipe in engine2.pipelines:
+        for li, p in pipe.params.items():
+            got = np.asarray(jax.tree.leaves(p)[0], np.float32)
+            np.testing.assert_allclose(got, saved[li], rtol=1e-6)
+
+    loss = engine2._train_step()
+    assert np.isfinite(loss)

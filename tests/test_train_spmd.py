@@ -57,6 +57,9 @@ def test_single_device_baseline():
         MeshShape(stage=2, tensor=2, data=2),
         MeshShape(stage=2, fsdp=2, tensor=2),
         MeshShape(stage=4, tensor=2, data=1),
+        MeshShape(seq=4, data=2),
+        MeshShape(stage=2, seq=2, data=2),
+        MeshShape(seq=2, tensor=2, fsdp=2),
     ],
 )
 def test_mesh_factorizations_match_baseline(shape):
