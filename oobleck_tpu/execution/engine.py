@@ -101,13 +101,21 @@ class ReconfigurationEngine:
         self._thread.start()
 
     def _listen(self) -> None:
+        # Single reader for the agent pipe: other message kinds (coordinator
+        # announcements during multi-host init) are routed to the engine's
+        # control queue instead of being dropped — two readers on one pipe
+        # would race and eat each other's messages.
         while True:
             try:
                 msg = self.pipe.recv()
             except (EOFError, OSError):
                 return
-            if isinstance(msg, dict) and msg.get("kind") == "reconfigure":
+            if not isinstance(msg, dict):
+                continue
+            if msg.get("kind") == "reconfigure":
                 self.engine.request_reconfiguration(msg["lost_ip"])
+            else:
+                self.engine._control_msgs.put(msg)
 
 
 class OobleckEngine:
@@ -119,6 +127,12 @@ class OobleckEngine:
         self._injected_devices = devices
 
         self.model = build_model(args.model.model_name, args.model.model_args)
+        if not getattr(self.model, "engine_compatible", True):
+            raise NotImplementedError(
+                f"{args.model.model_name} trains through the model-level API "
+                "(encoder/enc-dec/image objectives); engine integration for "
+                "non-causal-LM objectives lands in a later round"
+            )
         seq_len = min(self.model.config.max_position_embeddings, 1024)
         self.seq_len = seq_len
         self.dataset = build_dataset(
@@ -150,6 +164,9 @@ class OobleckEngine:
         self._exec_cache: dict = {}
         self._pending_lost: list[str] = []
         self._lock = threading.Lock()
+        import queue as _queue
+
+        self._control_msgs: _queue.Queue = _queue.Queue()
 
         self.optimizer = make_optimizer(
             learning_rate=args.job.learning_rate,
@@ -165,12 +182,18 @@ class OobleckEngine:
     def initialize_distributed(self) -> None:
         """Bind to the visible devices and compute templates.
 
-        Single-controller: all chips are local. Multi-host: the control
-        plane's coordinator chain would call jax.distributed.initialize here
-        first (reference initialize_distributed, engine.py:526-596, rebuilds
-        the NCCL world; JAX's equivalent is re-initializing the runtime and
-        recompiling — we rebuild meshes per pipeline instead).
+        Single-controller (default): all chips are local. Multi-host
+        (OOBLECK_MULTIHOST=1): initialize the JAX runtime from the control
+        plane's coordinator chain — the first host's worker announces
+        `<its_ip>:port` through its agent pipe, the master relays it, and
+        every worker passes it to jax.distributed.initialize. This is the
+        TPU equivalent of the reference's rank-0 TCPStore port chain +
+        NCCL world init (engine.py:563-593).
         """
+        import os
+
+        if os.environ.get("OOBLECK_MULTIHOST") == "1" and self.agent_pipe is not None:
+            self._initialize_multihost()
         self.devices = (
             list(self._injected_devices) if self._injected_devices is not None
             else list(jax.devices())
@@ -194,6 +217,49 @@ class OobleckEngine:
             )
         logger.info("templates for host counts %s",
                     [t.num_hosts for t in self.templates])
+
+    def _initialize_multihost(self, timeout_s: float = 120.0) -> None:
+        """Coordinator chain: host 0 announces, everyone initializes.
+
+        Untested on real multi-host hardware in this environment (one
+        tunneled chip); the chain mirrors the verified single-host relay
+        path in elastic/ (worker -> agent -> master -> agents -> workers).
+        """
+        import socket
+        import time as _time
+
+        process_id = self.host_ips.index(self.agent_ip)
+        if process_id == 0:
+            port = 0
+            with socket.socket() as s:
+                s.bind(("", 0))
+                port = s.getsockname()[1]
+            address = f"{self.agent_ip}:{port}"
+            self.agent_pipe.send({"kind": "coordinator", "address": address})
+        else:
+            # The ReconfigurationEngine thread owns the pipe; coordinator
+            # messages arrive via the control queue it feeds.
+            import queue as _queue
+
+            deadline = _time.monotonic() + timeout_s
+            address = None
+            while _time.monotonic() < deadline:
+                try:
+                    msg = self._control_msgs.get(timeout=1.0)
+                except _queue.Empty:
+                    continue
+                if msg.get("kind") == "coordinator":
+                    address = msg["address"]
+                    break
+            if address is None:
+                raise TimeoutError("no coordinator address from the agent")
+        jax.distributed.initialize(
+            coordinator_address=address,
+            num_processes=len(self.host_ips),
+            process_id=process_id,
+        )
+        logger.info("jax.distributed initialized: %s (process %d/%d)",
+                    address, process_id, len(self.host_ips))
 
     def compute_min_hosts(self) -> int:
         """Memory lower bound on hosts per pipeline (reference
@@ -294,18 +360,23 @@ class OobleckEngine:
 
     @measure_time("step")
     def _train_step(self) -> float:
+        from oobleck_tpu.utils.tracing import annotate
+
         losses = []
         weights = []
-        for pipe, dl in zip(self.pipelines, self.dataloaders):
-            batch = dl.next_batch()
-            losses.append(pipe.train_step(batch))
-            weights.append(pipe.num_microbatches)
-        synced = self.dp_engine.do_allreduce()
-        for pipe in self.pipelines:
-            self.opt_states[pipe.pipeline_id] = pipe.apply_updates(
-                self.optimizer, self.opt_states[pipe.pipeline_id],
-                synced[pipe.pipeline_id],
-            )
+        with annotate("pipelines"):
+            for pipe, dl in zip(self.pipelines, self.dataloaders):
+                batch = dl.next_batch()
+                losses.append(pipe.train_step(batch))
+                weights.append(pipe.num_microbatches)
+        with annotate("dp_allreduce"):
+            synced = self.dp_engine.do_allreduce()
+        with annotate("optimizer"):
+            for pipe in self.pipelines:
+                self.opt_states[pipe.pipeline_id] = pipe.apply_updates(
+                    self.optimizer, self.opt_states[pipe.pipeline_id],
+                    synced[pipe.pipeline_id],
+                )
         total = sum(w for w in weights)
         loss = sum(float(l) * w for l, w in zip(losses, weights)) / total
         self.step += 1
@@ -314,19 +385,26 @@ class OobleckEngine:
     def train(self) -> None:
         """Reference train loop (engine.py:651-668) + loss reporting and
         periodic checkpointing (capability the reference lacks)."""
+        from oobleck_tpu.utils.tracing import StepTracer
+
         max_steps = self.args.job.steps
         interval = self.args.execution.checkpoint_interval
-        while self.step < max_steps:
-            self._maybe_reconfigure()
-            loss = self._train_step()
-            logger.info("step %d/%d loss %.4f", self.step, max_steps, loss)
-            if self.step % 10 == 0:
-                timers = sync_timers()
-                logger.info("step timer: %s", timers.get("step"))
-            if interval and self.step % interval == 0:
+        tracer = StepTracer()
+        try:
+            while self.step < max_steps:
+                tracer.on_step(self.step)
+                self._maybe_reconfigure()
+                loss = self._train_step()
+                logger.info("step %d/%d loss %.4f", self.step, max_steps, loss)
+                if self.step % 10 == 0:
+                    timers = sync_timers()
+                    logger.info("step timer: %s", timers.get("step"))
+                if interval and self.step % interval == 0:
+                    self.save_checkpoint()
+            if interval and self.step % interval != 0:
                 self.save_checkpoint()
-        if interval and self.step % interval != 0:
-            self.save_checkpoint()
+        finally:
+            tracer.close()
 
     # ------------------------------------------------------------------ #
 

@@ -42,6 +42,59 @@ register("gpt3-6.7b")(lambda o: _gpt(o, hidden_size=4096, num_layers=32, num_hea
 register("gpt2-tiny")(lambda o: _gpt(o, vocab_size=256, hidden_size=64, num_layers=4, num_heads=4, max_position_embeddings=128))
 
 
+# Bloom family: GPT architecture with ALiBi position biases (no wpe)
+register("bloom-560m")(lambda o: _gpt(o, vocab_size=250880, hidden_size=1024, num_layers=24, num_heads=16, position_embedding="alibi"))
+register("bloom-7b1")(lambda o: _gpt(o, vocab_size=250880, hidden_size=4096, num_layers=30, num_heads=32, position_embedding="alibi"))
+register("bloom-tiny")(lambda o: _gpt(o, vocab_size=256, hidden_size=64, num_layers=4, num_heads=4, max_position_embeddings=128, position_embedding="alibi"))
+
+
+def _llama(overrides, **preset):
+    from oobleck_tpu.models.llama import LlamaConfig, LlamaModel
+
+    return LlamaModel(LlamaConfig().override(**preset).override(**overrides))
+
+
+# Llama family (HF names; sizes per the released checkpoints)
+register("llama-2-7b")(lambda o: _llama(o, hidden_size=4096, num_layers=32, num_heads=32, intermediate_size=11008))
+register("llama-2-13b")(lambda o: _llama(o, hidden_size=5120, num_layers=40, num_heads=40, intermediate_size=13824))
+register("llama-3-8b")(lambda o: _llama(o, vocab_size=128256, hidden_size=4096, num_layers=32, num_heads=32, num_kv_heads=8, intermediate_size=14336, max_position_embeddings=8192, rope_theta=500000.0))
+register("llama-tiny")(lambda o: _llama(o, vocab_size=256, hidden_size=64, num_layers=4, num_heads=4, num_kv_heads=2, max_position_embeddings=128))
+
+
+def _bert(overrides, **preset):
+    from oobleck_tpu.models.bert import BertConfig, BertModel
+
+    return BertModel(BertConfig().override(**preset).override(**overrides))
+
+
+def _vit(overrides, **preset):
+    from oobleck_tpu.models.vit import ViTConfig, ViTModel
+
+    return ViTModel(ViTConfig().override(**preset).override(**overrides))
+
+
+# BERT family (bidirectional encoder, MLM objective)
+register("bert-base-uncased")(lambda o: _bert(o, hidden_size=768, num_layers=12, num_heads=12))
+register("bert-large-uncased")(lambda o: _bert(o, hidden_size=1024, num_layers=24, num_heads=16))
+register("bert-tiny")(lambda o: _bert(o, vocab_size=256, hidden_size=64, num_layers=4, num_heads=4, max_position_embeddings=128, mask_token_id=1))
+
+def _t5(overrides, **preset):
+    from oobleck_tpu.models.t5 import T5Config, T5Model
+
+    return T5Model(T5Config().override(**preset).override(**overrides))
+
+
+# T5 family (encoder-decoder, seq2seq objective)
+register("t5-base")(lambda o: _t5(o, d_model=768, num_layers=12, num_decoder_layers=12, num_heads=12, d_ff=2048))
+register("t5-large")(lambda o: _t5(o, d_model=1024, num_layers=24, num_decoder_layers=24, num_heads=16, d_ff=2816))
+register("t5-tiny")(lambda o: _t5(o, vocab_size=256, d_model=64, num_layers=2, num_decoder_layers=2, num_heads=4, d_ff=128))
+
+# ViT family (image classification)
+register("vit-base-patch16-224")(lambda o: _vit(o, hidden_size=768, num_layers=12, num_heads=12))
+register("vit-large-patch16-224")(lambda o: _vit(o, hidden_size=1024, num_layers=24, num_heads=16))
+register("vit-tiny")(lambda o: _vit(o, image_size=32, patch_size=8, num_classes=10, hidden_size=64, num_layers=4, num_heads=4))
+
+
 def build_model(model_name: str, model_args: dict[str, Any] | None = None):
     """Resolve a model name (+ overrides) to a layer-list model instance."""
     try:

@@ -75,6 +75,16 @@ class GPTConfig:
     attention_impl: str = "auto"
     remat: bool = True
     vocab_pad_multiple: int = 128   # pad vocab so `tensor` can shard it
+    # "learned" (GPT-2) or "alibi" (Bloom family: no wpe, per-head distance
+    # bias in attention).
+    position_embedding: str = "learned"
+
+    def __post_init__(self):
+        if self.position_embedding not in ("learned", "alibi"):
+            raise ValueError(
+                f"position_embedding must be 'learned' or 'alibi', got "
+                f"{self.position_embedding!r}"
+            )
 
     @property
     def padded_vocab_size(self) -> int:
@@ -187,10 +197,14 @@ class GPTModel:
         c = self.config
         k1, k2 = jax.random.split(rng)
         std = c.initializer_range
-        return {
+        out = {
             "wte": jax.random.normal(k1, (c.padded_vocab_size, c.hidden_size), c.param_dtype) * std,
-            "wpe": jax.random.normal(k2, (c.max_position_embeddings, c.hidden_size), c.param_dtype) * std,
         }
+        if c.position_embedding == "learned":
+            out["wpe"] = jax.random.normal(
+                k2, (c.max_position_embeddings, c.hidden_size), c.param_dtype
+            ) * std
+        return out
 
     def _init_block(self, rng: jax.Array):
         c = self.config
@@ -250,12 +264,13 @@ class GPTModel:
             x = vocab_parallel_embed(p["wte"], tokens, offset, ctx.tensor)
         else:
             x = p["wte"][tokens]
-        if ctx and ctx.seq:
-            # Sequence-parallel: this shard holds positions [r*seq, (r+1)*seq).
-            pos0 = ctx.seq_rank() * seq
-            x = x + lax.dynamic_slice_in_dim(p["wpe"], pos0, seq, axis=0)
-        else:
-            x = x + p["wpe"][:seq]
+        if c.position_embedding == "learned":
+            if ctx and ctx.seq:
+                # Sequence-parallel: this shard holds [r*seq, (r+1)*seq).
+                pos0 = ctx.seq_rank() * seq
+                x = x + lax.dynamic_slice_in_dim(p["wpe"], pos0, seq, axis=0)
+            else:
+                x = x + p["wpe"][:seq]
         return x.astype(c.dtype)
 
     def apply_block(self, p, x: jax.Array, ctx: ShardCtx | None = None) -> jax.Array:
@@ -271,11 +286,30 @@ class GPTModel:
         bqkv = p["attn"]["bqkv"].astype(dt)                             # [3,Hl,D]
         qkv = jnp.einsum("bse,ethd->tbhsd", h, wqkv) + bqkv[:, None, :, None, :]
         if ctx and ctx.seq:
+            if c.position_embedding == "alibi":
+                raise NotImplementedError(
+                    "alibi + sequence parallelism needs ring-bias support"
+                )
             from oobleck_tpu.ops.ring_attention import ring_attention
 
             attn_out = ring_attention(qkv[0], qkv[1], qkv[2], axis_name=ctx.seq)
         else:
-            attn_out = causal_attention(qkv[0], qkv[1], qkv[2], impl=c.attention_impl)
+            bias = None
+            if c.position_embedding == "alibi":
+                from oobleck_tpu.ops.attention import alibi_bias
+
+                s_len = qkv.shape[3]
+                # Local heads under TP: slice this rank's slopes.
+                h_local = qkv.shape[2]
+                full = alibi_bias(c.num_heads, s_len, s_len)
+                if ctx and ctx.tensor:
+                    start = ctx.tp_rank() * h_local
+                    bias = lax.dynamic_slice_in_dim(full, start, h_local, axis=0)
+                else:
+                    bias = full
+            attn_out = causal_attention(
+                qkv[0], qkv[1], qkv[2], impl=c.attention_impl, bias=bias
+            )
         wo = _maybe_unshard(p["attn"]["wo"], f_, 2).astype(dt)          # [Hl,D,E]
         out = jnp.einsum("bhsd,hde->bse", attn_out, wo)
         out = _maybe_reduce_from_tp(out, t) + p["attn"]["bo"].astype(dt)
@@ -364,7 +398,9 @@ class GPTModel:
                 "bo": P(*s),
             },
         }
-        embed = {"wte": P("tensor", None), "wpe": P(None, None)}
+        embed = {"wte": P("tensor", None)}
+        if self.config.position_embedding == "learned":
+            embed["wpe"] = P(None, None)
         head = {"ln_f": {"scale": P(), "bias": P()}, "w": P(None, "tensor")}
         return {"embed": embed, "blocks": block, "head": head}
 

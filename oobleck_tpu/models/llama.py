@@ -1,0 +1,323 @@
+"""Llama family decoder as an explicit layer list.
+
+BASELINE.json config 5 ("Llama-2-7B via HF model_name — stretch the template
+planner to non-GPT arch"); the reference cannot run Llama at all (its split
+registry has no llama entry, /root/reference/oobleck/module/sharding.py:15-41).
+
+Same pipeline layer list contract as GPT ([embed, block_0.., head], see
+models/gpt.py) and the same ShardCtx manual-parallel protocol, with the Llama
+architecture: RMSNorm, rotary position embeddings (no learned positions —
+seq-parallel offsets rotate RoPE phases instead of slicing a table), SwiGLU
+MLP, no biases, untied head, optional grouped-query attention.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass, replace
+from typing import Any
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+from jax.sharding import PartitionSpec as P
+
+from oobleck_tpu.models.base import stack_layer_params
+from oobleck_tpu.models.gpt import NEG_INF, ShardCtx
+from oobleck_tpu.ops.attention import causal_attention
+from oobleck_tpu.parallel.collectives import (
+    copy_to_tp,
+    reduce_from_tp,
+    unshard_fsdp,
+    vocab_parallel_embed,
+    vocab_parallel_logits_loss,
+)
+
+
+@dataclass(frozen=True)
+class LlamaConfig:
+    vocab_size: int = 32000
+    max_position_embeddings: int = 4096
+    hidden_size: int = 4096
+    num_layers: int = 32
+    num_heads: int = 32
+    num_kv_heads: int | None = None     # None = MHA
+    intermediate_size: int | None = None
+    rms_norm_eps: float = 1e-5
+    rope_theta: float = 10000.0
+    initializer_range: float = 0.02
+    dtype: Any = jnp.bfloat16
+    param_dtype: Any = jnp.float32
+    attention_impl: str = "auto"
+    remat: bool = True
+    vocab_pad_multiple: int = 128
+
+    @property
+    def padded_vocab_size(self) -> int:
+        m = self.vocab_pad_multiple
+        return (self.vocab_size + m - 1) // m * m
+
+    @property
+    def kv_heads(self) -> int:
+        return self.num_kv_heads or self.num_heads
+
+    @property
+    def ffn_dim(self) -> int:
+        if self.intermediate_size:
+            return self.intermediate_size
+        # Llama sizing: 2/3 * 4E rounded up to a multiple of 256.
+        f = int(2 * 4 * self.hidden_size / 3)
+        return (f + 255) // 256 * 256
+
+    @property
+    def head_dim(self) -> int:
+        assert self.hidden_size % self.num_heads == 0
+        return self.hidden_size // self.num_heads
+
+    def override(self, **kwargs) -> "LlamaConfig":
+        alias = {
+            "n_embd": "hidden_size", "n_layer": "num_layers",
+            "n_head": "num_heads", "n_positions": "max_position_embeddings",
+        }
+        kwargs = {alias.get(k, k): v for k, v in kwargs.items()}
+        unknown = [k for k in kwargs if k not in LlamaConfig.__dataclass_fields__]
+        if unknown:
+            raise ValueError(f"unknown model_args {unknown}")
+        return replace(self, **kwargs)
+
+
+def _rms_norm(x: jax.Array, scale: jax.Array, eps: float) -> jax.Array:
+    dtype = x.dtype
+    x = x.astype(jnp.float32)
+    var = jnp.mean(jnp.square(x), axis=-1, keepdims=True)
+    return (x * jax.lax.rsqrt(var + eps) * scale).astype(dtype)
+
+
+def _rope(x: jax.Array, positions: jax.Array, theta: float) -> jax.Array:
+    """Rotary embedding. x: [B, H, S, D]; positions: [S]."""
+    d = x.shape[-1]
+    freqs = 1.0 / (theta ** (jnp.arange(0, d, 2, dtype=jnp.float32) / d))
+    angles = positions[:, None].astype(jnp.float32) * freqs[None, :]  # [S, D/2]
+    cos, sin = jnp.cos(angles), jnp.sin(angles)
+    x1, x2 = x[..., 0::2], x[..., 1::2]
+    out1 = x1 * cos - x2 * sin
+    out2 = x2 * cos + x1 * sin
+    return jnp.stack([out1, out2], axis=-1).reshape(x.shape).astype(x.dtype)
+
+
+def _maybe(fn, x, axis, *a):
+    return fn(x, axis, *a) if axis else x
+
+
+class LlamaModel:
+    """Layer-list Llama decoder; same contract as GPTModel."""
+
+    def __init__(self, config: LlamaConfig):
+        self.config = config
+
+    # ---- layer list ----
+
+    @property
+    def num_pipeline_layers(self) -> int:
+        return self.config.num_layers + 2
+
+    def layer_name(self, index: int) -> str:
+        if index == 0:
+            return "embed"
+        if index == self.num_pipeline_layers - 1:
+            return "head"
+        return f"block_{index - 1}"
+
+    def init_layer(self, rng: jax.Array, index: int):
+        ks = jax.random.split(rng, 3)
+        if index == 0:
+            return self._init_embed(ks[0])
+        if index == self.num_pipeline_layers - 1:
+            return self._init_head(ks[2])
+        return self._init_block(jax.random.fold_in(ks[1], index))
+
+    def apply_layer(self, index: int, params, carry, batch, ctx=None):
+        if index == 0:
+            return self.embed(params, batch["input_ids"], ctx)
+        if index == self.num_pipeline_layers - 1:
+            return self.head(params, carry, ctx)
+        return self.apply_block(params, carry, ctx)
+
+    def loss_from_logits(self, logits, batch):
+        from oobleck_tpu.models.gpt import cross_entropy_loss
+
+        return cross_entropy_loss(logits, batch["input_ids"], self.config.vocab_size)
+
+    def sample_batch(self, batch_size: int, seq_len: int):
+        tokens = jax.random.randint(
+            jax.random.PRNGKey(0), (batch_size, seq_len), 0,
+            self.config.vocab_size, dtype=jnp.int32,
+        )
+        return {"input_ids": tokens}
+
+    # ---- init ----
+
+    def _init_embed(self, rng):
+        c = self.config
+        return {"wte": jax.random.normal(
+            rng, (c.padded_vocab_size, c.hidden_size), c.param_dtype
+        ) * c.initializer_range}
+
+    def _init_block(self, rng):
+        c = self.config
+        ks = jax.random.split(rng, 5)
+        std = c.initializer_range
+        res_std = std / (2 * c.num_layers) ** 0.5
+        e, f, h, kv, d = (c.hidden_size, c.ffn_dim, c.num_heads,
+                          c.kv_heads, c.head_dim)
+        return {
+            "ln1": {"scale": jnp.ones((e,), c.param_dtype)},
+            "attn": {
+                "wq": jax.random.normal(ks[0], (e, h, d), c.param_dtype) * std,
+                "wkv": jax.random.normal(ks[1], (e, 2, kv, d), c.param_dtype) * std,
+                "wo": jax.random.normal(ks[2], (h, d, e), c.param_dtype) * res_std,
+            },
+            "ln2": {"scale": jnp.ones((e,), c.param_dtype)},
+            "mlp": {
+                "wg": jax.random.normal(ks[3], (e, f), c.param_dtype) * std,
+                "wu": jax.random.normal(ks[4], (e, f), c.param_dtype) * std,
+                "wo": jax.random.normal(
+                    jax.random.fold_in(ks[3], 1), (f, e), c.param_dtype
+                ) * res_std,
+            },
+        }
+
+    def _init_head(self, rng):
+        c = self.config
+        return {
+            "ln_f": {"scale": jnp.ones((c.hidden_size,), c.param_dtype)},
+            "w": jax.random.normal(
+                rng, (c.hidden_size, c.padded_vocab_size), c.param_dtype
+            ) * c.initializer_range,
+        }
+
+    def init_params(self, rng):
+        ks = jax.random.split(rng, 3)
+        blocks = [self._init_block(jax.random.fold_in(ks[1], i + 1))
+                  for i in range(self.config.num_layers)]
+        return {"embed": self._init_embed(ks[0]),
+                "blocks": stack_layer_params(blocks),
+                "head": self._init_head(ks[2])}
+
+    # ---- forward ----
+
+    def embed(self, p, tokens, ctx: ShardCtx | None = None):
+        c = self.config
+        if ctx and ctx.tensor:
+            vlocal = p["wte"].shape[0]
+            x = vocab_parallel_embed(p["wte"], tokens,
+                                     ctx.tp_rank() * vlocal, ctx.tensor)
+        else:
+            x = p["wte"][tokens]
+        return x.astype(c.dtype)
+
+    def _positions(self, s_local: int, ctx: ShardCtx | None):
+        if ctx and ctx.seq:
+            return ctx.seq_rank() * s_local + jnp.arange(s_local)
+        return jnp.arange(s_local)
+
+    def apply_block(self, p, x, ctx: ShardCtx | None = None):
+        c = self.config
+        dt = c.dtype
+        t = ctx.tensor if ctx else None
+        f_ = ctx.fsdp if ctx else None
+        b, s, _ = x.shape
+        pos = self._positions(s, ctx)
+
+        h = _maybe(copy_to_tp, x, t)
+        h = _rms_norm(h, p["ln1"]["scale"], c.rms_norm_eps)
+        wq = _maybe(unshard_fsdp, p["attn"]["wq"], f_, 0).astype(dt)      # [E,Hl,D]
+        wkv = _maybe(unshard_fsdp, p["attn"]["wkv"], f_, 0).astype(dt)    # [E,2,KVl,D]
+        q = jnp.einsum("bse,ehd->bhsd", h, wq)
+        kv = jnp.einsum("bse,ekhd->kbhsd", h, wkv)
+        k, v = kv[0], kv[1]
+        q = _rope(q, pos, c.rope_theta)
+        k = _rope(k, pos, c.rope_theta)
+        if c.kv_heads != c.num_heads:
+            rep = c.num_heads // c.kv_heads
+            k = jnp.repeat(k, rep, axis=1)
+            v = jnp.repeat(v, rep, axis=1)
+        if ctx and ctx.seq:
+            from oobleck_tpu.ops.ring_attention import ring_attention
+
+            attn = ring_attention(q, k, v, axis_name=ctx.seq)
+        else:
+            attn = causal_attention(q, k, v, impl=c.attention_impl)
+        wo = _maybe(unshard_fsdp, p["attn"]["wo"], f_, 2).astype(dt)      # [Hl,D,E]
+        out = jnp.einsum("bhsd,hde->bse", attn, wo)
+        x = x + _maybe(reduce_from_tp, out, t)
+
+        h = _maybe(copy_to_tp, x, t)
+        h = _rms_norm(h, p["ln2"]["scale"], c.rms_norm_eps)
+        wg = _maybe(unshard_fsdp, p["mlp"]["wg"], f_, 0).astype(dt)
+        wu = _maybe(unshard_fsdp, p["mlp"]["wu"], f_, 0).astype(dt)
+        g = jax.nn.silu(h @ wg) * (h @ wu)
+        wo = _maybe(unshard_fsdp, p["mlp"]["wo"], f_, 1).astype(dt)
+        out = g @ wo
+        return x + _maybe(reduce_from_tp, out, t)
+
+    def head(self, p, x, ctx: ShardCtx | None = None):
+        c = self.config
+        x = _rms_norm(x, p["ln_f"]["scale"], c.rms_norm_eps)
+        logits = (x @ p["w"].astype(c.dtype)).astype(jnp.float32)
+        if ctx and ctx.tensor:
+            logits = lax.all_gather(logits, ctx.tensor, axis=-1, tiled=True)
+        mask = jnp.arange(logits.shape[-1]) < c.vocab_size
+        return jnp.where(mask, logits, NEG_INF)
+
+    def head_loss_shifted(self, p, x, targets, mask, ctx: ShardCtx | None = None):
+        c = self.config
+        x = _rms_norm(x, p["ln_f"]["scale"], c.rms_norm_eps)
+        local_logits = (x @ p["w"].astype(c.dtype)).astype(jnp.float32)
+        vlocal = local_logits.shape[-1]
+        offset = (ctx.tp_rank() * vlocal) if (ctx and ctx.tensor) else 0
+        col_ids = jnp.arange(vlocal) + offset
+        local_logits = jnp.where(col_ids < c.vocab_size, local_logits, NEG_INF)
+        per_pos = vocab_parallel_logits_loss(
+            local_logits, targets, offset, ctx.tensor if ctx else None
+        )
+        return jnp.sum(per_pos * mask)
+
+    def forward(self, params, tokens):
+        c = self.config
+        x = self.embed(params["embed"], tokens)
+        block = self.apply_block
+        if c.remat:
+            block = jax.checkpoint(block)
+
+        def body(x, bp):
+            return block(bp, x), None
+
+        x, _ = jax.lax.scan(body, x, params["blocks"])
+        return self.head(params["head"], x)
+
+    def loss(self, params, batch):
+        return self.loss_from_logits(self.forward(params, batch["input_ids"]), batch)
+
+    # ---- sharding ----
+
+    def param_specs(self, *, stacked: bool = True):
+        s = ("stage",) if stacked else ()
+        block = {
+            "ln1": {"scale": P(*s)},
+            "attn": {
+                "wq": P(*s, "fsdp", "tensor", None),
+                "wkv": P(*s, "fsdp", None, "tensor", None),
+                "wo": P(*s, "tensor", None, "fsdp"),
+            },
+            "ln2": {"scale": P(*s)},
+            "mlp": {
+                "wg": P(*s, "fsdp", "tensor"),
+                "wu": P(*s, "fsdp", "tensor"),
+                "wo": P(*s, "tensor", "fsdp"),
+            },
+        }
+        return {
+            "embed": {"wte": P("tensor", None)},
+            "blocks": block,
+            "head": {"ln_f": {"scale": P()}, "w": P(None, "tensor")},
+        }

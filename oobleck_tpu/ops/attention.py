@@ -25,22 +25,55 @@ NEG_INF = -1e9  # large-but-finite: jnp.finfo(bf16).min overflows under softmax 
 
 
 def _xla_causal_attention(
-    q: jax.Array, k: jax.Array, v: jax.Array, *, scale: float | None = None
+    q: jax.Array, k: jax.Array, v: jax.Array, *, scale: float | None = None,
+    bias: jax.Array | None = None, causal: bool = True
 ) -> jax.Array:
-    """Plain masked-softmax attention. [B, H, S, D] -> [B, H, S, D]."""
+    """Masked-softmax attention. [B, H, S, D] -> [B, H, S, D].
+
+    `bias` ([H, Sq, Sk] or broadcastable) supports ALiBi (Bloom family);
+    `causal=False` gives the bidirectional encoder form (BERT/ViT)."""
     *_, seq_q, head_dim = q.shape
     seq_k = k.shape[-2]
     if scale is None:
         scale = head_dim**-0.5
     logits = jnp.einsum("bhqd,bhkd->bhqk", q, k) * scale
-    # Causal mask; supports seq_q != seq_k (ring attention partial blocks).
-    q_pos = jnp.arange(seq_q)[:, None] + (seq_k - seq_q)
-    k_pos = jnp.arange(seq_k)[None, :]
-    mask = q_pos >= k_pos
-    logits = jnp.where(mask, logits, NEG_INF)
+    if bias is not None:
+        logits = logits + bias.astype(logits.dtype)
+    if causal:
+        # Supports seq_q != seq_k (ring attention partial blocks).
+        q_pos = jnp.arange(seq_q)[:, None] + (seq_k - seq_q)
+        k_pos = jnp.arange(seq_k)[None, :]
+        logits = jnp.where(q_pos >= k_pos, logits, NEG_INF)
     # Softmax in f32 for stability regardless of compute dtype.
     probs = jax.nn.softmax(logits.astype(jnp.float32), axis=-1).astype(q.dtype)
     return jnp.einsum("bhqk,bhkd->bhqd", probs, v)
+
+
+def alibi_slopes(num_heads: int) -> jax.Array:
+    """ALiBi per-head slopes (Bloom): geometric sequence from 2^(-8/n)."""
+    import math
+
+    def pow2_slopes(n):
+        start = 2.0 ** (-(2.0 ** -(math.log2(n) - 3)))
+        return [start * (start ** i) for i in range(n)]
+
+    if math.log2(num_heads).is_integer():
+        s = pow2_slopes(num_heads)
+    else:
+        closest = 2 ** int(math.floor(math.log2(num_heads)))
+        s = pow2_slopes(closest)
+        extra = pow2_slopes(2 * closest)[0::2][: num_heads - closest]
+        s = s + extra
+    return jnp.asarray(s, jnp.float32)
+
+
+def alibi_bias(num_heads: int, seq_q: int, seq_k: int) -> jax.Array:
+    """[H, Sq, Sk] ALiBi bias: slope * -(q_pos - k_pos) for k <= q."""
+    slopes = alibi_slopes(num_heads)
+    q_pos = jnp.arange(seq_q)[:, None] + (seq_k - seq_q)
+    k_pos = jnp.arange(seq_k)[None, :]
+    dist = (q_pos - k_pos).astype(jnp.float32)
+    return -slopes[:, None, None] * dist[None]
 
 
 @functools.cache
@@ -76,5 +109,10 @@ def causal_attention(
     *,
     impl: str = "auto",
     scale: float | None = None,
+    bias: jax.Array | None = None,
 ) -> jax.Array:
+    if bias is not None:
+        # Additive biases (ALiBi) run through the XLA path; the flash kernel
+        # does not fold biases yet.
+        return _xla_causal_attention(q, k, v, scale=scale, bias=bias)
     return select_attention_impl(impl)(q, k, v, scale=scale)

@@ -1,0 +1,52 @@
+"""Runtime tracing.
+
+Capability match for the reference's torch-profiler annotations
+(record_function regions around FSDP hooks, /root/reference/oobleck/
+execution/layer.py:148-190) plus the TensorBoard wiring it lists as a dep
+but never uses (SURVEY §5): jax.profiler spans around engine regions, and an
+on-demand trace dump for a window of steps.
+
+Enable with OOBLECK_TRACE_DIR=/path — the engine wraps steps in named
+annotations and writes a perfetto-compatible trace for steps
+[OOBLECK_TRACE_START, OOBLECK_TRACE_START + OOBLECK_TRACE_STEPS).
+"""
+
+from __future__ import annotations
+
+import contextlib
+import os
+
+import jax
+
+
+def annotate(name: str):
+    """Named span visible in TPU profiler traces (and a no-op otherwise)."""
+    return jax.profiler.TraceAnnotation(name)
+
+
+class StepTracer:
+    """Traces a configured window of training steps to OOBLECK_TRACE_DIR."""
+
+    def __init__(self):
+        self.trace_dir = os.environ.get("OOBLECK_TRACE_DIR")
+        self.start = int(os.environ.get("OOBLECK_TRACE_START", "3"))
+        self.steps = int(os.environ.get("OOBLECK_TRACE_STEPS", "3"))
+        self._active = False
+
+    def on_step(self, step: int) -> None:
+        if not self.trace_dir:
+            return
+        if (not self._active and step >= self.start
+                and step < self.start + self.steps):
+            # >= so a checkpoint-resumed run past `start` still traces its
+            # first post-resume window.
+            jax.profiler.start_trace(self.trace_dir)
+            self._active = True
+        elif self._active and step >= self.start + self.steps:
+            jax.profiler.stop_trace()
+            self._active = False
+
+    def close(self) -> None:
+        if self._active:
+            jax.profiler.stop_trace()
+            self._active = False

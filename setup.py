@@ -1,0 +1,23 @@
+"""Build hook: compile the native planner alongside the Python package.
+
+The reference builds its C++ planner through CMake + pybind11
+(/root/reference/setup.py:96-108); here the planner is a plain shared
+library with a C API (ctypes), so the build is one compiler invocation,
+also run on demand at first import (oobleck_tpu/planning/_native.py).
+"""
+
+import subprocess
+from pathlib import Path
+
+from setuptools import setup
+from setuptools.command.build_py import build_py
+
+
+class BuildWithPlanner(build_py):
+    def run(self):
+        csrc = Path(__file__).parent / "oobleck_tpu" / "csrc"
+        subprocess.run(["make", "-C", str(csrc)], check=True)
+        super().run()
+
+
+setup(cmdclass={"build_py": BuildWithPlanner})

@@ -23,6 +23,15 @@ import numpy as np
 import pytest
 
 
+@pytest.fixture(scope="module", autouse=True)
+def _clear_jax_caches():
+    """Drop compiled-executable caches between test modules: the full suite
+    compiles hundreds of programs over 8 virtual devices and can exhaust
+    host memory in a single process otherwise."""
+    yield
+    jax.clear_caches()
+
+
 @pytest.fixture(scope="session")
 def devices8():
     devs = jax.devices()
