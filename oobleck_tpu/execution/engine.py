@@ -316,6 +316,7 @@ class OobleckEngine:
         self.pipelines = []
         self.dataloaders = []
         self.opt_states = {}
+        train_samples = len(self.dataset) - self._eval_reserve()
         for a in assignments:
             pipe = PipelineInstance(
                 pipeline_id=a.pipeline_index,
@@ -331,8 +332,10 @@ class OobleckEngine:
                 exec_cache=self._exec_cache,
             )
             self.pipelines.append(pipe)
+            # Train over the head split only; the tail is evaluate()'s
+            # held-out reserve.
             sampler = OobleckSampler(
-                num_samples=len(self.dataset),
+                num_samples=train_samples,
                 microbatch_size=self.args.job.microbatch_size,
                 pipeline_index=a.pipeline_index,
                 num_microbatches=num_mb_list,
@@ -447,6 +450,58 @@ class OobleckEngine:
         return payload
 
     # ------------------------------------------------------------------ #
+
+    EVAL_FRACTION = 0.1  # dataset tail reserved for evaluation
+
+    def _eval_reserve(self) -> int:
+        return max(1, int(len(self.dataset) * self.EVAL_FRACTION))
+
+    def evaluate(self, num_batches: int = 8) -> float:
+        """Forward-only mean loss over the held-out dataset tail (the
+        reference's Evaluation LoaderType exists but is never driven,
+        dataloader.py:101). Training samplers cover only the head split
+        (see _materialize_plan), so the tail is genuinely unseen. If one
+        eval bucket exceeds the reserve, the window extends into the
+        training tail out of necessity (tiny datasets) — logged."""
+        n = len(self.dataset)
+        bucket = self.args.job.microbatch_size * sum(
+            p.num_microbatches for p in self.pipelines
+        )
+        eval_n = self._eval_reserve()
+        if eval_n < bucket:
+            logger.warning(
+                "eval reserve %d < one bucket %d; eval overlaps training tail",
+                eval_n, bucket,
+            )
+            eval_n = bucket
+        offset = n - eval_n
+
+        class _Tail:
+            def __init__(self, ds):
+                self.ds = ds
+
+            def __len__(self):
+                return eval_n
+
+            def __getitem__(self, i):
+                return self.ds[offset + i]
+
+        tail = _Tail(self.dataset)
+        loss_sum = 0.0
+        weight_sum = 0
+        for pipe in self.pipelines:
+            sampler = OobleckSampler(
+                num_samples=len(tail),
+                microbatch_size=self.args.job.microbatch_size,
+                pipeline_index=pipe.pipeline_id,
+                num_microbatches=[p.num_microbatches for p in self.pipelines],
+            )
+            dl = OobleckDataLoader(tail, sampler)
+            for _ in range(max(1, num_batches // len(self.pipelines))):
+                loss = float(pipe.eval_step(dl.next_batch()))
+                loss_sum += loss * pipe.num_microbatches
+                weight_sum += pipe.num_microbatches
+        return loss_sum / weight_sum
 
     def request_reconfiguration(self, lost_ip: str) -> None:
         with self._lock:

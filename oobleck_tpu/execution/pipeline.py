@@ -341,6 +341,34 @@ class PipelineInstance:
 
     # ------------------------------------------------------------------ #
 
+    def eval_step(self, batch: np.ndarray):
+        """Forward-only loss over this pipeline's microbatches (no backward
+        instructions, no gradient memory); returns the mean loss."""
+        S, M = self.num_stages, batch.shape[0]
+        first_st, last_st = self.stages[0], self.stages[-1]
+        losses = []
+        for m in range(M):
+            tokens_first = jax.device_put(batch[m], first_st.batch_sharding)
+            tokens_last = (
+                tokens_first if S == 1
+                else jax.device_put(batch[m], last_st.batch_sharding)
+            )
+            x = None
+            for st in self.stages:
+                is_first = st.stage_index == 0
+                is_last = st.stage_index == S - 1
+                tokens = tokens_first if is_first else (
+                    tokens_last if is_last else None
+                )
+                out = st.fwd(tuple(self.params[li] for li in st.layer_ids),
+                             x, tokens)
+                if is_last:
+                    losses.append(out)
+                else:
+                    nxt = self.stages[st.stage_index + 1]
+                    x = jax.device_put(out, nxt.batch_sharding)
+        return sum(losses[1:], start=losses[0]) / len(losses)
+
     def apply_updates(self, optimizer, opt_state: dict[int, Any],
                       synced_grads: dict[int, Any]) -> dict[int, Any]:
         """Per-layer optimizer step with (possibly DP-synced) grads."""

@@ -43,31 +43,36 @@ def _flash_kernel(q_ref, k_ref, v_ref, o_ref, acc_ref, m_ref, l_ref,
         m_ref[:] = jnp.full_like(m_ref, NEG_INF)
         l_ref[:] = jnp.zeros_like(l_ref)
 
-    q = q_ref[0].astype(jnp.float32)          # [Bq, D]
-    k = k_ref[0].astype(jnp.float32)          # [Bk, D]
-    v = v_ref[0].astype(jnp.float32)          # [Bk, D]
+    # Fully-masked blocks (kv strictly after this q block) contribute exactly
+    # zero — skip their compute; the grid still visits them, but the MXU work
+    # (the actual cost) is predicated away, ~halving causal FLOPs.
+    @pl.when(ki * BLOCK_K <= qi * BLOCK_Q + (BLOCK_Q - 1))
+    def _():
+        q = q_ref[0].astype(jnp.float32)          # [Bq, D]
+        k = k_ref[0].astype(jnp.float32)          # [Bk, D]
+        v = v_ref[0].astype(jnp.float32)          # [Bk, D]
 
-    s = jax.lax.dot_general(
-        q, k, (((1,), (1,)), ((), ())), preferred_element_type=jnp.float32
-    ) * scale                                  # [Bq, Bk]
+        s = jax.lax.dot_general(
+            q, k, (((1,), (1,)), ((), ())), preferred_element_type=jnp.float32
+        ) * scale                                  # [Bq, Bk]
 
-    # causal mask on global positions
-    q_pos = qi * BLOCK_Q + jax.lax.broadcasted_iota(jnp.int32, s.shape, 0)
-    k_pos = ki * BLOCK_K + jax.lax.broadcasted_iota(jnp.int32, s.shape, 1)
-    s = jnp.where(q_pos >= k_pos, s, NEG_INF)
+        # causal mask on global positions
+        q_pos = qi * BLOCK_Q + jax.lax.broadcasted_iota(jnp.int32, s.shape, 0)
+        k_pos = ki * BLOCK_K + jax.lax.broadcasted_iota(jnp.int32, s.shape, 1)
+        s = jnp.where(q_pos >= k_pos, s, NEG_INF)
 
-    m_prev = m_ref[:, :1]                      # [Bq, 1]
-    m_cur = jnp.max(s, axis=-1, keepdims=True)
-    m_new = jnp.maximum(m_prev, m_cur)
-    p = jnp.exp(s - m_new)                     # [Bq, Bk]
-    correction = jnp.exp(m_prev - m_new)       # [Bq, 1]
+        m_prev = m_ref[:, :1]                      # [Bq, 1]
+        m_cur = jnp.max(s, axis=-1, keepdims=True)
+        m_new = jnp.maximum(m_prev, m_cur)
+        p = jnp.exp(s - m_new)                     # [Bq, Bk]
+        correction = jnp.exp(m_prev - m_new)       # [Bq, 1]
 
-    l_new = l_ref[:, :1] * correction + jnp.sum(p, axis=-1, keepdims=True)
-    acc_ref[:] = acc_ref[:] * correction + jax.lax.dot_general(
-        p, v, (((1,), (0,)), ((), ())), preferred_element_type=jnp.float32
-    )
-    m_ref[:] = jnp.broadcast_to(m_new, m_ref.shape)
-    l_ref[:] = jnp.broadcast_to(l_new, l_ref.shape)
+        l_new = l_ref[:, :1] * correction + jnp.sum(p, axis=-1, keepdims=True)
+        acc_ref[:] = acc_ref[:] * correction + jax.lax.dot_general(
+            p, v, (((1,), (0,)), ((), ())), preferred_element_type=jnp.float32
+        )
+        m_ref[:] = jnp.broadcast_to(m_new, m_ref.shape)
+        l_ref[:] = jnp.broadcast_to(l_new, l_ref.shape)
 
     @pl.when(ki == blocks_k - 1)
     def _():

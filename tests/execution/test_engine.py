@@ -140,3 +140,8 @@ def test_min_hosts_bound(cache_env, devices8):
     engine = make_engine(num_hosts=4, devices=devices8)
     engine.chips_per_host = 2
     assert engine.compute_min_hosts() >= 1
+
+
+def test_evaluate(trained_engine):
+    loss = trained_engine.evaluate(num_batches=2)
+    assert np.isfinite(loss) and 0 < loss < 20
