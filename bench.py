@@ -11,7 +11,51 @@ import os
 import time
 
 
+def _probe_device(timeout_s: int = 300) -> str | None:
+    """None if a trivial dispatch completes in a throwaway subprocess, else a
+    reason string.
+
+    Guards against a wedged TPU relay (a killed process can leave the chip
+    claim stuck — see .claude/skills/verify/SKILL.md): the hang sits inside
+    a native PJRT call Python signals cannot interrupt, so the probe is a
+    separate process. On timeout it is SIGTERM'd with a grace period first —
+    a hard SIGKILL mid-dispatch is itself a known relay-wedging action."""
+    import subprocess
+    import sys
+
+    proc = subprocess.Popen(
+        [sys.executable, "-c",
+         "import jax, jax.numpy as jnp;"
+         "print(float(jax.jit(lambda x: x + 1)(jnp.float32(0))))"],
+        stdout=subprocess.PIPE, stderr=subprocess.PIPE, text=True,
+    )
+    try:
+        _, err = proc.communicate(timeout=timeout_s)
+    except subprocess.TimeoutExpired:
+        proc.terminate()
+        try:
+            proc.wait(timeout=15)
+        except subprocess.TimeoutExpired:
+            proc.kill()
+        return f"device probe hung >{timeout_s}s (TPU relay wedged?)"
+    if proc.returncode != 0:
+        tail = (err or "").strip().splitlines()[-1:] or ["no stderr"]
+        return f"device probe failed (exit {proc.returncode}): {tail[0][:160]}"
+    return None
+
+
 def main():
+    reason = _probe_device()
+    if reason is not None:
+        print(json.dumps({
+            "metric": "tokens/sec/chip (gpt2 seq=1024 batch=8)",
+            "value": 0,
+            "unit": "tokens/s/chip",
+            "vs_baseline": 0,
+            "note": reason + "; see BENCH_BASELINE.json for the last good measurement",
+        }))
+        return
+
     import jax
 
     from oobleck_tpu.models import build_model

@@ -87,6 +87,9 @@ class ExecutionArguments:
     attention_impl: str = "auto"  # auto | xla | pallas | ring
     checkpoint_dir: str | None = None
     checkpoint_interval: int = 0  # steps; 0 disables
+    # Fraction of the dataset reserved as a held-out tail for evaluate();
+    # 0 trains on the full dataset (reference behavior).
+    eval_fraction: float = 0.0
 
 
 @dataclass

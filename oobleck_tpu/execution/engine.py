@@ -451,10 +451,8 @@ class OobleckEngine:
 
     # ------------------------------------------------------------------ #
 
-    EVAL_FRACTION = 0.1  # dataset tail reserved for evaluation
-
     def _eval_reserve(self) -> int:
-        return max(1, int(len(self.dataset) * self.EVAL_FRACTION))
+        return int(len(self.dataset) * self.args.execution.eval_fraction)
 
     def evaluate(self, num_batches: int = 8) -> float:
         """Forward-only mean loss over the held-out dataset tail (the

@@ -231,6 +231,20 @@ class PipelineInstance:
 
     # ------------------------------------------------------------------ #
 
+    def _place_tokens(self, batch: np.ndarray):
+        """Per-microbatch token placement: stage 0 consumes tokens (embed),
+        the last stage consumes them again (loss). Shared by train/eval."""
+        S, M = self.num_stages, batch.shape[0]
+        first_st, last_st = self.stages[0], self.stages[-1]
+        tokens_first = [
+            jax.device_put(batch[m], first_st.batch_sharding) for m in range(M)
+        ]
+        tokens_last = (
+            tokens_first if S == 1 else
+            [jax.device_put(batch[m], last_st.batch_sharding) for m in range(M)]
+        )
+        return tokens_first, tokens_last
+
     def train_step(self, batch: np.ndarray):
         """One iteration over this pipeline's microbatches.
 
@@ -242,16 +256,7 @@ class PipelineInstance:
         assert batch.shape[0] == self.num_microbatches, batch.shape
         S, M = self.num_stages, self.num_microbatches
         streams = [deque(s) for s in all_instructions(S, M)]
-        first_st, last_st = self.stages[0], self.stages[-1]
-
-        # Tokens live where they are consumed: stage 0 (embed) + last (loss).
-        tokens_first = [
-            jax.device_put(batch[m], first_st.batch_sharding) for m in range(M)
-        ]
-        tokens_last = (
-            tokens_first if S == 1 else
-            [jax.device_put(batch[m], last_st.batch_sharding) for m in range(M)]
-        )
+        tokens_first, tokens_last = self._place_tokens(batch)
 
         acts: dict[tuple[int, int], Any] = {}    # (stage, mb) -> input act
         gacts: dict[tuple[int, int], Any] = {}   # (stage, mb) -> output grad
@@ -345,20 +350,15 @@ class PipelineInstance:
         """Forward-only loss over this pipeline's microbatches (no backward
         instructions, no gradient memory); returns the mean loss."""
         S, M = self.num_stages, batch.shape[0]
-        first_st, last_st = self.stages[0], self.stages[-1]
+        tokens_first, tokens_last = self._place_tokens(batch)
         losses = []
         for m in range(M):
-            tokens_first = jax.device_put(batch[m], first_st.batch_sharding)
-            tokens_last = (
-                tokens_first if S == 1
-                else jax.device_put(batch[m], last_st.batch_sharding)
-            )
             x = None
             for st in self.stages:
                 is_first = st.stage_index == 0
                 is_last = st.stage_index == S - 1
-                tokens = tokens_first if is_first else (
-                    tokens_last if is_last else None
+                tokens = tokens_first[m] if is_first else (
+                    tokens_last[m] if is_last else None
                 )
                 out = st.fwd(tuple(self.params[li] for li in st.layer_ids),
                              x, tokens)

@@ -143,5 +143,12 @@ def test_min_hosts_bound(cache_env, devices8):
 
 
 def test_evaluate(trained_engine):
+    # Default eval_fraction=0: evaluate still works (overlap warning path).
     loss = trained_engine.evaluate(num_batches=2)
     assert np.isfinite(loss) and 0 < loss < 20
+    # With a reserve configured, training covers only the head split.
+    trained_engine.args.execution.eval_fraction = 0.1
+    assert trained_engine._eval_reserve() == int(
+        len(trained_engine.dataset) * 0.1
+    )
+    trained_engine.args.execution.eval_fraction = 0.0
