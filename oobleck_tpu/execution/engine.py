@@ -401,7 +401,8 @@ class OobleckEngine:
                 logger.info("step %d/%d loss %.4f", self.step, max_steps, loss)
                 if self.step % 10 == 0:
                     timers = sync_timers()
-                    logger.info("step timer: %s", timers.get("step"))
+                    logger.info("step timer: %s | %s",
+                                timers.get("step"), _device_memory_summary())
                 if interval and self.step % interval == 0:
                     self.save_checkpoint()
             if interval and self.step % interval != 0:
@@ -581,6 +582,22 @@ class OobleckEngine:
             "reconfigured after losing %s in %.2fs: %s",
             lost_ip, time.perf_counter() - t0, plan,
         )
+
+
+def _device_memory_summary() -> str:
+    """Peak/in-use device memory (reference logs CUDA memory every 10 steps,
+    engine.py:657-659); CPU backends report no stats."""
+    try:
+        # local_devices: on multi-host, devices()[0] is process 0's chip and
+        # is non-addressable from other workers.
+        stats = jax.local_devices()[0].memory_stats() or {}
+        used = stats.get("bytes_in_use", 0)
+        peak = stats.get("peak_bytes_in_use", used)
+        limit = stats.get("bytes_limit", 0)
+        return (f"mem {used / 2**30:.2f}GiB (peak {peak / 2**30:.2f}"
+                f"{f' / limit {limit / 2**30:.0f}' if limit else ''}GiB)")
+    except Exception:
+        return "mem n/a"
 
 
 def _place_opt_state(optimizer, state, param_sharding_tree):
