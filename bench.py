@@ -2,16 +2,26 @@
 
 Prints ONE JSON line: {"metric", "value", "unit", "vs_baseline"}.
 The reference repo publishes no numbers (see BASELINE.md); vs_baseline is
-measured against the round-1 recorded value in BENCH_BASELINE.json when
-present, else 1.0.
+measured against the recorded value in BENCH_BASELINE.json when present,
+else 1.0.
+
+A wedged axon TPU relay hangs every dispatch inside native PJRT code
+(uninterruptible from Python), so the device is probed in a throwaway
+subprocess with bounded retries; if the relay never recovers the benchmark
+re-runs itself on the CPU backend rather than recording zero (the round-1
+failure mode), with the degradation spelled out in the "note" field.
 """
 
 import json
 import os
+import subprocess
+import sys
 import time
 
+_INNER_ENV = "_OOBLECK_BENCH_INNER"
 
-def _probe_device(timeout_s: int = 300) -> str | None:
+
+def _probe_device(timeout_s: int) -> str | None:
     """None if a trivial dispatch completes in a throwaway subprocess, else a
     reason string.
 
@@ -20,9 +30,6 @@ def _probe_device(timeout_s: int = 300) -> str | None:
     a native PJRT call Python signals cannot interrupt, so the probe is a
     separate process. On timeout it is SIGTERM'd with a grace period first —
     a hard SIGKILL mid-dispatch is itself a known relay-wedging action."""
-    import subprocess
-    import sys
-
     proc = subprocess.Popen(
         [sys.executable, "-c",
          "import jax, jax.numpy as jnp;"
@@ -44,18 +51,16 @@ def _probe_device(timeout_s: int = 300) -> str | None:
     return None
 
 
-def main():
-    reason = _probe_device()
-    if reason is not None:
-        print(json.dumps({
-            "metric": "tokens/sec/chip (gpt2 seq=1024 batch=8)",
-            "value": 0,
-            "unit": "tokens/s/chip",
-            "vs_baseline": 0,
-            "note": reason + "; see BENCH_BASELINE.json for the last good measurement",
-        }))
-        return
+def _cpu_fallback_env() -> dict:
+    env = dict(os.environ)
+    env["JAX_PLATFORMS"] = "cpu"
+    env["PALLAS_AXON_POOL_IPS"] = ""
+    env[_INNER_ENV] = "1"
+    return env
 
+
+def _measure() -> dict:
+    """Run the benchmark in the current process and return the result dict."""
     import jax
 
     from oobleck_tpu.models import build_model
@@ -63,6 +68,7 @@ def main():
     from oobleck_tpu.parallel.train import build_train_step, make_optimizer
 
     n = len(jax.devices())
+    platform = jax.devices()[0].platform
     model_name = os.environ.get("BENCH_MODEL", "gpt2")
     seq = int(os.environ.get("BENCH_SEQ", "1024"))
     batch = int(os.environ.get("BENCH_BATCH", "8"))
@@ -100,12 +106,71 @@ def main():
         pass
     vs = tps_per_chip / baseline if baseline else 1.0
 
-    print(json.dumps({
+    result = {
         "metric": f"tokens/sec/chip ({model_name} {seq=} {batch=})",
         "value": round(tps_per_chip, 1),
         "unit": "tokens/s/chip",
         "vs_baseline": round(vs, 3),
-    }))
+    }
+    if platform != "tpu":
+        result["platform"] = platform
+    return result
+
+
+def main():
+    if os.environ.get(_INNER_ENV) == "1":
+        print(json.dumps(_measure()))
+        return
+
+    # Bounded retry with backoff: a transiently wedged relay often clears
+    # within minutes; a hard-wedged one does not (can stay stuck for hours).
+    reasons = []
+    for timeout_s, backoff_s in ((120, 30), (180, 60), (240, 0)):
+        reason = _probe_device(timeout_s)
+        if reason is None:
+            break
+        reasons.append(reason)
+        if backoff_s:
+            time.sleep(backoff_s)
+    else:
+        # Device unreachable after every retry: measure on the CPU backend in
+        # a scrubbed-env subprocess instead of recording zero.
+        model_name = os.environ.get("BENCH_MODEL", "gpt2")
+        seq = os.environ.get("BENCH_SEQ", "1024")
+        batch = os.environ.get("BENCH_BATCH", "8")
+        metric = f"tokens/sec/chip ({model_name} seq={seq} batch={batch})"
+        proc = None
+        try:
+            proc = subprocess.run(
+                [sys.executable, os.path.abspath(__file__)],
+                env=_cpu_fallback_env(),
+                capture_output=True, text=True, timeout=1800,
+            )
+            result = json.loads(proc.stdout.strip().splitlines()[-1])
+        except Exception as exc:
+            stderr = getattr(exc, "stderr", None)
+            if stderr is None and proc is not None:
+                stderr = proc.stderr
+            if isinstance(stderr, bytes):
+                stderr = stderr.decode(errors="replace")
+            result = {
+                "metric": metric,
+                "value": 0, "unit": "tokens/s/chip", "vs_baseline": 0,
+                "note": f"CPU fallback also failed ({type(exc).__name__}): "
+                        + (stderr or "").strip()[-200:],
+            }
+            print(json.dumps(result))
+            return
+        result["note"] = (
+            "TPU unreachable after 3 probe attempts ("
+            + "; ".join(reasons)
+            + ") — value measured on CPU fallback backend, NOT TPU; see "
+              "BENCH_BASELINE.json for the last good TPU measurement"
+        )
+        print(json.dumps(result))
+        return
+
+    print(json.dumps(_measure()))
 
 
 if __name__ == "__main__":

@@ -56,6 +56,16 @@ class OobleckSampler:
         self.shuffle = shuffle
         self.seed = seed
         self.bucket_size = microbatch_size * sum(num_microbatches)
+        if num_samples < self.bucket_size:
+            # next_iteration() would slice past the index array and emit
+            # short/empty microbatches that surface later as jit shape
+            # errors; fail here with the actual arithmetic instead.
+            raise ValueError(
+                f"dataset of {num_samples} samples cannot fill one iteration "
+                f"bucket of {self.bucket_size} "
+                f"(= microbatch_size {microbatch_size} x "
+                f"sum(num_microbatches) {sum(num_microbatches)})"
+            )
 
     def iterations_per_epoch(self) -> int:
         return self.num_samples // self.bucket_size

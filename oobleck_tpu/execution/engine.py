@@ -150,8 +150,13 @@ class OobleckEngine:
             args.model.model_name, args.model.model_tag, args.job.microbatch_size
         )
 
-        # Cluster geometry: hosts partition the device list.
+        # Cluster geometry: hosts partition the device list. Ranks encode
+        # ORIGINAL host indices (rank = original_index * chips_per_host +
+        # local), and self.devices never shrinks — so lost-host lookups must
+        # use this immutable map, never .index() on the shrinking host_ips
+        # list (a second failure would resolve to the wrong host).
         self.host_ips = list(args.dist.node_ips)
+        self._host_index = {ip: i for i, ip in enumerate(self.host_ips)}
         self.devices: list | None = None
         self.chips_per_host: int | None = None
         self.templates: list[PipelineTemplate] = []
@@ -421,12 +426,34 @@ class OobleckEngine:
                 opt.setdefault(li, self.opt_states[pipe.pipeline_id][li])
         return params, opt
 
+    def _sync_replicas(self) -> None:
+        """Re-broadcast each DP-replicated layer from its canonical owner
+        (the first pipeline holding it) to every other owner, bounding the
+        bit-wise replica drift that accumulates from different per-mesh
+        reduction orders (reference _copy_model_states broadcasts from an
+        owner, engine.py:238-309; here a cross-mesh device_put)."""
+        if not self.dp_engine:
+            return
+        for li, owners in self.dp_engine.owners.items():
+            if len(owners) <= 1:
+                continue
+            anchor = owners[0]
+            for other in owners[1:]:
+                dst = other.stages[other.stage_of_layer(li)].param_shardings[li]
+                other.params[li] = jax.device_put(anchor.params[li], dst)
+                self.opt_states[other.pipeline_id][li] = _place_opt_state(
+                    self.optimizer,
+                    self.opt_states[anchor.pipeline_id][li],
+                    dst,
+                )
+
     def save_checkpoint(self) -> None:
         from oobleck_tpu.execution.checkpoint import save_checkpoint
 
         ckpt_dir = self.args.execution.checkpoint_dir
         if not ckpt_dir:
             return
+        self._sync_replicas()
         params, opt = self._collect_layer_state()
         save_checkpoint(
             ckpt_dir, step=self.step, params=params, opt_state=opt,
@@ -522,9 +549,9 @@ class OobleckEngine:
         if lost_ip not in self.host_ips:
             logger.warning("unknown lost host %s", lost_ip)
             return
-        lost_host = self.host_ips.index(lost_ip)
+        lost_host = self._host_index[lost_ip]
 
-        # Current per-pipeline host lists (ranks -> hosts).
+        # Current per-pipeline host lists (ranks -> ORIGINAL host indices).
         current = [
             sorted({r // self.chips_per_host for r in p.ranks})
             for p in self.pipelines
@@ -532,27 +559,55 @@ class OobleckEngine:
         min_hosts = min(t.num_hosts for t in self.templates)
         new_hosts = reconfigure_hosts(current, {lost_host}, min_hosts)
 
-        # Match each host set to the template of its size (reference
-        # engine.py:92-102); sizes beyond the largest template are trimmed
-        # back into the pool via the template map.
+        # Match each host group to the largest template it can fill
+        # (reference engine.py:92-102). Hosts beyond a group's template size
+        # are NOT silently dropped (round-1 advisor finding): the surplus is
+        # re-folded — first into extra pipelines, then by growing existing
+        # groups to the next feasible template size — and anything truly
+        # unplaceable is logged.
         by_hosts = {t.num_hosts: t for t in self.templates}
+        sizes = sorted(by_hosts)
+        fitted: list[list[int]] = []
+        surplus: list[int] = []
+        for hosts in new_hosts:
+            fit = max((s for s in sizes if s <= len(hosts)), default=0)
+            if fit == 0:
+                surplus.extend(hosts)
+                continue
+            fitted.append(list(hosts[:fit]))
+            surplus.extend(hosts[fit:])
+        while surplus:
+            new_size = max((s for s in sizes if s <= len(surplus)), default=0)
+            if new_size:
+                fitted.append(surplus[:new_size])
+                surplus = surplus[new_size:]
+                continue
+            grown = False
+            for g in sorted(fitted, key=len):
+                bigger = [s for s in sizes
+                          if s > len(g) and s - len(g) <= len(surplus)]
+                if bigger:
+                    need = bigger[0] - len(g)
+                    g.extend(surplus[:need])
+                    surplus = surplus[need:]
+                    grown = True
+                    break
+            if not grown:
+                break
+        if surplus:
+            logger.warning(
+                "hosts %s idle after reconfiguration: no template extension "
+                "fits them (feasible sizes %s)", surplus, sizes,
+            )
+        if not fitted:
+            raise RuntimeError(
+                f"no template fits any surviving host group (sizes {sizes})"
+            )
+        new_hosts = fitted
         new_instances: dict[PipelineTemplate, int] = {}
         for hosts in new_hosts:
-            n = len(hosts)
-            while n > 0 and n not in by_hosts:
-                n -= 1
-            if n == 0:
-                raise RuntimeError(f"no template fits {len(hosts)} hosts")
-            t = by_hosts[n]
+            t = by_hosts[len(hosts)]
             new_instances[t] = new_instances.get(t, 0) + 1
-        # Trim host lists to their template size.
-        trimmed = []
-        for hosts in new_hosts:
-            n = len(hosts)
-            while n > 0 and n not in by_hosts:
-                n -= 1
-            trimmed.append(hosts[:n])
-        new_hosts = trimmed
 
         ar_across = [p.allreduce_across_hosts for p in self.profiles]
         plan = PipelineInstantiator().get_new_execution_plan(
@@ -569,14 +624,19 @@ class OobleckEngine:
         epoch = self.dataloaders[0].epoch
 
         self.host_ips.remove(lost_ip)
-        # Devices of the lost host are gone: order plan pipelines by the
-        # host assignment we computed.
         self.plan = plan
-        # Sort assignments to match host list ordering deterministically.
-        new_hosts_sorted = sorted(new_hosts, key=len)
+        # Pair each plan instance with a host group of exactly its size —
+        # explicit matching rather than relying on two separate sorts
+        # (plan.instances' canonical order vs a host-list sort) agreeing.
+        groups_by_size: dict[int, list[list[int]]] = {}
+        for g in new_hosts:
+            groups_by_size.setdefault(len(g), []).append(g)
+        host_assignment = [
+            groups_by_size[t.num_hosts].pop(0) for t in plan.instances
+        ]
         self._materialize_plan(
             plan, it_done, epoch, old_params, old_opt,
-            host_assignment=new_hosts_sorted,
+            host_assignment=host_assignment,
         )
         logger.warning(
             "reconfigured after losing %s in %.2fs: %s",
