@@ -75,12 +75,27 @@ class ModelArguments:
 
 @dataclass
 class ExecutionArguments:
-    """TPU-specific execution knobs (no reference counterpart)."""
+    """TPU-specific execution knobs (no reference counterpart).
 
-    # Mesh axis sizes; -1 means "infer from device count".
+    Every knob here is consumed by the engine:
+      * MPMD path: `tensor_parallel`/`fsdp` factor each stage's chips into a
+        (fsdp, tensor) stage mesh; `num_stages` filters the feasible pipeline
+        templates; `precision`/`remat`/`attention_impl` override model config.
+      * Fused path (`engine_path: fused`, or `auto` with
+        sequence_parallel > 1): one global mesh
+        (data, stage, fsdp, seq, tensor) runs the compiled SPMD train step
+        (parallel/train.py) — required for sequence parallelism.
+    """
+
+    # Which execution path drives training: "mpmd" (per-stage jits +
+    # 1F1B interpreter, supports heterogeneous pipelines), "fused" (one
+    # compiled SPMD program over a global mesh), or "auto" (fused when
+    # sequence_parallel > 1, mpmd otherwise).
+    engine_path: str = "auto"
+    # Mesh axis sizes; -1 means "infer".
     num_stages: int = -1          # pipeline-parallel degree (per pipeline)
     tensor_parallel: int = 1      # intra-op model sharding degree
-    fsdp: int = 1                 # parameter-sharding degree within a stage
+    fsdp: int = -1                # param-sharding degree within a stage (-1: remaining chips)
     sequence_parallel: int = 1    # ring-attention / context-parallel degree
     precision: str = "bfloat16"   # activation/compute dtype
     remat: bool = True            # rematerialize per-layer activations
@@ -90,6 +105,22 @@ class ExecutionArguments:
     # Fraction of the dataset reserved as a held-out tail for evaluate();
     # 0 trains on the full dataset (reference behavior).
     eval_fraction: float = 0.0
+
+    def __post_init__(self) -> None:
+        if self.engine_path not in ("auto", "mpmd", "fused"):
+            raise ValueError(
+                f"engine_path must be auto|mpmd|fused, got {self.engine_path!r}"
+            )
+        if self.sequence_parallel > 1 and self.engine_path == "mpmd":
+            raise ValueError(
+                "sequence_parallel > 1 requires the fused path "
+                "(engine_path: auto or fused)"
+            )
+
+    def resolved_path(self) -> str:
+        if self.engine_path != "auto":
+            return self.engine_path
+        return "fused" if self.sequence_parallel > 1 else "mpmd"
 
 
 @dataclass

@@ -102,13 +102,19 @@ class OobleckAgent:
     def ensure_profile(self) -> None:
         """Profile-on-miss (reference _launch_workers, agent.py:112-134)."""
         assert self.args is not None
-        from oobleck_tpu.planning.profiler import get_profile_path, profile
+        from oobleck_tpu.planning.profiler import (
+            effective_tag,
+            get_profile_path,
+            profile,
+        )
 
         m = self.args.model
-        path = get_profile_path(m.model_name, m.model_tag)
+        ex = self.args.execution
+        path = get_profile_path(m.model_name, effective_tag(m.model_tag, ex))
         if not (path / f"mb{self.args.job.microbatch_size}.json").exists():
             logger.info("profile missing for %s; profiling now", m.model_name)
             profile(m.model_name, m.model_args, model_tag=m.model_tag,
+                    execution=ex,
                     microbatch_size=self.args.job.microbatch_size)
 
     def launch_worker(self) -> None:

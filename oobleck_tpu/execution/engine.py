@@ -126,7 +126,8 @@ class OobleckEngine:
         self.agent_pipe = agent_pipe
         self._injected_devices = devices
 
-        self.model = build_model(args.model.model_name, args.model.model_args)
+        self.model = build_model(args.model.model_name, args.model.model_args,
+                                 execution=args.execution)
         if not getattr(self.model, "engine_compatible", True):
             raise NotImplementedError(
                 f"{args.model.model_name} trains through the model-level API "
@@ -143,11 +144,16 @@ class OobleckEngine:
         )
 
         # Planning inputs (profile-on-miss mirrors agent.ensure_profile).
+        # The profiled model carries the same execution overrides as the
+        # trained one — a bf16 profile must not plan an f32 run.
+        from oobleck_tpu.planning.profiler import effective_tag
+
+        tag = effective_tag(args.model.model_tag, args.execution)
         profile(args.model.model_name, args.model.model_args,
-                model_tag=args.model.model_tag,
+                model_tag=args.model.model_tag, execution=args.execution,
                 microbatch_size=args.job.microbatch_size, seq_len=seq_len)
         self.profiles = load_profile(
-            args.model.model_name, args.model.model_tag, args.job.microbatch_size
+            args.model.model_name, tag, args.job.microbatch_size
         )
 
         # Cluster geometry: hosts partition the device list. Ranks encode
@@ -161,6 +167,8 @@ class OobleckEngine:
         self.chips_per_host: int | None = None
         self.templates: list[PipelineTemplate] = []
         self.pipelines: list[PipelineInstance] = []
+        self.fused = None                    # FusedPipeline when engine_path=fused
+        self._fused_hosts: list[int] = []    # surviving ORIGINAL host indices
         self.dataloaders: list[OobleckDataLoader] = []
         self.opt_states: dict[int, dict[int, Any]] = {}
         self.plan: HeterogeneousPlan | None = None
@@ -210,16 +218,48 @@ class OobleckEngine:
             )
         self.chips_per_host = len(self.devices) // n_hosts
 
+        if self.args.execution.resolved_path() == "fused":
+            # Fused path: one global mesh instead of per-pipeline templates;
+            # geometry comes from ExecutionArguments at instantiation time.
+            self._fused_hosts = list(range(n_hosts))
+            return
+
         min_hosts = self.compute_min_hosts()
         gen = TemplateGenerator()
-        self.templates = gen.create_pipeline_templates(
-            self.profiles, (min_hosts, n_hosts), self.chips_per_host
-        )
+        tp = self.args.execution.tensor_parallel
+        if tp > 1:
+            # TP groups are the planning unit: templates are generated over
+            # chips_per_host // tp "chip groups" and scaled back, so every
+            # stage's chip count is a multiple of the TP degree.
+            if self.chips_per_host % tp != 0:
+                raise ValueError(
+                    f"chips_per_host={self.chips_per_host} not divisible by "
+                    f"tensor_parallel={tp}"
+                )
+            base = gen.create_pipeline_templates(
+                self.profiles, (min_hosts, n_hosts), self.chips_per_host // tp
+            )
+            self.templates = [_scale_template_chips(t, tp) for t in base]
+        else:
+            self.templates = gen.create_pipeline_templates(
+                self.profiles, (min_hosts, n_hosts), self.chips_per_host
+            )
         if not self.templates:
             raise RuntimeError(
                 f"no feasible pipeline templates for hosts in "
                 f"[{min_hosts}, {n_hosts}] x {self.chips_per_host} chips"
             )
+        num_stages = self.args.execution.num_stages
+        if num_stages > 0:
+            filtered = [t for t in self.templates
+                        if len(t.stages) == num_stages]
+            if not filtered:
+                raise RuntimeError(
+                    f"execution.num_stages={num_stages} matches no feasible "
+                    f"template (stage counts available: "
+                    f"{sorted({len(t.stages) for t in self.templates})})"
+                )
+            self.templates = filtered
         logger.info("templates for host counts %s",
                     [t.num_hosts for t in self.templates])
 
@@ -284,11 +324,6 @@ class OobleckEngine:
 
     def instantiate_pipelines(self, global_num_microbatch: int,
                               num_iterations_done: int = 0, epoch: int = 0) -> None:
-        ar_across = [p.allreduce_across_hosts for p in self.profiles]
-        self.plan = PipelineInstantiator().get_best_execution_plan(
-            self.templates, ar_across, len(self.host_ips), global_num_microbatch
-        )
-        logger.info("execution plan: %s", self.plan)
         old_params = old_opt = None
         restored = self.try_restore_checkpoint()
         if restored is not None:
@@ -304,8 +339,123 @@ class OobleckEngine:
             self.step = int(meta["step"])
             num_iterations_done = int(meta["num_iterations_done"])
             epoch = int(meta["epoch"])
+
+        if self.args.execution.resolved_path() == "fused":
+            payload = None
+            if restored is not None:
+                payload = {"params": old_params, "opt": old_opt,
+                           "meta": {"step": self.step}}
+            self._materialize_fused(global_num_microbatch,
+                                    num_iterations_done, epoch, payload)
+            return
+
+        ar_across = [p.allreduce_across_hosts for p in self.profiles]
+        self.plan = PipelineInstantiator().get_best_execution_plan(
+            self.templates, ar_across, len(self.host_ips), global_num_microbatch
+        )
+        logger.info("execution plan: %s", self.plan)
         self._materialize_plan(self.plan, num_iterations_done, epoch,
                                old_params=old_params, old_opt=old_opt)
+
+    def _fused_devices(self) -> list:
+        return [
+            d
+            for h in self._fused_hosts
+            for d in self.devices[h * self.chips_per_host:
+                                  (h + 1) * self.chips_per_host]
+        ]
+
+    def _fused_mesh(self, devices: list, *, shrink_to_fit: bool):
+        """Resolve ExecutionArguments into a global fused mesh over `devices`.
+
+        fsdp=-1 means "the chips left after stage*tensor*seq" (ZeRO-style
+        param sharding, matching the MPMD meaning of -1); data absorbs any
+        explicit-fsdp remainder. The fused step shards each microbatch's
+        sample dim over (data, fsdp), so microbatch_size must divide by
+        their product — a config error at startup, but during recovery
+        (`shrink_to_fit`) the mesh drops chips instead of crashing the
+        training loop it exists to save."""
+        from oobleck_tpu.parallel.mesh import MeshShape, make_mesh
+
+        ex = self.args.execution
+        mb = self.args.job.microbatch_size
+        stage = ex.num_stages if ex.num_stages > 0 else 1
+        base = stage * ex.tensor_parallel * ex.sequence_parallel
+        if len(devices) < base:
+            raise RuntimeError(
+                f"{len(devices)} devices cannot fit stage*tensor*seq={base}"
+            )
+        if self.seq_len % ex.sequence_parallel != 0:
+            raise ValueError(
+                f"seq_len={self.seq_len} not divisible by "
+                f"sequence_parallel={ex.sequence_parallel}"
+            )
+        if ex.fsdp > 0:
+            fsdp = ex.fsdp
+            data = len(devices) // (base * fsdp)
+            if data < 1:
+                raise RuntimeError(
+                    f"{len(devices)} devices cannot fit "
+                    f"stage*tensor*seq*fsdp={base * fsdp}"
+                )
+        else:
+            fsdp = len(devices) // base
+            data = 1
+        if mb % (data * fsdp) != 0:
+            if not shrink_to_fit:
+                raise ValueError(
+                    f"microbatch_size={mb} not divisible by data*fsdp="
+                    f"{data * fsdp}: the fused path shards each microbatch's "
+                    "sample dim over (data, fsdp); raise microbatch_size or "
+                    "pin more devices to stage/tensor/seq via "
+                    "ExecutionArguments"
+                )
+            d = next((d for d in range(data, 0, -1)
+                      if mb % (d * fsdp) == 0), 0)
+            if d:
+                data = d
+            elif ex.fsdp <= 0:
+                fsdp = next(f for f in range(fsdp, 0, -1) if mb % f == 0)
+                data = 1
+            else:
+                raise RuntimeError(
+                    f"microbatch_size={mb} not divisible by explicit "
+                    f"fsdp={fsdp}; cannot build a runnable recovery mesh"
+                )
+        used = data * fsdp * base
+        if used < len(devices):
+            logger.warning(
+                "fused mesh uses %d of %d devices", used, len(devices)
+            )
+        shape = MeshShape(data=data, stage=stage, fsdp=fsdp,
+                          seq=ex.sequence_parallel, tensor=ex.tensor_parallel)
+        return make_mesh(shape, devices[:used])
+
+    def _materialize_fused(self, global_num_microbatch: int,
+                           num_iterations_done: int, epoch: int,
+                           restored: dict | None) -> None:
+        from oobleck_tpu.execution.fused import FusedPipeline
+
+        mesh = self._fused_mesh(self._fused_devices(), shrink_to_fit=False)
+        logger.info("fused mesh: %s", dict(mesh.shape))
+        self.fused = FusedPipeline(
+            self.model, mesh, num_microbatches=global_num_microbatch,
+            microbatch_size=self.args.job.microbatch_size,
+            seq_len=self.seq_len, optimizer=self.optimizer,
+            restored=restored,
+        )
+        train_samples = len(self.dataset) - self._eval_reserve()
+        sampler = OobleckSampler(
+            num_samples=train_samples,
+            microbatch_size=self.args.job.microbatch_size,
+            pipeline_index=0,
+            num_microbatches=[global_num_microbatch],
+            num_iterations_done=num_iterations_done,
+            epoch=epoch,
+        )
+        self.dataloaders = [OobleckDataLoader(self.dataset, sampler)]
+        self.pipelines = []
+        self.dp_engine = None
 
     def _materialize_plan(self, plan: HeterogeneousPlan, num_iterations_done,
                           epoch, old_params, old_opt,
@@ -335,6 +485,8 @@ class OobleckEngine:
                 seq_len=self.seq_len,
                 params=old_params,
                 exec_cache=self._exec_cache,
+                tensor_parallel=self.args.execution.tensor_parallel,
+                fsdp=self.args.execution.fsdp,
             )
             self.pipelines.append(pipe)
             # Train over the head split only; the tail is evaluate()'s
@@ -369,6 +521,12 @@ class OobleckEngine:
     @measure_time("step")
     def _train_step(self) -> float:
         from oobleck_tpu.utils.tracing import annotate
+
+        if self.fused is not None:
+            with annotate("fused_step"):
+                loss = self.fused.train_step(self.dataloaders[0].next_batch())
+            self.step += 1
+            return float(loss)
 
         losses = []
         weights = []
@@ -453,8 +611,11 @@ class OobleckEngine:
         ckpt_dir = self.args.execution.checkpoint_dir
         if not ckpt_dir:
             return
-        self._sync_replicas()
-        params, opt = self._collect_layer_state()
+        if self.fused is not None:
+            params, opt = self.fused.layer_state()
+        else:
+            self._sync_replicas()
+            params, opt = self._collect_layer_state()
         save_checkpoint(
             ckpt_dir, step=self.step, params=params, opt_state=opt,
             num_iterations_done=self.dataloaders[0].num_iterations_done,
@@ -490,8 +651,9 @@ class OobleckEngine:
         eval bucket exceeds the reserve, the window extends into the
         training tail out of necessity (tiny datasets) — logged."""
         n = len(self.dataset)
-        bucket = self.args.job.microbatch_size * sum(
-            p.num_microbatches for p in self.pipelines
+        bucket = self.args.job.microbatch_size * (
+            self.fused.num_microbatches if self.fused is not None
+            else sum(p.num_microbatches for p in self.pipelines)
         )
         eval_n = self._eval_reserve()
         if eval_n < bucket:
@@ -515,6 +677,18 @@ class OobleckEngine:
         tail = _Tail(self.dataset)
         loss_sum = 0.0
         weight_sum = 0
+        if self.fused is not None:
+            sampler = OobleckSampler(
+                num_samples=len(tail),
+                microbatch_size=self.args.job.microbatch_size,
+                pipeline_index=0,
+                num_microbatches=[self.fused.num_microbatches],
+            )
+            dl = OobleckDataLoader(tail, sampler)
+            for _ in range(max(1, num_batches)):
+                loss_sum += float(self.fused.eval_step(dl.next_batch()))
+                weight_sum += 1
+            return loss_sum / weight_sum
         for pipe in self.pipelines:
             sampler = OobleckSampler(
                 num_samples=len(tail),
@@ -550,6 +724,9 @@ class OobleckEngine:
             logger.warning("unknown lost host %s", lost_ip)
             return
         lost_host = self._host_index[lost_ip]
+        if self.fused is not None:
+            self._reconfigure_fused(lost_ip, lost_host, t0)
+            return
 
         # Current per-pipeline host lists (ranks -> ORIGINAL host indices).
         current = [
@@ -642,6 +819,31 @@ class OobleckEngine:
             "reconfigured after losing %s in %.2fs: %s",
             lost_ip, time.perf_counter() - t0, plan,
         )
+
+    def _reconfigure_fused(self, lost_ip: str, lost_host: int, t0: float) -> None:
+        """Fused-path recovery: shrink the global mesh to the surviving
+        chips and re-place the live TrainState on it (the sharded-state
+        analog of the reference's template re-match + weight copy)."""
+        self._fused_hosts.remove(lost_host)
+        self.host_ips.remove(lost_ip)
+        mesh = self._fused_mesh(self._fused_devices(), shrink_to_fit=True)
+        self.fused = self.fused.replace_mesh(mesh)
+        logger.warning(
+            "reconfigured (fused) after losing %s in %.2fs: mesh %s",
+            lost_ip, time.perf_counter() - t0, dict(mesh.shape),
+        )
+
+
+def _scale_template_chips(t: PipelineTemplate, tp: int) -> PipelineTemplate:
+    """Scale a template generated over TP chip-groups back to real chips."""
+    import dataclasses
+
+    stages = tuple(
+        dataclasses.replace(s, num_chips=s.num_chips * tp) for s in t.stages
+    )
+    return dataclasses.replace(
+        t, stages=stages, chips_per_host=t.chips_per_host * tp
+    )
 
 
 def _device_memory_summary() -> str:

@@ -1,0 +1,268 @@
+"""Fused-path engine adapter: the compiled SPMD train step as a pipeline.
+
+Routes the product surface (CLI -> master -> engine) onto the fused SPMD
+program (parallel/train.py) when ExecutionArguments selects it — the path
+that carries sequence parallelism / ring attention, which the per-stage MPMD
+interpreter cannot express (the ring collective spans the whole sequence).
+
+The adapter speaks the engine's pipeline dialect (train_step/eval_step over
+[num_microbatches, microbatch, seq] token batches) and converts between the
+fused TrainState (blocks stacked on a leading layer axis) and the engine's
+layer-keyed checkpoint format, so checkpoints written by either execution
+path restore into the other (capability the reference lacks entirely —
+/root/reference/README.md:103 has no checkpointing at all).
+"""
+
+from __future__ import annotations
+
+import logging
+from typing import Any
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import optax
+
+from oobleck_tpu.models.base import stack_layer_params
+from oobleck_tpu.parallel.train import TrainState, build_train_step
+
+logger = logging.getLogger("oobleck.fused")
+
+
+# --------------------------------------------------------------------- #
+# stacked <-> layer-keyed state conversion                               #
+# --------------------------------------------------------------------- #
+
+def params_to_layers(model, params) -> dict[int, Any]:
+    """Stacked fused params -> {layer_index: params_tree} (checkpoint form)."""
+    last = model.num_pipeline_layers - 1
+    out = {0: params["embed"], last: params["head"]}
+    for i in range(model.config.num_layers):
+        out[i + 1] = jax.tree.map(lambda x: x[i], params["blocks"])
+    return out
+
+
+def layers_to_params(model, layers: dict[int, Any]):
+    """Inverse of params_to_layers."""
+    last = model.num_pipeline_layers - 1
+    blocks = stack_layer_params(
+        [layers[i + 1] for i in range(model.config.num_layers)]
+    )
+    return {"embed": layers[0], "blocks": blocks, "head": layers[last]}
+
+
+def _param_leaf_labels(optimizer, params):
+    """Flatten-aligned metadata for an optimizer state over `params`:
+    returns (labels, state_structure) where labels[i] is None for a
+    non-param state leaf and (group_key_path, leaf_index_within_params)
+    for a param-shaped leaf (mu/nu mirrors)."""
+    state_shape = jax.eval_shape(optimizer.init, params)
+    n_leaves = len(jax.tree.leaves(params))
+    index_tree = jax.tree.unflatten(jax.tree.structure(params), range(n_leaves))
+    labeled = optax.tree_map_params(
+        optimizer,
+        lambda _leaf, idx: _Label(idx),
+        state_shape,
+        index_tree,
+        transform_non_params=lambda _leaf: _Label(None),
+    )
+    labels = [l.value for l in jax.tree.leaves(
+        labeled, is_leaf=lambda x: isinstance(x, _Label)
+    )]
+    return labels, jax.tree.structure(state_shape)
+
+
+class _Label:
+    """Opaque leaf wrapper so tree flattening doesn't recurse into labels."""
+
+    def __init__(self, value):
+        self.value = value
+
+
+def opt_state_to_layers(model, optimizer, params, opt_state) -> dict[int, Any]:
+    """Fused (stacked) optimizer state -> per-layer optimizer states that
+    match `optimizer.init(layer_params)` structures exactly."""
+    layers = params_to_layers(model, params)
+    full_labels, _ = _param_leaf_labels(optimizer, params)
+    full_leaves = jax.tree.leaves(opt_state)
+    # Map param-leaf index (in full params flatten order) -> state leaf value.
+    param_state_leaf: dict[int, Any] = {
+        lab: leaf for lab, leaf in zip(full_labels, full_leaves)
+        if lab is not None
+    }
+    nonparam_leaves = [leaf for lab, leaf in zip(full_labels, full_leaves)
+                       if lab is None]
+
+    # Full-params flatten index of each (group, inner-leaf) position.
+    flat_params, params_struct = jax.tree.flatten(params)
+    del flat_params
+    n_leaves = len(jax.tree.leaves(params))
+    index_tree = jax.tree.unflatten(params_struct, range(n_leaves))
+    group_index = {
+        "embed": jax.tree.leaves(index_tree["embed"]),
+        "blocks": jax.tree.leaves(index_tree["blocks"]),
+        "head": jax.tree.leaves(index_tree["head"]),
+    }
+    last = model.num_pipeline_layers - 1
+
+    out: dict[int, Any] = {}
+    for li, lp in layers.items():
+        group = "embed" if li == 0 else "head" if li == last else "blocks"
+        slice_idx = None if group != "blocks" else li - 1
+        lab_layer, struct_layer = _param_leaf_labels(optimizer, lp)
+        it_nonparam = iter(nonparam_leaves)
+        leaves_layer = []
+        for lab in lab_layer:
+            if lab is None:
+                leaves_layer.append(next(it_nonparam))
+            else:
+                full_idx = group_index[group][lab]
+                leaf = param_state_leaf[full_idx]
+                if slice_idx is not None:
+                    leaf = leaf[slice_idx]
+                leaves_layer.append(leaf)
+        out[li] = jax.tree.unflatten(struct_layer, leaves_layer)
+    return out
+
+
+def opt_state_from_layers(model, optimizer, params, opt_layers: dict[int, Any]):
+    """Per-layer optimizer states -> one fused (stacked) optimizer state
+    matching `optimizer.init(params)` (params: stacked fused params)."""
+    full_labels, full_struct = _param_leaf_labels(optimizer, params)
+    n_leaves = len(jax.tree.leaves(params))
+    index_tree = jax.tree.unflatten(jax.tree.structure(params), range(n_leaves))
+    group_index = {
+        "embed": jax.tree.leaves(index_tree["embed"]),
+        "blocks": jax.tree.leaves(index_tree["blocks"]),
+        "head": jax.tree.leaves(index_tree["head"]),
+    }
+    last = model.num_pipeline_layers - 1
+    L = model.config.num_layers
+
+    # Per-layer param-leaf state values keyed by inner leaf index.
+    per_layer: dict[int, dict[int, Any]] = {}
+    nonparam_ref: list[Any] | None = None
+    for li, state in opt_layers.items():
+        group = "embed" if li == 0 else "head" if li == last else "blocks"
+        if group == "blocks":
+            lp_example = jax.tree.map(lambda x: x[0], params["blocks"])
+        else:
+            lp_example = params[group]
+        labels, _ = _param_leaf_labels(optimizer, lp_example)
+        leaves = jax.tree.leaves(state)
+        pl = {lab: leaf for lab, leaf in zip(labels, leaves) if lab is not None}
+        per_layer[li] = pl
+        if nonparam_ref is None:
+            nonparam_ref = [leaf for lab, leaf in zip(labels, leaves)
+                            if lab is None]
+
+    it_nonparam = iter(nonparam_ref or [])
+    # Inner-leaf index maps for each group (full-params flatten index ->
+    # position within the group's own flatten order).
+    inner_of = {
+        g: {full_idx: j for j, full_idx in enumerate(group_index[g])}
+        for g in group_index
+    }
+    leaves_full = []
+    for lab in full_labels:
+        if lab is None:
+            leaves_full.append(next(it_nonparam))
+            continue
+        if lab in inner_of["embed"]:
+            leaves_full.append(per_layer[0][inner_of["embed"][lab]])
+        elif lab in inner_of["head"]:
+            leaves_full.append(per_layer[last][inner_of["head"][lab]])
+        else:
+            j = inner_of["blocks"][lab]
+            leaves_full.append(
+                jnp.stack([per_layer[i + 1][j] for i in range(L)])
+            )
+    return jax.tree.unflatten(full_struct, leaves_full)
+
+
+# --------------------------------------------------------------------- #
+# adapter                                                                #
+# --------------------------------------------------------------------- #
+
+class FusedPipeline:
+    """One fused SPMD program over a global mesh, presented through the
+    engine's pipeline interface (train_step / eval_step over
+    [num_microbatches, microbatch, seq] batches)."""
+
+    pipeline_id = 0
+
+    def __init__(self, model, mesh, *, num_microbatches: int,
+                 microbatch_size: int, seq_len: int, optimizer,
+                 restored: dict | None = None):
+        self.model = model
+        self.mesh = mesh
+        self.num_microbatches = num_microbatches
+        self.microbatch_size = microbatch_size
+        self.seq_len = seq_len
+        self.optimizer = optimizer
+        self._init_fn, self._step_fn = build_train_step(
+            model, mesh, num_microbatches=num_microbatches,
+            optimizer=optimizer,
+        )
+        self._eval_fn = jax.jit(self._step_fn.loss_fn)
+        if restored is None:
+            # Seed 42 matches the MPMD path's layer init (reference fixes
+            # seed 42, module/model.py:18) so both paths start identically.
+            self.state = self._init_fn(jax.random.PRNGKey(42))
+        else:
+            self.state = self._place_restored(restored)
+
+    def _place_restored(self, restored) -> TrainState:
+        params = layers_to_params(self.model, restored["params"])
+        opt = opt_state_from_layers(
+            self.model, self.optimizer, params, restored["opt"]
+        )
+        step = jnp.asarray(int(restored["meta"]["step"]), jnp.int32)
+        template = self._init_fn(jax.random.PRNGKey(0))
+        placed = jax.tree.map(
+            lambda ref, val: jax.device_put(
+                jnp.asarray(val, ref.dtype), ref.sharding
+            ),
+            template, TrainState(params, opt, step),
+        )
+        return placed
+
+    # ---- engine dialect ---- #
+
+    def train_step(self, batch: np.ndarray):
+        """batch: [num_microbatches, microbatch, seq] int32 tokens."""
+        assert batch.shape[0] == self.num_microbatches, batch.shape
+        tokens = np.asarray(batch).reshape(-1, batch.shape[-1])
+        self.state, metrics = self._step_fn(self.state, tokens)
+        return metrics.loss
+
+    def eval_step(self, batch: np.ndarray):
+        tokens_mb = jnp.asarray(batch)
+        return self._eval_fn(self.state.params, tokens_mb)
+
+    def layer_state(self):
+        """(params_layers, opt_layers) in the engine's checkpoint form."""
+        params_layers = params_to_layers(self.model, self.state.params)
+        opt_layers = opt_state_to_layers(
+            self.model, self.optimizer, self.state.params,
+            self.state.opt_state,
+        )
+        return params_layers, opt_layers
+
+    def replace_mesh(self, mesh) -> "FusedPipeline":
+        """Re-place the live state onto a new (smaller) mesh — the fused
+        path's reconfiguration primitive."""
+        fresh = FusedPipeline(
+            self.model, mesh, num_microbatches=self.num_microbatches,
+            microbatch_size=self.microbatch_size, seq_len=self.seq_len,
+            optimizer=self.optimizer,
+        )
+        template = fresh.state
+        host_state = jax.tree.map(lambda x: np.asarray(x), self.state)
+        fresh.state = jax.tree.map(
+            lambda ref, val: jax.device_put(
+                jnp.asarray(val, ref.dtype), ref.sharding
+            ),
+            template, host_state,
+        )
+        return fresh

@@ -42,14 +42,14 @@ from oobleck_tpu.planning.templates import PipelineTemplate
 logger = logging.getLogger("oobleck.pipeline")
 
 
-def _map_spec_fsdp(spec: P, use_fsdp: bool) -> P:
-    """Project a model PartitionSpec onto a stage mesh with only an `fsdp`
-    axis: `tensor` entries become replicated (MPMD stages run TP-free in v1),
-    `fsdp` kept when the stage batch allows it."""
+def _project_spec(spec: P, keep: frozenset) -> P:
+    """Project a model PartitionSpec onto a stage mesh, keeping only the axis
+    names in `keep` (subset of {"fsdp", "tensor"}); everything else becomes
+    replicated."""
     out = []
     for entry in spec:
         names = entry if isinstance(entry, tuple) else (entry,)
-        names = tuple(n for n in names if n == "fsdp" and use_fsdp)
+        names = tuple(n for n in names if n in keep)
         out.append(names[0] if len(names) == 1 else (tuple(names) or None))
     return P(*out)
 
@@ -61,9 +61,24 @@ class StageRuntime:
     ranks: tuple[int, ...]
     mesh: Mesh
     batch_sharding: NamedSharding          # [mb, seq(, emb)] layouts
-    param_shardings: dict[int, Any]        # layer -> sharding tree
+    param_shardings: dict[int, Any]        # layer -> NamedSharding tree
+    param_pspecs: dict[int, Any]           # layer -> PartitionSpec tree
+    tp: int = 1                            # tensor-parallel degree in-stage
+    use_fsdp: bool = False                 # params + batch sharded over fsdp
     fwd: Callable | None = None
     bwd: Callable | None = None
+
+    @property
+    def ctx(self):
+        """ShardCtx for manual-collective execution; None = plain program."""
+        if self.tp == 1 and not self.use_fsdp:
+            return None
+        from oobleck_tpu.models.gpt import ShardCtx
+
+        return ShardCtx(
+            tensor="tensor" if self.tp > 1 else None,
+            fsdp="fsdp" if self.use_fsdp else None,
+        )
 
 
 class PipelineInstance:
@@ -82,6 +97,8 @@ class PipelineInstance:
         seq_len: int,
         params: dict[int, Any] | None = None,
         exec_cache: dict | None = None,
+        tensor_parallel: int = 1,
+        fsdp: int = -1,
     ):
         assert len(ranks) == template.num_chips, (len(ranks), template.num_chips)
         self.pipeline_id = pipeline_id
@@ -94,19 +111,65 @@ class PipelineInstance:
         self.seq_len = seq_len
         self._exec_cache = exec_cache if exec_cache is not None else {}
 
+        tp = max(1, tensor_parallel)
+        if tp > 1:
+            cfg = model.config
+            if not hasattr(model, "head_loss_shifted"):
+                raise ValueError(
+                    f"{type(model).__name__} has no manual-TP support "
+                    "(head_loss_shifted); set tensor_parallel=1"
+                )
+            if cfg.num_heads % tp != 0:
+                raise ValueError(
+                    f"num_heads={cfg.num_heads} not divisible by "
+                    f"tensor_parallel={tp}"
+                )
+
         self.stages: list[StageRuntime] = []
         cursor = 0
         for si, stage in enumerate(template.stages):
             stage_ranks = tuple(self.ranks[cursor:cursor + stage.num_chips])
             cursor += stage.num_chips
             stage_devices = np.array([devices[r] for r in stage_ranks])
+            if stage.num_chips % tp != 0:
+                raise ValueError(
+                    f"stage {si} has {stage.num_chips} chips, not divisible "
+                    f"by tensor_parallel={tp}"
+                )
+            # fsdp semantics: -1 auto (shard over the chips/tp remainder when
+            # the microbatch allows, else replicate), 1 = never shard params,
+            # N = must equal chips/tp and be honorable or it's an error.
+            fsdp_deg = stage.num_chips // tp
+            if fsdp not in (-1, 1, fsdp_deg):
+                raise ValueError(
+                    f"stage {si}: fsdp={fsdp} requested but chips/tp = "
+                    f"{stage.num_chips}/{tp} = {fsdp_deg}"
+                )
             use_fsdp = (
-                stage.num_chips > 1 and microbatch_size % stage.num_chips == 0
+                fsdp != 1 and fsdp_deg > 1
+                and microbatch_size % fsdp_deg == 0
             )
-            mesh = Mesh(stage_devices.reshape(-1), ("fsdp",))
+            if fsdp == fsdp_deg and fsdp > 1 and not use_fsdp:
+                raise ValueError(
+                    f"stage {si}: explicit fsdp={fsdp} cannot be honored: "
+                    f"microbatch_size={microbatch_size} not divisible by it"
+                )
+            if fsdp == -1 and fsdp_deg > 1 and not use_fsdp:
+                logger.info(
+                    "stage %d: %d chips replicate params (microbatch %d "
+                    "not divisible by fsdp degree %d)",
+                    si, stage.num_chips, microbatch_size, fsdp_deg,
+                )
+            mesh = Mesh(
+                stage_devices.reshape(fsdp_deg, tp), ("fsdp", "tensor")
+            )
+            keep = frozenset(
+                a for a, on in (("fsdp", use_fsdp), ("tensor", tp > 1)) if on
+            )
             batch_spec = P("fsdp") if use_fsdp else P(None)
             specs = model.param_specs(stacked=False)
             param_shardings: dict[int, Any] = {}
+            param_pspecs: dict[int, Any] = {}
             for li in stage.layer_indices:
                 name = model.layer_name(li)
                 tree = (
@@ -114,9 +177,14 @@ class PipelineInstance:
                     else specs["head"] if name == "head"
                     else specs["blocks"]
                 )
-                param_shardings[li] = jax.tree.map(
-                    lambda s: NamedSharding(mesh, _map_spec_fsdp(s, use_fsdp)),
+                param_pspecs[li] = jax.tree.map(
+                    lambda s: _project_spec(s, keep),
                     tree,
+                    is_leaf=lambda x: isinstance(x, P),
+                )
+                param_shardings[li] = jax.tree.map(
+                    lambda s: NamedSharding(mesh, s),
+                    param_pspecs[li],
                     is_leaf=lambda x: isinstance(x, P),
                 )
             self.stages.append(StageRuntime(
@@ -126,6 +194,9 @@ class PipelineInstance:
                 mesh=mesh,
                 batch_sharding=NamedSharding(mesh, batch_spec),
                 param_shardings=param_shardings,
+                param_pspecs=param_pspecs,
+                tp=tp,
+                use_fsdp=use_fsdp,
             ))
 
         # Parameters: dict layer -> pytree placed on the owning stage's mesh.
@@ -162,20 +233,94 @@ class PipelineInstance:
     def _stage_apply(self, st: StageRuntime):
         model = self.model
         last_layer = model.num_pipeline_layers - 1
+        remat = bool(getattr(model.config, "remat", False))
+        ctx = st.ctx
 
-        def apply(params_tuple, x, tokens):
+        if ctx is None:
+            block = jax.checkpoint(model.apply_block) if remat else model.apply_block
+
+            def apply(params_tuple, x, tokens):
+                carry = x
+                for li, p in zip(st.layer_ids, params_tuple):
+                    if li == 0:
+                        carry = model.embed(p, tokens)
+                    elif li == last_layer:
+                        logits = model.head(p, carry)
+                        return cross_entropy_loss(
+                            logits, tokens, model.config.vocab_size
+                        )
+                    else:
+                        carry = block(p, carry)
+                return carry
+
+            return apply
+
+        # Manual-collective stage program: the stage's chips form a
+        # (fsdp, tensor) sub-mesh and the model's ShardCtx path runs under
+        # shard_map — the same Megatron f/g + fsdp-gather machinery as the
+        # fused SPMD step (parallel/train.py), per stage. Gradient reductions
+        # fall out of the shard_map in_spec transposes.
+        is_first = st.layer_ids[0] == 0
+        is_last = st.layer_ids[-1] == last_layer
+        batch_axes = ("fsdp",) if ctx.fsdp else ()
+        block_fn = lambda p, x: model.apply_block(p, x, ctx)
+        block = jax.checkpoint(block_fn) if remat else block_fn
+        denom = float(self.microbatch_size * (self.seq_len - 1))
+        x_spec = P("fsdp" if st.use_fsdp else None, None, None)
+        tok_spec = P("fsdp" if st.use_fsdp else None, None)
+
+        def core(*ops):
+            it = iter(ops)
+            params_tuple = next(it)
+            x = None if is_first else next(it)
+            tokens = next(it) if is_first else None
+            targets = next(it) if is_last else None
+            mask = next(it) if is_last else None
             carry = x
             for li, p in zip(st.layer_ids, params_tuple):
                 if li == 0:
-                    carry = model.embed(p, tokens)
+                    carry = model.embed(p, tokens, ctx)
                 elif li == last_layer:
-                    logits = model.head(p, carry)
-                    return cross_entropy_loss(
-                        logits, tokens, model.config.vocab_size
-                    )
+                    loss_sum = model.head_loss_shifted(p, carry, targets, mask, ctx)
+                    if batch_axes:
+                        loss_sum = jax.lax.psum(loss_sum, batch_axes)
+                    return loss_sum / denom
                 else:
-                    carry = model.apply_block(p, carry)
+                    carry = block(p, carry)
             return carry
+
+        in_specs: list[Any] = [tuple(st.param_pspecs[li] for li in st.layer_ids)]
+        if not is_first:
+            in_specs.append(x_spec)
+        if is_first:
+            in_specs.append(tok_spec)
+        if is_last:
+            in_specs.extend([tok_spec, tok_spec])
+        out_spec = P() if is_last else x_spec
+        smap = jax.shard_map(
+            core, mesh=st.mesh, in_specs=tuple(in_specs), out_specs=out_spec
+        )
+
+        def apply(params_tuple, x, tokens):
+            ops: list[Any] = [params_tuple]
+            if not is_first:
+                ops.append(x)
+            if is_first:
+                ops.append(tokens)
+            if is_last:
+                # Pre-shifted targets + validity mask: computed on the full
+                # (logically unsharded) tokens so the next-token shift never
+                # crosses a shard boundary (cf. parallel/train.py loss_fn).
+                targets = jnp.concatenate(
+                    [tokens[:, 1:], jnp.zeros_like(tokens[:, :1])], axis=-1
+                )
+                mask = jnp.broadcast_to(
+                    (jnp.arange(tokens.shape[-1]) < tokens.shape[-1] - 1)
+                    .astype(jnp.float32),
+                    tokens.shape,
+                )
+                ops.extend([targets, mask])
+            return smap(*ops)
 
         return apply
 
@@ -190,7 +335,7 @@ class PipelineInstance:
             key = (
                 st.layer_ids, len(st.ranks), tuple(st.ranks),
                 self.microbatch_size, self.seq_len, is_first, is_last,
-                self.total_num_microbatches,
+                self.total_num_microbatches, st.tp, st.use_fsdp,
             )
             if key in self._exec_cache:
                 st.fwd, st.bwd = self._exec_cache[key]

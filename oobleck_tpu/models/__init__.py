@@ -95,15 +95,48 @@ register("vit-large-patch16-224")(lambda o: _vit(o, hidden_size=1024, num_layers
 register("vit-tiny")(lambda o: _vit(o, image_size=32, patch_size=8, num_classes=10, hidden_size=64, num_layers=4, num_heads=4))
 
 
-def build_model(model_name: str, model_args: dict[str, Any] | None = None):
-    """Resolve a model name (+ overrides) to a layer-list model instance."""
+def build_model(model_name: str, model_args: dict[str, Any] | None = None,
+                execution=None):
+    """Resolve a model name (+ overrides) to a layer-list model instance.
+
+    `execution` (an ExecutionArguments, duck-typed) threads the engine's
+    precision / remat / attention_impl knobs into the model config — applied
+    only where the family's config has the field, and never overriding an
+    explicit `model_args` entry.
+    """
     try:
         factory = _REGISTRY[model_name]
     except KeyError:
         raise ValueError(
             f"unknown model {model_name!r}; known: {sorted(_REGISTRY)}"
         ) from None
-    return factory(model_args or {})
+    model_args = dict(model_args or {})
+    model = factory(model_args)
+    if execution is not None:
+        import jax.numpy as jnp
+
+        dtypes = {
+            "bfloat16": jnp.bfloat16,
+            "float32": jnp.float32,
+            "float16": jnp.float16,
+        }
+        precision = getattr(execution, "precision", None)
+        if precision is not None and precision not in dtypes:
+            raise ValueError(
+                f"unknown precision {precision!r}; known: {sorted(dtypes)}"
+            )
+        fields = type(model.config).__dataclass_fields__
+        extra = {
+            k: v for k, v in {
+                "dtype": dtypes[precision] if precision else None,
+                "remat": getattr(execution, "remat", None),
+                "attention_impl": getattr(execution, "attention_impl", None),
+            }.items()
+            if v is not None and k in fields and k not in model_args
+        }
+        if extra:
+            model = factory({**model_args, **extra})
+    return model
 
 
 def available_models() -> list[str]:

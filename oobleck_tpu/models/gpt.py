@@ -32,7 +32,6 @@ from jax.sharding import PartitionSpec as P
 from oobleck_tpu.models.base import stack_layer_params
 from oobleck_tpu.ops.attention import causal_attention
 from oobleck_tpu.parallel.collectives import (
-    copy_to_tp,
     reduce_from_tp,
     unshard_fsdp,
     vocab_parallel_embed,
@@ -127,10 +126,6 @@ def _layer_norm(x: jax.Array, scale: jax.Array, bias: jax.Array, eps: float) -> 
     var = jnp.mean(jnp.square(x - mean), axis=-1, keepdims=True)
     y = (x - mean) * jax.lax.rsqrt(var + eps)
     return (y * scale + bias).astype(dtype)
-
-
-def _maybe_copy_to_tp(x, axis):
-    return copy_to_tp(x, axis) if axis else x
 
 
 def _maybe_reduce_from_tp(x, axis):
@@ -280,8 +275,9 @@ class GPTModel:
         f_ = ctx.fsdp if ctx else None
 
         # --- attention ---
-        h = _maybe_copy_to_tp(x, t)
-        h = _layer_norm(h, p["ln1"]["scale"], p["ln1"]["bias"], c.layer_norm_epsilon)
+        # (No Megatron `f` here: shard_map's vma transpose psums the
+        # replicated->varying boundary cotangent itself; see collectives.py.)
+        h = _layer_norm(x, p["ln1"]["scale"], p["ln1"]["bias"], c.layer_norm_epsilon)
         wqkv = _maybe_unshard(p["attn"]["wqkv"], f_, 0).astype(dt)     # [E,3,Hl,D]
         bqkv = p["attn"]["bqkv"].astype(dt)                             # [3,Hl,D]
         qkv = jnp.einsum("bse,ethd->tbhsd", h, wqkv) + bqkv[:, None, :, None, :]
@@ -316,8 +312,7 @@ class GPTModel:
         x = x + out
 
         # --- mlp ---
-        h = _maybe_copy_to_tp(x, t)
-        h = _layer_norm(h, p["ln2"]["scale"], p["ln2"]["bias"], c.layer_norm_epsilon)
+        h = _layer_norm(x, p["ln2"]["scale"], p["ln2"]["bias"], c.layer_norm_epsilon)
         wi = _maybe_unshard(p["mlp"]["wi"], f_, 0).astype(dt)           # [E,Fl]
         h = jax.nn.gelu(h @ wi + p["mlp"]["bi"].astype(dt))
         wo = _maybe_unshard(p["mlp"]["wo"], f_, 1).astype(dt)           # [Fl,E]

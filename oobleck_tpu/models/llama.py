@@ -25,7 +25,6 @@ from oobleck_tpu.models.base import stack_layer_params
 from oobleck_tpu.models.gpt import NEG_INF, ShardCtx
 from oobleck_tpu.ops.attention import causal_attention
 from oobleck_tpu.parallel.collectives import (
-    copy_to_tp,
     reduce_from_tp,
     unshard_fsdp,
     vocab_parallel_embed,
@@ -228,8 +227,9 @@ class LlamaModel:
         b, s, _ = x.shape
         pos = self._positions(s, ctx)
 
-        h = _maybe(copy_to_tp, x, t)
-        h = _rms_norm(h, p["ln1"]["scale"], c.rms_norm_eps)
+        # (No Megatron `f`: shard_map's vma transpose supplies the backward
+        # psum at the replicated->varying boundary; see collectives.py.)
+        h = _rms_norm(x, p["ln1"]["scale"], c.rms_norm_eps)
         wq = _maybe(unshard_fsdp, p["attn"]["wq"], f_, 0).astype(dt)      # [E,Hl,D]
         wkv = _maybe(unshard_fsdp, p["attn"]["wkv"], f_, 0).astype(dt)    # [E,2,KVl,D]
         q = jnp.einsum("bse,ehd->bhsd", h, wq)
@@ -251,8 +251,7 @@ class LlamaModel:
         out = jnp.einsum("bhsd,hde->bse", attn, wo)
         x = x + _maybe(reduce_from_tp, out, t)
 
-        h = _maybe(copy_to_tp, x, t)
-        h = _rms_norm(h, p["ln2"]["scale"], c.rms_norm_eps)
+        h = _rms_norm(x, p["ln2"]["scale"], c.rms_norm_eps)
         wg = _maybe(unshard_fsdp, p["mlp"]["wg"], f_, 0).astype(dt)
         wu = _maybe(unshard_fsdp, p["mlp"]["wu"], f_, 0).astype(dt)
         g = jax.nn.silu(h @ wg) * (h @ wu)

@@ -12,33 +12,18 @@ operations expressed as XLA collectives over mesh axes.
 
 from __future__ import annotations
 
-import functools
-
 import jax
 import jax.numpy as jnp
 from jax import lax
 from jax.sharding import PartitionSpec as P
 
 
-@functools.partial(jax.custom_vjp, nondiff_argnums=(1,))
-def copy_to_tp(x, axis: str):
-    """Megatron `f`: identity forward, psum backward over the tensor axis.
-
-    Placed where a replicated activation enters a column-parallel region so
-    the partial input-cotangents from each tensor rank get summed.
-    """
-    return x
-
-
-def _copy_to_tp_fwd(x, axis):
-    return x, None
-
-
-def _copy_to_tp_bwd(axis, _res, g):
-    return (lax.psum(g, axis),)
-
-
-copy_to_tp.defvjp(_copy_to_tp_fwd, _copy_to_tp_bwd)
+# NOTE on Megatron `f` (identity forward / psum backward): it is deliberately
+# ABSENT. Modern shard_map tracks varying-manual-axes (vma) and inserts the
+# backward psum itself when a tensor-replicated activation enters a
+# column-parallel region — an explicit custom_vjp psum there DOUBLE-counts
+# the cotangent (verified numerically: grads off by ~2x with it, exact
+# without). Only the forward reduction `g` needs writing out.
 
 
 def reduce_from_tp(x, axis: str):

@@ -255,18 +255,39 @@ def profile_allreduce_across_nodes(model, max_hosts: int) -> list[dict]:
     return rows
 
 
+def effective_tag(model_tag: str, execution=None) -> str:
+    """Profile cache tag incorporating the execution knobs that change layer
+    timing and memory (precision / remat / attention_impl): a bf16 profile
+    must never be mistaken for an f32 one when planning memory bounds."""
+    if execution is None:
+        return model_tag
+    parts = [model_tag]
+    if getattr(execution, "precision", "bfloat16") != "bfloat16":
+        parts.append(execution.precision)
+    if not getattr(execution, "remat", True):
+        parts.append("noremat")
+    impl = getattr(execution, "attention_impl", "auto")
+    if impl != "auto":
+        parts.append(impl)
+    return "+".join(parts)
+
+
 def profile(model_name: str, model_args: dict, *, model_tag: str = "default",
             microbatch_size: int = 1, seq_len: int | None = None,
             chips_per_host: int = 4, max_hosts: int = 32,
-            force: bool = False) -> Path:
+            force: bool = False, execution=None) -> Path:
     """Run all profiles and write the JSON cache; returns the cache dir.
+
+    `execution` (ExecutionArguments, duck-typed) must match what the engine
+    trains with: it changes the measured model (dtype/remat/attention) AND
+    the cache tag (pass the same object to effective_tag for loading).
 
     File layout matches the reference (profiler.py:290-319) so the planner's
     loader is schema-compatible.
     """
     from oobleck_tpu.models import build_model
 
-    path = get_profile_path(model_name, model_tag)
+    path = get_profile_path(model_name, effective_tag(model_tag, execution))
     files = [f"mb{microbatch_size}.json", "allreduce_in_node.json",
              "allreduce_across_nodes.json", "model_args.json"]
     if all((path / f).exists() for f in files) and not force:
@@ -274,7 +295,7 @@ def profile(model_name: str, model_args: dict, *, model_tag: str = "default",
         validate_model_args(path, model_args)
         return path
     path.mkdir(parents=True, exist_ok=True)
-    model = build_model(model_name, model_args)
+    model = build_model(model_name, model_args, execution=execution)
 
     contents = {
         f"mb{microbatch_size}.json":
