@@ -102,9 +102,11 @@ class ExecutionArguments:
     attention_impl: str = "auto"  # auto | xla | pallas | ring
     checkpoint_dir: str | None = None
     checkpoint_interval: int = 0  # steps; 0 disables
-    # Fraction of the dataset reserved as a held-out tail for evaluate();
-    # 0 trains on the full dataset (reference behavior).
-    eval_fraction: float = 0.0
+    # Fraction of the dataset reserved as a held-out tail for evaluate()
+    # when no real validation split exists. Nonzero BY DEFAULT so eval is
+    # honest out of the box; 0 opts out explicitly (train on everything,
+    # the reference behavior — its eval data is never actually driven).
+    eval_fraction: float = 0.02
 
     def __post_init__(self) -> None:
         if self.engine_path not in ("auto", "mpmd", "fused"):

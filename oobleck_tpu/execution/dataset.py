@@ -63,7 +63,7 @@ class HFTextDataset:
     """
 
     def __init__(self, dataset_path: str, dataset_name: str | None,
-                 tokenizer_name: str, seq_length: int):
+                 tokenizer_name: str, seq_length: int, split: str = "train"):
         import os
 
         # Fail fast from the local cache: without these, a cache miss burns
@@ -76,12 +76,13 @@ class HFTextDataset:
         except ImportError as e:
             raise RuntimeError(f"HF libraries unavailable: {e}") from e
         try:
-            raw = load_dataset(dataset_path, dataset_name, split="train")
+            raw = load_dataset(dataset_path, dataset_name, split=split)
             tok = AutoTokenizer.from_pretrained(tokenizer_name)
         except Exception as e:
             raise RuntimeError(
-                f"could not load {dataset_path}/{dataset_name} or tokenizer "
-                f"{tokenizer_name} from local cache (offline env): {e}"
+                f"could not load {dataset_path}/{dataset_name} split={split} "
+                f"or tokenizer {tokenizer_name} from local cache "
+                f"(offline env): {e}"
             ) from e
         text_col = "text" if "text" in raw.column_names else raw.column_names[0]
         ids: list[int] = []
@@ -108,3 +109,50 @@ def build_dataset(dataset_path: str, dataset_name: str | None, *,
     if dataset_path in ("synthetic", "", None):
         return SyntheticTextDataset(vocab_size, seq_length, num_samples)
     return HFTextDataset(dataset_path, dataset_name, model_name, seq_length)
+
+
+_EVAL_SPLITS = ("validation", "valid", "test")
+
+
+def has_validation_split(dataset_path: str, dataset_name: str | None) -> bool:
+    """Cheap existence probe (raw split load, no tokenization) so engines
+    can size the train/eval partition without paying the full eval-dataset
+    build at startup."""
+    if dataset_path in ("synthetic", "", None):
+        return False
+    import os
+
+    os.environ.setdefault("HF_HUB_OFFLINE", "1")
+    os.environ.setdefault("HF_DATASETS_OFFLINE", "1")
+    try:
+        from datasets import load_dataset
+    except ImportError:
+        return False
+    for split in _EVAL_SPLITS:
+        try:
+            load_dataset(dataset_path, dataset_name, split=split)
+            return True
+        except Exception:
+            continue
+    return False
+
+
+def build_eval_dataset(dataset_path: str, dataset_name: str | None, *,
+                       model_name: str, seq_length: int):
+    """A REAL validation split for evaluation, when one exists.
+
+    HF datasets carry train+validation (the reference loads both,
+    dataset.py:88-148, though its Evaluation loader is never driven); the
+    synthetic corpus does not — callers fall back to the engine's held-out
+    tail reserve (ExecutionArguments.eval_fraction) on None."""
+    if dataset_path in ("synthetic", "", None):
+        return None
+    for split in _EVAL_SPLITS:
+        try:
+            return HFTextDataset(
+                dataset_path, dataset_name, model_name, seq_length,
+                split=split,
+            )
+        except RuntimeError:
+            continue
+    return None

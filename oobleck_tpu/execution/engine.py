@@ -38,7 +38,11 @@ from oobleck_tpu.config import OobleckArguments
 from oobleck_tpu.execution.dataloader import OobleckDataLoader, OobleckSampler
 from oobleck_tpu.execution.dataset import build_dataset
 from oobleck_tpu.execution.pipeline import PipelineInstance
-from oobleck_tpu.execution.reconfigure import hosts_to_ranks, reconfigure_hosts
+from oobleck_tpu.execution.reconfigure import (
+    fit_host_groups,
+    hosts_to_ranks,
+    reconfigure_hosts,
+)
 from oobleck_tpu.models import build_model
 from oobleck_tpu.parallel.train import make_optimizer
 from oobleck_tpu.planning.instantiator import HeterogeneousPlan, PipelineInstantiator
@@ -142,6 +146,13 @@ class OobleckEngine:
             vocab_size=self.model.config.vocab_size,
             seq_length=seq_len,
         )
+        # Real validation split when the data source has one; else
+        # evaluate() holds out the eval_fraction tail of the train set.
+        # Built lazily on first evaluate() — tokenizing a whole extra split
+        # at startup would tax exactly the recovery latency BASELINE bounds.
+        self._eval_ds_cache: Any = _UNSET
+        self._has_val_split: bool | None = None
+        self._eval_state = (0, 0)  # rotating (iterations_done, epoch)
 
         # Planning inputs (profile-on-miss mirrors agent.ensure_profile).
         # The profiled model carries the same execution overrides as the
@@ -169,6 +180,9 @@ class OobleckEngine:
         self.pipelines: list[PipelineInstance] = []
         self.fused = None                    # FusedPipeline when engine_path=fused
         self._fused_hosts: list[int] = []    # surviving ORIGINAL host indices
+        # Wall-clock seconds per completed reconfiguration — the paper's
+        # headline recovery metric (BASELINE.md targets <60 s/failure).
+        self.recovery_times: list[float] = []
         self.dataloaders: list[OobleckDataLoader] = []
         self.opt_states: dict[int, dict[int, Any]] = {}
         self.plan: HeterogeneousPlan | None = None
@@ -640,67 +654,97 @@ class OobleckEngine:
 
     # ------------------------------------------------------------------ #
 
+    def _has_validation_split(self) -> bool:
+        if self._has_val_split is None:
+            from oobleck_tpu.execution.dataset import has_validation_split
+
+            self._has_val_split = has_validation_split(
+                self.args.model.dataset_path, self.args.model.dataset_name
+            )
+        return self._has_val_split
+
+    @property
+    def eval_dataset(self):
+        if self._eval_ds_cache is _UNSET:
+            from oobleck_tpu.execution.dataset import build_eval_dataset
+
+            self._eval_ds_cache = (
+                build_eval_dataset(
+                    self.args.model.dataset_path,
+                    self.args.model.dataset_name,
+                    model_name=self.args.model.model_name,
+                    seq_length=self.seq_len,
+                )
+                if self._has_validation_split() else None
+            )
+        return self._eval_ds_cache
+
     def _eval_reserve(self) -> int:
+        if self._has_validation_split():
+            return 0  # a real validation split exists; train on everything
         return int(len(self.dataset) * self.args.execution.eval_fraction)
 
     def evaluate(self, num_batches: int = 8) -> float:
-        """Forward-only mean loss over the held-out dataset tail (the
-        reference's Evaluation LoaderType exists but is never driven,
-        dataloader.py:101). Training samplers cover only the head split
-        (see _materialize_plan), so the tail is genuinely unseen. If one
-        eval bucket exceeds the reserve, the window extends into the
-        training tail out of necessity (tiny datasets) — logged."""
-        n = len(self.dataset)
-        bucket = self.args.job.microbatch_size * (
-            self.fused.num_microbatches if self.fused is not None
-            else sum(p.num_microbatches for p in self.pipelines)
+        """Forward-only mean loss over held-out data.
+
+        The pool is a real validation split when the data source has one,
+        else the eval_fraction tail reserve — training samplers cover only
+        the head split (_materialize_plan), so the tail is genuinely
+        unseen. Windows ROTATE: the eval position persists across calls
+        (epoch wrap in the sampler), so repeated evaluate() calls sweep the
+        whole pool instead of replaying its first window. (The reference
+        defines an Evaluation LoaderType but never drives it,
+        dataloader.py:101.)"""
+        mb_counts = (
+            [self.fused.num_microbatches] if self.fused is not None
+            else [p.num_microbatches for p in self.pipelines]
         )
-        eval_n = self._eval_reserve()
-        if eval_n < bucket:
+        bucket = self.args.job.microbatch_size * sum(mb_counts)
+        pool = self.eval_dataset
+        if pool is None:
+            n = len(self.dataset)
+            eval_n = self._eval_reserve()
+            if eval_n < bucket:
+                logger.warning(
+                    "eval reserve %d < one bucket %d; eval overlaps the "
+                    "training tail (raise execution.eval_fraction)",
+                    eval_n, bucket,
+                )
+                eval_n = bucket
+            pool = _TailView(self.dataset, n - eval_n, eval_n)
+        elif len(pool) < bucket:
             logger.warning(
-                "eval reserve %d < one bucket %d; eval overlaps training tail",
-                eval_n, bucket,
+                "validation split of %d samples smaller than one eval "
+                "bucket (%d); samples repeat within a window",
+                len(pool), bucket,
             )
-            eval_n = bucket
-        offset = n - eval_n
+            pool = _CyclicView(pool, bucket)
 
-        class _Tail:
-            def __init__(self, ds):
-                self.ds = ds
-
-            def __len__(self):
-                return eval_n
-
-            def __getitem__(self, i):
-                return self.ds[offset + i]
-
-        tail = _Tail(self.dataset)
+        it_done, epoch = self._eval_state
+        samplers = [
+            OobleckSampler(
+                num_samples=len(pool),
+                microbatch_size=self.args.job.microbatch_size,
+                pipeline_index=i,
+                num_microbatches=mb_counts,
+                num_iterations_done=it_done,  # sampler wraps epochs itself
+                epoch=epoch,
+            )
+            for i in range(len(mb_counts))
+        ]
+        loaders = [OobleckDataLoader(pool, s) for s in samplers]
         loss_sum = 0.0
         weight_sum = 0
-        if self.fused is not None:
-            sampler = OobleckSampler(
-                num_samples=len(tail),
-                microbatch_size=self.args.job.microbatch_size,
-                pipeline_index=0,
-                num_microbatches=[self.fused.num_microbatches],
-            )
-            dl = OobleckDataLoader(tail, sampler)
-            for _ in range(max(1, num_batches)):
-                loss_sum += float(self.fused.eval_step(dl.next_batch()))
+        for _ in range(max(1, num_batches // len(mb_counts))):
+            if self.fused is not None:
+                loss_sum += float(self.fused.eval_step(loaders[0].next_batch()))
                 weight_sum += 1
-            return loss_sum / weight_sum
-        for pipe in self.pipelines:
-            sampler = OobleckSampler(
-                num_samples=len(tail),
-                microbatch_size=self.args.job.microbatch_size,
-                pipeline_index=pipe.pipeline_id,
-                num_microbatches=[p.num_microbatches for p in self.pipelines],
-            )
-            dl = OobleckDataLoader(tail, sampler)
-            for _ in range(max(1, num_batches // len(self.pipelines))):
-                loss = float(pipe.eval_step(dl.next_batch()))
-                loss_sum += loss * pipe.num_microbatches
-                weight_sum += pipe.num_microbatches
+            else:
+                for pipe, dl in zip(self.pipelines, loaders):
+                    loss = float(pipe.eval_step(dl.next_batch()))
+                    loss_sum += loss * pipe.num_microbatches
+                    weight_sum += pipe.num_microbatches
+        self._eval_state = (samplers[0].num_iterations_done, samplers[0].epoch)
         return loss_sum / weight_sum
 
     def request_reconfiguration(self, lost_ip: str) -> None:
@@ -736,51 +780,17 @@ class OobleckEngine:
         min_hosts = min(t.num_hosts for t in self.templates)
         new_hosts = reconfigure_hosts(current, {lost_host}, min_hosts)
 
-        # Match each host group to the largest template it can fill
-        # (reference engine.py:92-102). Hosts beyond a group's template size
-        # are NOT silently dropped (round-1 advisor finding): the surplus is
-        # re-folded — first into extra pipelines, then by growing existing
-        # groups to the next feasible template size — and anything truly
-        # unplaceable is logged.
+        # Match each host group to the largest template it can fill,
+        # re-folding surplus hosts instead of silently idling them
+        # (fit_host_groups; round-1 advisor finding).
         by_hosts = {t.num_hosts: t for t in self.templates}
         sizes = sorted(by_hosts)
-        fitted: list[list[int]] = []
-        surplus: list[int] = []
-        for hosts in new_hosts:
-            fit = max((s for s in sizes if s <= len(hosts)), default=0)
-            if fit == 0:
-                surplus.extend(hosts)
-                continue
-            fitted.append(list(hosts[:fit]))
-            surplus.extend(hosts[fit:])
-        while surplus:
-            new_size = max((s for s in sizes if s <= len(surplus)), default=0)
-            if new_size:
-                fitted.append(surplus[:new_size])
-                surplus = surplus[new_size:]
-                continue
-            grown = False
-            for g in sorted(fitted, key=len):
-                bigger = [s for s in sizes
-                          if s > len(g) and s - len(g) <= len(surplus)]
-                if bigger:
-                    need = bigger[0] - len(g)
-                    g.extend(surplus[:need])
-                    surplus = surplus[need:]
-                    grown = True
-                    break
-            if not grown:
-                break
-        if surplus:
+        new_hosts, idle = fit_host_groups(new_hosts, sizes)
+        if idle:
             logger.warning(
                 "hosts %s idle after reconfiguration: no template extension "
-                "fits them (feasible sizes %s)", surplus, sizes,
+                "fits them (feasible sizes %s)", idle, sizes,
             )
-        if not fitted:
-            raise RuntimeError(
-                f"no template fits any surviving host group (sizes {sizes})"
-            )
-        new_hosts = fitted
         new_instances: dict[PipelineTemplate, int] = {}
         for hosts in new_hosts:
             t = by_hosts[len(hosts)]
@@ -815,23 +825,70 @@ class OobleckEngine:
             plan, it_done, epoch, old_params, old_opt,
             host_assignment=host_assignment,
         )
+        elapsed = time.perf_counter() - t0
+        self.recovery_times.append(elapsed)
         logger.warning(
-            "reconfigured after losing %s in %.2fs: %s",
-            lost_ip, time.perf_counter() - t0, plan,
+            "reconfigured after losing %s in %.2fs: %s", lost_ip, elapsed, plan,
         )
 
     def _reconfigure_fused(self, lost_ip: str, lost_host: int, t0: float) -> None:
         """Fused-path recovery: shrink the global mesh to the surviving
         chips and re-place the live TrainState on it (the sharded-state
         analog of the reference's template re-match + weight copy)."""
-        self._fused_hosts.remove(lost_host)
+        # Build the new mesh BEFORE mutating host bookkeeping: if the
+        # survivors genuinely cannot run (fewer than stage*tensor*seq
+        # chips), the raise leaves the engine state consistent.
+        survivors = [h for h in self._fused_hosts if h != lost_host]
+        devices = [
+            d for h in survivors
+            for d in self.devices[h * self.chips_per_host:
+                                  (h + 1) * self.chips_per_host]
+        ]
+        mesh = self._fused_mesh(devices, shrink_to_fit=True)
+        new_fused = self.fused.replace_mesh(mesh)
+        self._fused_hosts = survivors
         self.host_ips.remove(lost_ip)
-        mesh = self._fused_mesh(self._fused_devices(), shrink_to_fit=True)
-        self.fused = self.fused.replace_mesh(mesh)
+        self.fused = new_fused
+        elapsed = time.perf_counter() - t0
+        self.recovery_times.append(elapsed)
         logger.warning(
             "reconfigured (fused) after losing %s in %.2fs: mesh %s",
-            lost_ip, time.perf_counter() - t0, dict(mesh.shape),
+            lost_ip, elapsed, dict(mesh.shape),
         )
+
+
+_UNSET = object()
+
+
+class _CyclicView:
+    """Repeat a too-small eval pool up to `length` samples (i mod len) so a
+    tiny validation split can still fill one iteration bucket."""
+
+    def __init__(self, ds, length: int):
+        self.ds = ds
+        self.length = length
+
+    def __len__(self) -> int:
+        return self.length
+
+    def __getitem__(self, i: int):
+        return self.ds[i % len(self.ds)]
+
+
+class _TailView:
+    """A length-`length` window of `ds` starting at `offset` (the held-out
+    evaluation tail)."""
+
+    def __init__(self, ds, offset: int, length: int):
+        self.ds = ds
+        self.offset = offset
+        self.length = length
+
+    def __len__(self) -> int:
+        return self.length
+
+    def __getitem__(self, i: int):
+        return self.ds[self.offset + i]
 
 
 def _scale_template_chips(t: PipelineTemplate, tp: int) -> PipelineTemplate:

@@ -28,6 +28,8 @@ from oobleck_tpu.parallel.train import TrainState, build_train_step
 
 logger = logging.getLogger("oobleck.fused")
 
+_PREPLACED = object()  # sentinel: caller supplies the state via _place()
+
 
 # --------------------------------------------------------------------- #
 # stacked <-> layer-keyed state conversion                               #
@@ -209,6 +211,8 @@ class FusedPipeline:
             # Seed 42 matches the MPMD path's layer init (reference fixes
             # seed 42, module/model.py:18) so both paths start identically.
             self.state = self._init_fn(jax.random.PRNGKey(42))
+        elif restored is _PREPLACED:
+            self.state = None  # caller places the live state via _place
         else:
             self.state = self._place_restored(restored)
 
@@ -218,14 +222,30 @@ class FusedPipeline:
             self.model, self.optimizer, params, restored["opt"]
         )
         step = jnp.asarray(int(restored["meta"]["step"]), jnp.int32)
-        template = self._init_fn(jax.random.PRNGKey(0))
-        placed = jax.tree.map(
-            lambda ref, val: jax.device_put(
-                jnp.asarray(val, ref.dtype), ref.sharding
-            ),
-            template, TrainState(params, opt, step),
+        return self._place(TrainState(params, opt, step))
+
+    def _place(self, state: TrainState) -> TrainState:
+        """device_put a host-side TrainState onto this mesh's shardings.
+
+        Shape/dtype templates come from eval_shape (no device allocation):
+        materializing a throwaway random state here would double peak
+        memory exactly when it's scarcest (restore and post-failure
+        re-placement)."""
+        shapes = jax.eval_shape(
+            lambda: TrainState(
+                self.model.init_params(jax.random.PRNGKey(0)),
+                self.optimizer.init(
+                    self.model.init_params(jax.random.PRNGKey(0))
+                ),
+                jnp.zeros((), jnp.int32),
+            )
         )
-        return placed
+        return jax.tree.map(
+            lambda ref, sh, val: jax.device_put(
+                jnp.asarray(val, ref.dtype), sh
+            ),
+            shapes, self._step_fn.state_shardings, state,
+        )
 
     # ---- engine dialect ---- #
 
@@ -252,17 +272,12 @@ class FusedPipeline:
     def replace_mesh(self, mesh) -> "FusedPipeline":
         """Re-place the live state onto a new (smaller) mesh — the fused
         path's reconfiguration primitive."""
+        host_state = jax.tree.map(lambda x: np.asarray(x), self.state)
         fresh = FusedPipeline(
             self.model, mesh, num_microbatches=self.num_microbatches,
             microbatch_size=self.microbatch_size, seq_len=self.seq_len,
             optimizer=self.optimizer,
+            restored=_PREPLACED,
         )
-        template = fresh.state
-        host_state = jax.tree.map(lambda x: np.asarray(x), self.state)
-        fresh.state = jax.tree.map(
-            lambda ref, val: jax.device_put(
-                jnp.asarray(val, ref.dtype), ref.sharding
-            ),
-            template, host_state,
-        )
+        fresh.state = fresh._place(host_state)
         return fresh

@@ -72,6 +72,56 @@ def reconfigure_hosts(
     return ok
 
 
+def fit_host_groups(
+    groups: list[list[int]],
+    template_sizes: list[int],
+) -> tuple[list[list[int]], list[int]]:
+    """Match host groups to feasible template sizes without idling capacity.
+
+    Each group is trimmed to the largest template size it can fill
+    (reference engine.py:92-102); trimmed-off hosts are NOT dropped (the
+    round-1 silent-idle bug): the surplus pool first forms extra pipelines,
+    then grows existing groups to the next feasible size, and only what
+    remains after both is returned as idle.
+
+    Returns (fitted_groups, idle_hosts). Raises if no group fits any
+    template at all.
+    """
+    sizes = sorted(set(template_sizes))
+    fitted: list[list[int]] = []
+    surplus: list[int] = []
+    for hosts in groups:
+        fit = max((s for s in sizes if s <= len(hosts)), default=0)
+        if fit == 0:
+            surplus.extend(hosts)
+            continue
+        fitted.append(list(hosts[:fit]))
+        surplus.extend(hosts[fit:])
+    while surplus:
+        new_size = max((s for s in sizes if s <= len(surplus)), default=0)
+        if new_size:
+            fitted.append(surplus[:new_size])
+            surplus = surplus[new_size:]
+            continue
+        grown = False
+        for g in sorted(fitted, key=len):
+            bigger = [s for s in sizes
+                      if s > len(g) and s - len(g) <= len(surplus)]
+            if bigger:
+                need = bigger[0] - len(g)
+                g.extend(surplus[:need])
+                surplus = surplus[need:]
+                grown = True
+                break
+        if not grown:
+            break
+    if not fitted:
+        raise RuntimeError(
+            f"no template fits any surviving host group (sizes {sizes})"
+        )
+    return fitted, surplus
+
+
 def hosts_to_ranks(hosts: list[int], chips_per_host: int) -> list[int]:
     """Expand host ids to global chip ranks (rank = host*chips + local)."""
     out = []

@@ -263,4 +263,5 @@ def build_train_step(model, mesh, *, num_microbatches: int, optimizer=None,
 
     wrapped_step.jitted = jit_step
     wrapped_step.loss_fn = loss_fn
+    wrapped_step.state_shardings = state_shardings
     return jit_init, wrapped_step

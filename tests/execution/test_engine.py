@@ -143,12 +143,70 @@ def test_min_hosts_bound(cache_env, devices8):
 
 
 def test_evaluate(trained_engine):
-    # Default eval_fraction=0: evaluate still works (overlap warning path).
+    # Held-out reserve exists BY DEFAULT (eval_fraction nonzero).
+    assert trained_engine._eval_reserve() > 0
     loss = trained_engine.evaluate(num_batches=2)
     assert np.isfinite(loss) and 0 < loss < 20
-    # With a reserve configured, training covers only the head split.
     trained_engine.args.execution.eval_fraction = 0.1
     assert trained_engine._eval_reserve() == int(
         len(trained_engine.dataset) * 0.1
     )
-    trained_engine.args.execution.eval_fraction = 0.0
+    trained_engine.args.execution.eval_fraction = 0.02
+
+
+class _RecordingDataset:
+    def __init__(self, ds):
+        self.ds = ds
+        self.seen: list[int] = []
+
+    def __len__(self):
+        return len(self.ds)
+
+    def __getitem__(self, i):
+        self.seen.append(i)
+        return self.ds[i]
+
+
+def test_eval_disjoint_and_rotating_default_config(cache_env, devices8):
+    """Under the DEFAULT config, every index evaluate() reads is disjoint
+    from every index training ever read, and consecutive evaluate() calls
+    read different windows (rotation, not replay)."""
+    engine = make_engine(num_hosts=2, steps=5, devices=devices8)
+    engine.initialize_distributed()
+    rec = _RecordingDataset(engine.dataset)
+    engine.dataset = rec
+    engine.instantiate_pipelines(engine.args.job.global_num_microbatch)
+    for _ in range(3):
+        engine._train_step()
+    train_seen = set(rec.seen)
+
+    rec.seen = []
+    assert np.isfinite(engine.evaluate(num_batches=2))
+    eval_first = set(rec.seen)
+    rec.seen = []
+    assert np.isfinite(engine.evaluate(num_batches=2))
+    eval_second = set(rec.seen)
+
+    assert eval_first and eval_second
+    assert train_seen.isdisjoint(eval_first | eval_second)
+    assert eval_first != eval_second  # windows rotate across calls
+
+
+def test_reconfigure_no_idle_survivors_two_failures(cache_env, devices8):
+    """Every surviving host keeps training after each of two consecutive
+    host losses (surplus re-fold + immutable host-index lookup), and the
+    recovery time is recorded as a first-class metric."""
+    engine = make_engine(num_hosts=4, steps=10, devices=devices8)
+    engine.initialize_distributed()
+    engine.instantiate_pipelines(engine.args.job.global_num_microbatch)
+    engine._train_step()
+
+    for n_lost, ip in enumerate(["10.0.0.1", "10.0.0.3"], start=1):
+        engine.reconfigure(ip)
+        survivors = {engine._host_index[h] for h in engine.host_ips}
+        training = {r // engine.chips_per_host
+                    for p in engine.pipelines for r in p.ranks}
+        assert training == survivors, (n_lost, training, survivors)
+        assert len(engine.recovery_times) == n_lost
+        assert engine.recovery_times[-1] < 60.0
+        assert np.isfinite(engine._train_step())

@@ -69,3 +69,67 @@ def test_14_host_4_pipeline_scenarios():
 
 def test_hosts_to_ranks():
     assert hosts_to_ranks([1, 3], 4) == [4, 5, 6, 7, 12, 13, 14, 15]
+
+
+# --------------------------------------------------------------------- #
+# fit_host_groups: surplus re-fold (round-1 silent-idle fix)
+
+
+def test_fit_exact_match():
+    from oobleck_tpu.execution.reconfigure import fit_host_groups
+
+    fitted, idle = fit_host_groups([[0, 1], [2, 3]], [1, 2])
+    assert sorted(map(sorted, fitted)) == [[0, 1], [2, 3]]
+    assert idle == []
+
+
+def test_fit_surplus_forms_extra_pipeline():
+    from oobleck_tpu.execution.reconfigure import fit_host_groups
+
+    # A 6-host group with templates {2, 4}: trimmed to 4, the 2-host
+    # surplus becomes its own pipeline instead of idling.
+    fitted, idle = fit_host_groups([[0, 1, 2, 3, 4, 5]], [2, 4])
+    assert sorted(map(sorted, fitted)) == [[0, 1, 2, 3], [4, 5]]
+    assert idle == []
+
+
+def test_fit_surplus_grows_existing_group():
+    from oobleck_tpu.execution.reconfigure import fit_host_groups
+
+    # Groups [2, 3] with templates {2, 4}: the 3-group trims to 2 leaving
+    # one surplus host, which cannot form a pipeline (min size 2) but CAN
+    # grow the other 2-group... only if 2 more were available — with one
+    # surplus nothing fits, so it idles.  With two surplus hosts the grow
+    # branch fires.
+    fitted, idle = fit_host_groups([[0, 1], [2, 3, 4], [5, 6, 7]], [2, 4])
+    # trims: [0,1] + [2,3] + [5,6], surplus [4, 7] -> extra pipeline [4, 7]
+    assert sorted(len(g) for g in fitted) == [2, 2, 2, 2]
+    assert idle == []
+    assert sorted(h for g in fitted for h in g) == list(range(8))
+
+
+def test_fit_grow_branch():
+    from oobleck_tpu.execution.reconfigure import fit_host_groups
+
+    # Templates {3, 4}: groups [3, 5] -> trims to [3, 4], surplus [1 host];
+    # no 1-host template and 3->4 needs exactly 1: grow fires.
+    fitted, idle = fit_host_groups([[0, 1, 2], [3, 4, 5, 6, 7]], [3, 4])
+    assert idle == []
+    assert sorted(len(g) for g in fitted) == [4, 4]
+    assert sorted(h for g in fitted for h in g) == list(range(8))
+
+
+def test_fit_truly_unplaceable_idles():
+    from oobleck_tpu.execution.reconfigure import fit_host_groups
+
+    # Templates {2}: 3 survivors -> one host has nowhere to go.
+    fitted, idle = fit_host_groups([[0, 1, 2]], [2])
+    assert sorted(map(sorted, fitted)) == [[0, 1]]
+    assert idle == [2]
+
+
+def test_fit_no_group_fits_raises():
+    from oobleck_tpu.execution.reconfigure import fit_host_groups
+
+    with pytest.raises(RuntimeError, match="no template fits"):
+        fit_host_groups([[0]], [2])
