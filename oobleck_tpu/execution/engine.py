@@ -655,28 +655,45 @@ class OobleckEngine:
     # ------------------------------------------------------------------ #
 
     def _has_validation_split(self) -> bool:
-        if self._has_val_split is None:
-            from oobleck_tpu.execution.dataset import has_validation_split
+        """Whether a USABLE validation split exists.
 
-            self._has_val_split = has_validation_split(
+        The raw split probe is not enough: a split that tokenizes to zero
+        full sequences must count as absent, or the reserve is sized 0 and
+        evaluate() would score training data. So a present split is
+        tokenized here (validation splits are small) and cached for
+        evaluate()."""
+        if self._has_val_split is None:
+            from oobleck_tpu.execution.dataset import (
+                build_eval_dataset, has_validation_split)
+
+            present = has_validation_split(
                 self.args.model.dataset_path, self.args.model.dataset_name
             )
-        return self._has_val_split
-
-    @property
-    def eval_dataset(self):
-        if self._eval_ds_cache is _UNSET:
-            from oobleck_tpu.execution.dataset import build_eval_dataset
-
-            self._eval_ds_cache = (
-                build_eval_dataset(
+            if present:
+                ds = build_eval_dataset(
                     self.args.model.dataset_path,
                     self.args.model.dataset_name,
                     model_name=self.args.model.model_name,
                     seq_length=self.seq_len,
                 )
-                if self._has_validation_split() else None
-            )
+                if len(ds) == 0:
+                    logger.warning(
+                        "validation split tokenizes to 0 sequences at "
+                        "seq_length %d; treating it as absent (held-out "
+                        "tail reserve applies)", self.seq_len,
+                    )
+                    present = False
+                else:
+                    self._eval_ds_cache = ds
+            self._has_val_split = present
+        return self._has_val_split
+
+    @property
+    def eval_dataset(self):
+        if self._eval_ds_cache is _UNSET:
+            # _has_validation_split tokenizes and caches a usable split.
+            if not self._has_validation_split():
+                self._eval_ds_cache = None
         return self._eval_ds_cache
 
     def _eval_reserve(self) -> int:
@@ -701,6 +718,15 @@ class OobleckEngine:
         )
         bucket = self.args.job.microbatch_size * sum(mb_counts)
         pool = self.eval_dataset
+        if pool is not None and len(pool) == 0:
+            # A real validation split can tokenize to zero full sequences
+            # (fewer than seq_length tokens); treat it as absent rather than
+            # dividing by zero in _CyclicView.
+            logger.warning(
+                "validation split tokenizes to 0 sequences at seq_length %d; "
+                "falling back to the held-out training tail", self.seq_len,
+            )
+            pool = None
         if pool is None:
             n = len(self.dataset)
             eval_n = self._eval_reserve()

@@ -304,7 +304,8 @@ class GPTModel:
                 else:
                     bias = full
             attn_out = causal_attention(
-                qkv[0], qkv[1], qkv[2], impl=c.attention_impl, bias=bias
+                qkv[0], qkv[1], qkv[2], impl=c.attention_impl, bias=bias,
+                constant_bias=True,  # ALiBi is position-only
             )
         wo = _maybe_unshard(p["attn"]["wo"], f_, 2).astype(dt)          # [Hl,D,E]
         out = jnp.einsum("bhsd,hde->bse", attn_out, wo)

@@ -95,9 +95,15 @@ def select_attention_impl(impl: str = "auto"):
 
         return ring_attention
     if impl == "auto":
-        # Pallas flash is opt-in until its perf is validated per-platform;
-        # auto currently means the XLA path everywhere. Never silently
+        # On TPU the Pallas flash kernel (fwd + bwd) is the default — it
+        # keeps HBM traffic linear in S where the XLA path materializes
+        # [S, S] logits. Elsewhere (CPU mesh tests) the kernel would run in
+        # interpreter mode, so the fused XLA path is faster. Never silently
         # swallow an ImportError here — a masked fallback hides real bugs.
+        if jax.default_backend() == "tpu":
+            from oobleck_tpu.ops.flash import flash_attention
+
+            return flash_attention
         return _xla_causal_attention
     raise ValueError(f"unknown attention impl: {impl!r}")
 
@@ -110,9 +116,31 @@ def causal_attention(
     impl: str = "auto",
     scale: float | None = None,
     bias: jax.Array | None = None,
+    causal: bool = True,
+    constant_bias: bool = False,
 ) -> jax.Array:
-    if bias is not None:
-        # Additive biases (ALiBi) run through the XLA path; the flash kernel
-        # does not fold biases yet.
-        return _xla_causal_attention(q, k, v, scale=scale, bias=bias)
-    return select_attention_impl(impl)(q, k, v, scale=scale)
+    """Dispatching attention entry point.
+
+    `constant_bias=True` asserts the bias carries no gradient (ALiBi and
+    other position-only biases) — required for the flash kernel, whose VJP
+    treats the bias as a constant. Learned/batch-dependent biases and
+    cross-attention (seq_q != seq_k) always take the XLA path.
+    """
+    fn = select_attention_impl(impl)
+    if fn.__name__ == "ring_attention":
+        # Ring handles unbiased causal self-attention only; anything else
+        # falls back to XLA (single-device call — the sequence-parallel path
+        # reaches ring_attention directly with its own checks).
+        if bias is None and causal:
+            return fn(q, k, v, scale=scale)
+        return _xla_causal_attention(q, k, v, scale=scale, bias=bias,
+                                     causal=causal)
+    flash_ok = (
+        q.shape[-2] == k.shape[-2]
+        and (bias is None
+             or (constant_bias and (bias.ndim < 4 or bias.shape[0] == 1)))
+    )
+    if fn is _xla_causal_attention or not flash_ok:
+        return _xla_causal_attention(q, k, v, scale=scale, bias=bias,
+                                     causal=causal)
+    return fn(q, k, v, scale=scale, bias=bias, causal=causal)

@@ -192,6 +192,28 @@ def test_eval_disjoint_and_rotating_default_config(cache_env, devices8):
     assert eval_first != eval_second  # windows rotate across calls
 
 
+def test_empty_validation_split_counts_as_absent(trained_engine, monkeypatch):
+    """A validation split that tokenizes to zero sequences must count as
+    absent at probe time, so the held-out tail reserve is sized nonzero and
+    evaluate() never scores training data (nor divides by zero)."""
+    import oobleck_tpu.execution.dataset as ds_mod
+    from oobleck_tpu.execution.engine import _UNSET
+
+    monkeypatch.setattr(ds_mod, "has_validation_split", lambda *a, **k: True)
+    monkeypatch.setattr(ds_mod, "build_eval_dataset", lambda *a, **k: [])
+    trained_engine._has_val_split = None
+    trained_engine._eval_ds_cache = _UNSET
+    try:
+        assert trained_engine._has_validation_split() is False
+        assert trained_engine._eval_reserve() > 0
+        assert trained_engine.eval_dataset is None
+        loss = trained_engine.evaluate(num_batches=2)
+        assert np.isfinite(loss)
+    finally:
+        trained_engine._has_val_split = None
+        trained_engine._eval_ds_cache = _UNSET
+
+
 def test_reconfigure_no_idle_survivors_two_failures(cache_env, devices8):
     """Every surviving host keeps training after each of two consecutive
     host losses (surplus re-fold + immutable host-index lookup), and the
