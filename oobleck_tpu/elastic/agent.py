@@ -25,6 +25,8 @@ from __future__ import annotations
 import asyncio
 import logging
 import multiprocessing as mp
+import os
+import time
 from dataclasses import dataclass
 
 from oobleck_tpu.config import OobleckArguments
@@ -38,6 +40,10 @@ from oobleck_tpu.elastic.message import (
 logger = logging.getLogger("oobleck.agent")
 
 PING_INTERVAL = 10.0
+# Multi-host: how long an unexplained worker death may wait for the
+# RECONFIGURATION that explains it (a peer died mid-collective) before the
+# agent gives up and terminates.
+WORKER_DEATH_GRACE = 30.0
 
 
 @dataclass
@@ -72,13 +78,45 @@ class OobleckAgent:
         """Worker death must surface as a host failure: drop the master
         connection so disconnect-based detection fires (the reference treats
         worker-level failure as out of scope, agent.py:171-173 — here the
-        agent exits with its worker so the cluster reconfigures)."""
+        agent exits with its worker so the cluster reconfigures).
+
+        Exceptions: exit code 0 is training completing normally (exit
+        cleanly, don't declare the host dead); and in multi-host mode a
+        worker dying of a PEER's failure (collective partner gone) gets a
+        grace window for the explaining RECONFIGURATION to arrive — the
+        respawn replaces self.worker, clearing the pending death."""
+        pending: tuple[object, float] | None = None
         while True:
             await asyncio.sleep(1.0)
-            if self.worker is not None and not self.worker.process.is_alive():
-                logger.error("worker process died (exit=%s); terminating agent",
-                             self.worker.process.exitcode)
-                self.terminate()
+            w = self.worker
+            if w is None or w.process.is_alive():
+                pending = None
+                continue
+            if w.process.exitcode == 0:
+                logger.info("worker finished training; agent exiting")
+                try:
+                    async with self._send_lock:
+                        await send_request(self._writer, RequestType.JOB_DONE)
+                except (ConnectionError, OSError):
+                    pass
+                raise SystemExit(0)
+            if self._multihost():
+                if pending is None or pending[0] is not w:
+                    pending = (w, time.monotonic())
+                    logger.warning(
+                        "worker died (exit=%s); waiting %.0fs for a "
+                        "reconfiguration that explains it",
+                        w.process.exitcode, WORKER_DEATH_GRACE)
+                    continue
+                if time.monotonic() - pending[1] < WORKER_DEATH_GRACE:
+                    continue
+            logger.error("worker process died (exit=%s); terminating agent",
+                         w.process.exitcode)
+            self.terminate()
+
+    @staticmethod
+    def _multihost() -> bool:
+        return os.environ.get("OOBLECK_MULTIHOST") == "1"
 
     async def connect_to_master(self) -> None:
         self._reader, self._writer = await asyncio.open_connection(
@@ -130,6 +168,33 @@ class OobleckAgent:
         )
         proc.start()
         self.worker = Worker(pipe=parent_pipe, process=proc)
+        logger.info("agent %s launched worker pid=%d", self.agent_ip, proc.pid)
+
+    def _stop_worker(self, timeout: float = 15.0) -> None:
+        """Terminate the worker, escalating to SIGKILL — a worker wedged in
+        a collective with a dead peer can ignore SIGTERM."""
+        w = self.worker
+        self.worker = None  # watch loop must not treat this as a death
+        if w is None or not w.process.is_alive():
+            return
+        w.process.terminate()
+        w.process.join(timeout)
+        if w.process.is_alive():
+            logger.warning("worker ignored SIGTERM; killing")
+            w.process.kill()
+            w.process.join(5.0)
+
+    def respawn_worker(self) -> None:
+        """Multi-host recovery: restart the worker against the surviving
+        hosts. The fresh worker re-runs the coordinator chain (a new
+        jax.distributed world of the survivors) and restores position and
+        weights from the latest checkpoint."""
+        t0 = time.monotonic()
+        self._stop_worker()
+        self.args.dist.node_ips = list(self.node_ips)
+        self.launch_worker()
+        logger.info("worker respawned for %d survivors in %.1fs",
+                    len(self.node_ips), time.monotonic() - t0)
 
     # ------------------------------------------------------------------ #
 
@@ -147,7 +212,7 @@ class OobleckAgent:
             if kind == ResponseType.PONG.value:
                 continue
             if kind == ResponseType.RECONFIGURATION.value:
-                self.on_reconfiguration(msg["lost_ip"])
+                await self.on_reconfiguration(msg["lost_ip"])
             elif kind == ResponseType.FORWARD_COORDINATOR.value:
                 if self.worker is not None:
                     self.worker.pipe.send(
@@ -159,7 +224,7 @@ class OobleckAgent:
                         {"kind": "dist_info", "dist_info": msg["dist_info"]}
                     )
 
-    def on_reconfiguration(self, lost_ip: str) -> None:
+    async def on_reconfiguration(self, lost_ip: str) -> None:
         """Reference on_receive_reconfiguration (agent.py:217-232)."""
         logger.warning("host %s lost", lost_ip)
         if lost_ip == self.agent_ip:
@@ -169,7 +234,20 @@ class OobleckAgent:
             return
         if lost_ip in self.node_ips:
             self.node_ips.remove(lost_ip)
-        if self.worker is not None:
+        if self._multihost():
+            w = self.worker
+            if w is not None and w.process.exitcode == 0:
+                # Our own training already completed; a peer's departure
+                # (however the master classified it) changes nothing.
+                logger.info("training already complete; ignoring host loss")
+                return
+            # A peer process is gone: the jax.distributed world is broken
+            # and cannot shrink in place — restart the worker over the
+            # survivors (checkpoint restore carries weights + data position).
+            # to_thread: _stop_worker joins for up to 20s and must not stall
+            # the response/ping/relay loops mid-recovery.
+            await asyncio.to_thread(self.respawn_worker)
+        elif self.worker is not None:
             self.worker.pipe.send({"kind": "reconfigure", "lost_ip": lost_ip})
 
     async def ping_loop(self) -> None:
@@ -185,14 +263,19 @@ class OobleckAgent:
         """Poll the worker pipe for the coordinator announcement and forward
         it to the master (reference forward_worker_port, agent.py:181-188)."""
         while True:
-            if self.worker is not None and self.worker.pipe.poll():
-                msg = self.worker.pipe.recv()
-                if msg.get("kind") == "coordinator":
-                    async with self._send_lock:
-                        await send_request(
-                            self._writer, RequestType.FORWARD_COORDINATOR,
-                            {"address": msg["address"]},
-                        )
+            try:
+                if self.worker is not None and self.worker.pipe.poll():
+                    msg = self.worker.pipe.recv()
+                    if msg.get("kind") == "coordinator":
+                        async with self._send_lock:
+                            await send_request(
+                                self._writer, RequestType.FORWARD_COORDINATOR,
+                                {"address": msg["address"]},
+                            )
+            except (EOFError, OSError):
+                # Worker died with the pipe open mid-poll; the watch loop
+                # owns death handling.
+                await asyncio.sleep(1.0)
             await asyncio.sleep(0.05)
 
     def terminate(self) -> None:

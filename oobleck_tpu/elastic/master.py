@@ -46,6 +46,7 @@ class AgentInfo:
     ip: str
     reader: asyncio.StreamReader
     writer: asyncio.StreamWriter
+    clean_exit: bool = False  # JOB_DONE received: departure is not a failure
 
 
 class LocalLauncher:
@@ -56,11 +57,13 @@ class LocalLauncher:
 
     async def launch(self, ip: str, master_ip: str, master_port: int,
                      args: OobleckArguments) -> None:
-        self.procs.append(subprocess.Popen(
+        proc = subprocess.Popen(
             [sys.executable, "-m", "oobleck_tpu.elastic.agent",
              "--master-ip", master_ip, "--master-port", str(master_port),
              "--agent-ip", ip],
-        ))
+        )
+        self.procs.append(proc)
+        logger.info("launched agent for %s (pid %d)", ip, proc.pid)
 
 
 class SSHLauncher:
@@ -213,6 +216,9 @@ class OobleckMasterDaemon:
                 )
                 await send_response(agent.writer, ResponseType.SUCCESS,
                                     {"dist_info": info.to_dict()})
+            elif kind == RequestType.JOB_DONE.value:
+                logger.info("agent %s reports training complete", agent.ip)
+                agent.clean_exit = True
             elif kind == RequestType.FORWARD_COORDINATOR.value:
                 # First agent's worker announces the JAX coordinator address;
                 # relay to everyone (reference forward_rank0_port_handler,
@@ -229,10 +235,13 @@ class OobleckMasterDaemon:
 
     async def _close_agent(self, ip: str) -> None:
         """Reference close_agent (master.py:192-203): drop the agent and
-        broadcast the loss to survivors."""
+        broadcast the loss to survivors — unless the agent announced a clean
+        JOB_DONE departure (completion is not a failure)."""
         agent = self.agents.pop(ip, None)
         if agent is not None:
             agent.writer.close()
+        if agent is not None and agent.clean_exit:
+            return
         for other in list(self.agents.values()):
             try:
                 await send_response(other.writer, ResponseType.RECONFIGURATION,

@@ -29,6 +29,10 @@ class RequestType(str, Enum):
     REGISTER_AGENT = "register_agent"
     PING = "ping"
     FORWARD_COORDINATOR = "forward_coordinator"  # reference: FORWARD_RANK0_PORT
+    # Clean departure: the agent's worker completed training. The master
+    # drops the agent WITHOUT broadcasting RECONFIGURATION — completion must
+    # not look like a failure to the surviving agents.
+    JOB_DONE = "job_done"
 
 
 class ResponseType(str, Enum):

@@ -2,24 +2,100 @@
 
 Capability match for /root/reference/oobleck/elastic/worker.py:13-34. The
 worker owns every local chip (no per-device pinning) and drives the engine:
-build -> initialize distributed -> instantiate pipelines -> train.
+initialize the JAX runtime -> build -> instantiate pipelines -> train.
+
+Multi-host (OOBLECK_MULTIHOST=1): the JAX distributed runtime MUST come up
+before anything touches a backend (profiling, model init), so the coordinator
+chain runs here, first thing — host 0's worker picks a free port and
+announces `ip:port` up its agent pipe (agent -> master -> every agent ->
+every worker pipe), the TPU equivalent of the reference's rank-0 TCPStore
+port chain + NCCL world init (engine.py:563-593).
 """
 
 from __future__ import annotations
 
 import logging
+import os
+import socket
+import time
 
 from oobleck_tpu.config import OobleckArguments
 
 logger = logging.getLogger("oobleck.worker")
 
 
+def coordinator_announcement(address: str, world: int) -> dict:
+    """The coordinator message. `world` is the generation tag: the survivor
+    set only ever shrinks, so its size uniquely identifies a reconfiguration
+    round — stale announcements from an earlier (larger) world must not be
+    adopted by respawned workers. Shared by the worker-side chain here and
+    the embedded-engine chain (engine._initialize_multihost)."""
+    return {"kind": "coordinator", "address": address, "world": world}
+
+
+def coordinator_address_if_current(msg, world: int) -> str | None:
+    """Address from a coordinator message iff it matches this generation
+    (untagged messages are trusted — the legacy single-generation form)."""
+    if not isinstance(msg, dict) or msg.get("kind") != "coordinator":
+        return None
+    if msg.get("world", world) != world:
+        return None
+    return msg["address"]
+
+
+def _init_jax_distributed(pipe, agent_ip: str, args: OobleckArguments,
+                          timeout_s: float = 120.0) -> None:
+    """Run the coordinator chain and bring up jax.distributed.
+
+    Called before the engine exists, so this owns the pipe exclusively:
+    non-coordinator messages seen while waiting are dropped (none are
+    expected before initialization completes)."""
+    import jax
+
+    node_ips = list(args.dist.node_ips)
+    world = len(node_ips)
+    process_id = node_ips.index(agent_ip)
+    if process_id == 0:
+        with socket.socket() as s:
+            s.bind((agent_ip, 0))
+            port = s.getsockname()[1]
+        address = f"{agent_ip}:{port}"
+        pipe.send(coordinator_announcement(address, world))
+    else:
+        deadline = time.monotonic() + timeout_s
+        address = None
+        while time.monotonic() < deadline:
+            if pipe.poll(1.0):
+                msg = pipe.recv()
+                addr = coordinator_address_if_current(msg, world)
+                if addr is not None:
+                    address = addr
+                    break
+        if address is None:
+            raise TimeoutError("no coordinator address from the agent")
+    jax.distributed.initialize(
+        coordinator_address=address,
+        num_processes=len(node_ips),
+        process_id=process_id,
+    )
+    logger.info("jax.distributed initialized: %s (process %d/%d)",
+                address, process_id, len(node_ips))
+
+
 def worker_main(pipe, agent_ip: str, args_dict: dict) -> None:
+    # Fresh spawned process: without a handler, INFO logs (per-step loss,
+    # checkpoint/restore lines — the operator's training signal) vanish.
+    logging.basicConfig(
+        level=logging.INFO,
+        format=f"[worker {agent_ip}] %(name)s: %(message)s")
     args = OobleckArguments.from_dict(args_dict)
     job = args.job
     # Sanity mirrored from the reference (worker.py:27-28); JobArguments also
     # enforces this at construction.
     assert job.global_microbatch_size % job.microbatch_size == 0
+
+    if os.environ.get("OOBLECK_MULTIHOST") == "1":
+        _init_jax_distributed(pipe, agent_ip, args)
 
     from oobleck_tpu.execution.engine import OobleckEngine
 

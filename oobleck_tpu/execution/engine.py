@@ -55,6 +55,18 @@ logger = logging.getLogger("oobleck.engine")
 DEFAULT_HBM_BYTES = 16 * 2**30  # v5e/v4 chip HBM, used when stats are absent
 
 
+def _jax_distributed_active() -> bool:
+    """Whether jax.distributed.initialize has already run in this process."""
+    try:
+        from jax._src.distributed import global_state
+
+        return global_state.client is not None
+    except Exception:
+        # Never probe via jax.process_count() here: it initializes the local
+        # backend, which is exactly what this gate exists to prevent.
+        return False
+
+
 class DataParallelEngine:
     """Layer-granularity gradient sync across heterogeneous pipelines
     (reference engine.py:363-412): each layer's grads are summed over every
@@ -219,7 +231,12 @@ class OobleckEngine:
         """
         import os
 
-        if os.environ.get("OOBLECK_MULTIHOST") == "1" and self.agent_pipe is not None:
+        if (os.environ.get("OOBLECK_MULTIHOST") == "1"
+                and self.agent_pipe is not None
+                and not _jax_distributed_active()):
+            # Normally worker_main brought the runtime up before the engine
+            # was built (backends must not initialize first); this is the
+            # embedded-engine path.
             self._initialize_multihost()
         self.devices = (
             list(self._injected_devices) if self._injected_devices is not None
@@ -287,6 +304,12 @@ class OobleckEngine:
         import socket
         import time as _time
 
+        from oobleck_tpu.elastic.worker import (
+            coordinator_address_if_current,
+            coordinator_announcement,
+        )
+
+        world = len(self.host_ips)
         process_id = self.host_ips.index(self.agent_ip)
         if process_id == 0:
             port = 0
@@ -294,7 +317,7 @@ class OobleckEngine:
                 s.bind(("", 0))
                 port = s.getsockname()[1]
             address = f"{self.agent_ip}:{port}"
-            self.agent_pipe.send({"kind": "coordinator", "address": address})
+            self.agent_pipe.send(coordinator_announcement(address, world))
         else:
             # The ReconfigurationEngine thread owns the pipe; coordinator
             # messages arrive via the control queue it feeds.
@@ -307,8 +330,9 @@ class OobleckEngine:
                     msg = self._control_msgs.get(timeout=1.0)
                 except _queue.Empty:
                     continue
-                if msg.get("kind") == "coordinator":
-                    address = msg["address"]
+                addr = coordinator_address_if_current(msg, world)
+                if addr is not None:
+                    address = addr
                     break
             if address is None:
                 raise TimeoutError("no coordinator address from the agent")
@@ -625,6 +649,9 @@ class OobleckEngine:
         ckpt_dir = self.args.execution.checkpoint_dir
         if not ckpt_dir:
             return
+        # Multi-process: EVERY process calls save — orbax writes host-type
+        # values from the primary process only but runs a cross-process
+        # barrier inside save(); gating non-zero processes out deadlocks it.
         if self.fused is not None:
             params, opt = self.fused.layer_state()
         else:

@@ -257,15 +257,27 @@ class FusedPipeline:
         return metrics.loss
 
     def eval_step(self, batch: np.ndarray):
-        tokens_mb = jnp.asarray(batch)
+        tokens_mb = np.asarray(batch)
+        if jax.process_count() > 1:
+            tokens_mb = jax.make_array_from_callback(
+                tokens_mb.shape, self._step_fn.token_sharding,
+                lambda idx: tokens_mb[idx],
+            )
         return self._eval_fn(self.state.params, tokens_mb)
 
     def layer_state(self):
-        """(params_layers, opt_layers) in the engine's checkpoint form."""
-        params_layers = params_to_layers(self.model, self.state.params)
+        """(params_layers, opt_layers) in the engine's checkpoint form.
+
+        State leaves come to host first (local shard assembly): the
+        per-layer slicing below would otherwise be an eager op on
+        non-addressable arrays under multi-process SPMD."""
+        from oobleck_tpu.execution.checkpoint import to_host_local
+
+        params = jax.tree.map(to_host_local, self.state.params)
+        opt_state = jax.tree.map(to_host_local, self.state.opt_state)
+        params_layers = params_to_layers(self.model, params)
         opt_layers = opt_state_to_layers(
-            self.model, self.optimizer, self.state.params,
-            self.state.opt_state,
+            self.model, self.optimizer, params, opt_state,
         )
         return params_layers, opt_layers
 

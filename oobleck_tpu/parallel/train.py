@@ -33,6 +33,7 @@ from typing import Any, NamedTuple
 
 import jax
 import jax.numpy as jnp
+import numpy as np
 import optax
 from jax import lax
 from jax.sharding import NamedSharding, PartitionSpec as P
@@ -258,10 +259,20 @@ def build_train_step(model, mesh, *, num_microbatches: int, optimizer=None,
         b, seq = tokens.shape
         assert b % num_mb == 0, f"batch {b} not divisible by {num_mb} microbatches"
         assert seq % sp == 0, f"seq {seq} not divisible by seq-parallel {sp}"
-        tokens_mb = tokens.reshape(num_mb, b // num_mb, seq)
+        tokens_mb = np.asarray(tokens).reshape(num_mb, b // num_mb, seq)
+        if jax.process_count() > 1:
+            # Multi-process SPMD: every host computes the same global batch
+            # (same dataset + sampler seed); build the global array from the
+            # host-local copy — numpy inputs cannot carry non-trivial
+            # shardings across processes.
+            tokens_mb = jax.make_array_from_callback(
+                tokens_mb.shape, token_sharding,
+                lambda idx: tokens_mb[idx],
+            )
         return jit_step(state, tokens_mb)
 
     wrapped_step.jitted = jit_step
     wrapped_step.loss_fn = loss_fn
     wrapped_step.state_shardings = state_shardings
+    wrapped_step.token_sharding = token_sharding
     return jit_init, wrapped_step

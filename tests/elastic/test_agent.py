@@ -103,7 +103,7 @@ async def test_reconfiguration_forwarded_to_worker(job_args):
     agent = await registered_agent(daemon, "10.0.0.1")
     agent.worker, child = fake_worker()
 
-    agent.on_reconfiguration("10.0.0.2")
+    await agent.on_reconfiguration("10.0.0.2")
     assert agent.node_ips == ["10.0.0.1", "10.0.0.3"]
     assert child.poll(1)
     assert child.recv() == {"kind": "reconfigure", "lost_ip": "10.0.0.2"}
@@ -119,7 +119,7 @@ async def test_kill_switch_terminates_self(job_args):
     agent.worker, _ = fake_worker()
 
     with pytest.raises(SystemExit):
-        agent.on_reconfiguration("10.0.0.2")
+        await agent.on_reconfiguration("10.0.0.2")
     assert agent.worker.process.terminated
     task.cancel()
 

@@ -1,0 +1,194 @@
+"""Multi-process elastic integration: the REAL master -> agent -> worker
+chain across separate OS processes.
+
+Mirrors the reference's multi-process harness
+(/root/reference/tests/conftest.py:347-474 and
+tests/execution/test_engine.py:601-1065, which spawn one torch process per
+GPU and SIGKILL one to test recovery). Here: a master subprocess launches
+one agent subprocess per "host" (loopback aliases 127.0.0.1 / 127.0.0.2),
+each agent spawns a worker process, the workers bring up a 2-process
+jax.distributed CPU world through the coordinator relay
+(worker -> agent -> master -> agents -> workers) and train the fused SPMD
+path together. The test then SIGKILLs one host's worker AND agent: the
+master detects the disconnect, broadcasts RECONFIGURATION, and the
+surviving agent respawns its worker over the survivor set, restoring
+weights + data position from the latest checkpoint. Recovery wall-time is
+asserted under the 60 s BASELINE target.
+
+This test runs everything in subprocesses (no jax use in this process), so
+it does not depend on the conftest CPU mesh.
+"""
+
+from __future__ import annotations
+
+import os
+import re
+import signal
+import socket
+import subprocess
+import sys
+import time
+from pathlib import Path
+
+import pytest
+import yaml
+
+TINY_MODEL = {
+    "num_layers": 2,
+    "hidden_size": 64,
+    "num_heads": 2,
+    "max_position_embeddings": 128,
+    "vocab_size": 256,
+}
+STEPS = 6
+HOSTS = ["127.0.0.1", "127.0.0.2"]
+
+
+def _free_port() -> int:
+    s = socket.socket()
+    s.bind(("127.0.0.1", 0))
+    port = s.getsockname()[1]
+    s.close()
+    return port
+
+
+def _wait_for(pattern: str, log: Path, deadline: float, *,
+              after: int = 0) -> re.Match:
+    """Poll `log` until `pattern` matches past byte offset `after`."""
+    rx = re.compile(pattern)
+    while time.monotonic() < deadline:
+        if log.exists():
+            m = rx.search(log.read_text()[after:])
+            if m:
+                return m
+        time.sleep(0.25)
+    tail = log.read_text()[-4000:] if log.exists() else "<no log>"
+    raise AssertionError(f"timed out waiting for /{pattern}/; log tail:\n{tail}")
+
+
+def _kill(pid: int) -> None:
+    try:
+        os.kill(pid, signal.SIGKILL)
+    except ProcessLookupError:
+        pass
+
+
+def test_multiprocess_elastic_train_and_recover(tmp_path):
+    env = os.environ.copy()
+    env.update({
+        "JAX_PLATFORMS": "cpu",
+        "PALLAS_AXON_POOL_IPS": "",
+        "XLA_FLAGS": "--xla_force_host_platform_device_count=2",
+        "OOBLECK_MULTIHOST": "1",
+        "OOBLECK_TPU_CACHE": str(tmp_path / "cache"),
+    })
+    port = _free_port()
+    cfg = {
+        "dist": {"master_ip": "127.0.0.1", "master_port": port,
+                 "node_ips": HOSTS},
+        "job": {"microbatch_size": 4, "global_microbatch_size": 8,
+                "steps": STEPS},
+        "model": {"model_name": "gpt2", "dataset_path": "synthetic",
+                  "model_args": TINY_MODEL},
+        "execution": {"engine_path": "fused", "tensor_parallel": 1,
+                      "fsdp": 1, "checkpoint_dir": str(tmp_path / "ckpt"),
+                      "checkpoint_interval": 1},
+    }
+    cfg_path = tmp_path / "job.yaml"
+    cfg_path.write_text(yaml.safe_dump(cfg))
+
+    # Pre-generate the profile so the two agents don't race the profiler
+    # over the shared cache dir.
+    subprocess.run(
+        [sys.executable, "-c",
+         "from oobleck_tpu.planning.profiler import profile\n"
+         "from oobleck_tpu.config import ExecutionArguments\n"
+         f"profile('gpt2', {TINY_MODEL!r}, microbatch_size=4, seq_len=128,\n"
+         "        execution=ExecutionArguments(engine_path='fused', fsdp=1))\n"],
+        env=env, check=True, timeout=240, cwd=str(Path(__file__).parents[2]),
+    )
+
+    log = tmp_path / "cluster.log"
+    procs: list[subprocess.Popen] = []
+    pids_to_kill: set[int] = set()
+    try:
+        with open(log, "wb") as logf:
+            master = subprocess.Popen(
+                [sys.executable, "-m", "oobleck_tpu.elastic.master",
+                 "--port", str(port)],
+                env=env, stdout=logf, stderr=subprocess.STDOUT,
+                cwd=str(Path(__file__).parents[2]),
+            )
+        procs.append(master)
+        deadline = time.monotonic() + 420
+        _wait_for(r"master listening", log, deadline)
+
+        subprocess.run(
+            [sys.executable, "-m", "oobleck_tpu.elastic.run",
+             "--config-path", str(cfg_path)],
+            env=env, check=True, timeout=60,
+            cwd=str(Path(__file__).parents[2]),
+        )
+
+        # Agents register and each launches a worker.
+        agent_pids = {
+            ip: int(_wait_for(
+                rf"launched agent for {re.escape(ip)} \(pid (\d+)\)",
+                log, deadline).group(1))
+            for ip in HOSTS
+        }
+        worker_pids = {
+            ip: int(_wait_for(
+                rf"agent {re.escape(ip)} launched worker pid=(\d+)",
+                log, deadline).group(1))
+            for ip in HOSTS
+        }
+        pids_to_kill.update(agent_pids.values())
+        pids_to_kill.update(worker_pids.values())
+
+        # The 2-process jax.distributed world comes up and training starts.
+        _wait_for(r"jax\.distributed initialized: .* \(process 1/2\)",
+                  log, deadline)
+        _wait_for(rf"step 2/{STEPS} loss [\d.]+", log, deadline)
+        _wait_for(r"saved checkpoint", log, deadline)
+
+        # ---- failure injection: SIGKILL host 2's worker AND agent ----
+        offset = log.stat().st_size
+        t_kill = time.monotonic()
+        _kill(worker_pids[HOSTS[1]])
+        _kill(agent_pids[HOSTS[1]])
+
+        _wait_for(rf"agent {re.escape(HOSTS[1])} disconnected", log, deadline)
+        _wait_for(r"worker respawned for 1 survivors", log, deadline,
+                  after=offset)
+        new_worker = int(_wait_for(
+            rf"agent {re.escape(HOSTS[0])} launched worker pid=(\d+)",
+            log, deadline, after=offset).group(1))
+        pids_to_kill.add(new_worker)
+        # The respawned worker restores from the checkpoint (weights + data
+        # position) rather than restarting from scratch.
+        _wait_for(r"restoring from .*step_", log, deadline, after=offset)
+        m = _wait_for(rf"step (\d+)/{STEPS} loss ([\d.]+)", log, deadline,
+                      after=offset)
+        recovery_s = time.monotonic() - t_kill
+        # Recovery includes process respawn + recompile + restore; BASELINE
+        # targets < 60 s per failure.
+        assert recovery_s < 60, f"recovery took {recovery_s:.1f}s"
+        assert int(m.group(1)) >= 2, "restored step regressed to scratch"
+        assert float(m.group(2)) > 0
+        print(f"multiprocess recovery in {recovery_s:.1f}s")
+
+        _wait_for(rf"step {STEPS}/{STEPS} loss [\d.]+", log, deadline,
+                  after=offset)
+        _wait_for(r"worker finished training; agent exiting", log, deadline,
+                  after=offset)
+    finally:
+        for p in procs:
+            p.terminate()
+        for pid in pids_to_kill:
+            _kill(pid)
+        for p in procs:
+            try:
+                p.wait(timeout=10)
+            except subprocess.TimeoutExpired:
+                p.kill()
