@@ -111,12 +111,14 @@ class OobleckSampler:
 
 
 class OobleckDataLoader:
-    """Assembles sampler microbatches into numpy token arrays.
+    """Assembles sampler microbatches into numpy batch dicts.
 
     One `next_batch()` call returns ALL of this pipeline's microbatches for
-    one iteration, stacked [num_mb, mb_size, seq] — matching the fused train
+    one iteration as {field: [num_mb, mb_size, ...]} — matching the train
     step's input contract (the reference loads one microbatch per schedule
-    instruction instead, pipeline.py:158-167).
+    instruction instead, pipeline.py:158-167). Fields come from the
+    dataset's per-sample dict (input_ids for causal LM; labels/loss_mask,
+    decoder_input_ids, pixel_values for the other objectives).
     """
 
     def __init__(self, dataset, sampler: OobleckSampler):
@@ -131,10 +133,12 @@ class OobleckDataLoader:
     def epoch(self) -> int:
         return self.sampler.epoch
 
-    def next_batch(self) -> np.ndarray:
+    def next_batch(self) -> dict[str, np.ndarray]:
         mbs = self.sampler.next_iteration()
-        batches = []
+        per_mb: list[dict[str, np.ndarray]] = []
         for idx_list in mbs:
-            rows = [self.dataset[int(i)]["input_ids"] for i in idx_list]
-            batches.append(np.stack(rows))
-        return np.stack(batches)
+            rows = [self.dataset[int(i)] for i in idx_list]
+            per_mb.append({
+                k: np.stack([r[k] for r in rows]) for k in rows[0]
+            })
+        return {k: np.stack([mb[k] for mb in per_mb]) for k in per_mb[0]}

@@ -101,14 +101,123 @@ class HFTextDataset:
         return {"input_ids": self._chunks[idx]}
 
 
+class MLMView:
+    """Dataset-side masked-LM corruption over a token dataset.
+
+    Mirrors the reference's HF MLM data collator
+    (/root/reference/oobleck/execution/dataset.py:60-86, which random-masks
+    in collate): 15% of positions are selected, 80% become [MASK], 10% a
+    random token, 10% kept; labels are the clean tokens and loss_mask marks
+    the selected positions. Corruption is idx-seeded (deterministic,
+    rank-independent) so heterogeneous pipelines see identical batches.
+    """
+
+    def __init__(self, base, vocab_size: int, mask_token_id: int,
+                 seed: int = 7):
+        self.base = base
+        self.vocab_size = vocab_size
+        self.mask_token_id = mask_token_id
+        self.seed = seed
+
+    def __len__(self) -> int:
+        return len(self.base)
+
+    def __getitem__(self, idx: int) -> dict:
+        tokens = self.base[idx]["input_ids"]
+        rng = np.random.default_rng(self.seed * 9_999_991 + idx)
+        select = rng.random(tokens.shape) < 0.15
+        roll = rng.random(tokens.shape)
+        randoms = rng.integers(0, self.vocab_size, tokens.shape,
+                               dtype=tokens.dtype)
+        corrupted = np.where(select & (roll < 0.8), self.mask_token_id, tokens)
+        corrupted = np.where(select & (roll >= 0.8) & (roll < 0.9),
+                             randoms, corrupted)
+        return {
+            "input_ids": corrupted.astype(np.int32),
+            "labels": tokens.astype(np.int32),
+            "loss_mask": select.astype(np.float32),
+        }
+
+
+class Seq2SeqView:
+    """Denoising-style seq2seq batches from a token dataset: the decoder
+    reconstructs the sequence with teacher forcing (decoder_input_ids =
+    labels shifted right from pad), exercising the full encoder-decoder
+    path (cf. the reference's seq2seq collator wiring, dataset.py:60-86)."""
+
+    def __init__(self, base, pad_token_id: int = 0):
+        self.base = base
+        self.pad_token_id = pad_token_id
+
+    def __len__(self) -> int:
+        return len(self.base)
+
+    def __getitem__(self, idx: int) -> dict:
+        tokens = self.base[idx]["input_ids"].astype(np.int32)
+        dec = np.concatenate([[self.pad_token_id], tokens[:-1]]).astype(np.int32)
+        return {"input_ids": tokens, "labels": tokens,
+                "decoder_input_ids": dec}
+
+
+class SyntheticImageDataset:
+    """Deterministic class-conditional image stream (reference image path:
+    /root/reference/oobleck/execution/dataset.py:88-148 loads HF image
+    datasets; zero-egress here, so classes are seeded Gaussian templates +
+    per-sample noise — learnable, offline, rank-independent)."""
+
+    def __init__(self, image_size: int, num_classes: int,
+                 num_channels: int = 3, num_samples: int = 8192,
+                 seed: int = 42):
+        self.image_size = image_size
+        self.num_classes = num_classes
+        self.num_channels = num_channels
+        self.num_samples = num_samples
+        self.seed = seed
+        rng = np.random.default_rng(seed)
+        self._templates = rng.normal(
+            0.0, 1.0, (num_classes, image_size, image_size, num_channels)
+        ).astype(np.float32)
+
+    def __len__(self) -> int:
+        return self.num_samples
+
+    def __getitem__(self, idx: int) -> dict:
+        if not 0 <= idx < self.num_samples:
+            raise IndexError(idx)
+        rng = np.random.default_rng(self.seed * 1_000_003 + idx)
+        label = int(rng.integers(0, self.num_classes))
+        noise = rng.normal(0.0, 0.5, self._templates.shape[1:]).astype(np.float32)
+        return {
+            "pixel_values": self._templates[label] + noise,
+            "labels": np.int32(label),
+        }
+
+
 def build_dataset(dataset_path: str, dataset_name: str | None, *,
                   model_name: str, vocab_size: int, seq_length: int,
-                  num_samples: int = 8192):
+                  num_samples: int = 8192, data_kind: str = "causal_lm",
+                  mask_token_id: int = 103, image_size: int = 224,
+                  num_classes: int = 1000, num_channels: int = 3):
     """Resolve config (dataset_path/dataset_name per the reference's
-    ModelArguments contract, training_util.py:27-32) to a dataset object."""
+    ModelArguments contract, training_util.py:27-32) to a dataset object.
+
+    `data_kind` (from the model) picks the batch contract: causal_lm yields
+    {input_ids}; mlm wraps the token stream in MLMView; seq2seq in
+    Seq2SeqView; image produces {pixel_values, labels}."""
+    if data_kind == "image":
+        # HF image pipelines need locally-cached image data (zero-egress);
+        # the synthetic stream is the offline path.
+        return SyntheticImageDataset(image_size, num_classes, num_channels,
+                                     num_samples)
     if dataset_path in ("synthetic", "", None):
-        return SyntheticTextDataset(vocab_size, seq_length, num_samples)
-    return HFTextDataset(dataset_path, dataset_name, model_name, seq_length)
+        base = SyntheticTextDataset(vocab_size, seq_length, num_samples)
+    else:
+        base = HFTextDataset(dataset_path, dataset_name, model_name, seq_length)
+    if data_kind == "mlm":
+        return MLMView(base, vocab_size, mask_token_id)
+    if data_kind == "seq2seq":
+        return Seq2SeqView(base)
+    return base
 
 
 _EVAL_SPLITS = ("validation", "valid", "test")
@@ -138,21 +247,29 @@ def has_validation_split(dataset_path: str, dataset_name: str | None) -> bool:
 
 
 def build_eval_dataset(dataset_path: str, dataset_name: str | None, *,
-                       model_name: str, seq_length: int):
+                       model_name: str, seq_length: int,
+                       data_kind: str = "causal_lm", vocab_size: int = 0,
+                       mask_token_id: int = 103):
     """A REAL validation split for evaluation, when one exists.
 
     HF datasets carry train+validation (the reference loads both,
     dataset.py:88-148, though its Evaluation loader is never driven); the
     synthetic corpus does not — callers fall back to the engine's held-out
-    tail reserve (ExecutionArguments.eval_fraction) on None."""
-    if dataset_path in ("synthetic", "", None):
+    tail reserve (ExecutionArguments.eval_fraction) on None. The split is
+    wrapped with the same batch-contract view as training (mlm/seq2seq)."""
+    if dataset_path in ("synthetic", "", None) or data_kind == "image":
         return None
     for split in _EVAL_SPLITS:
         try:
-            return HFTextDataset(
+            base = HFTextDataset(
                 dataset_path, dataset_name, model_name, seq_length,
                 split=split,
             )
         except RuntimeError:
             continue
+        if data_kind == "mlm":
+            return MLMView(base, vocab_size, mask_token_id)
+        if data_kind == "seq2seq":
+            return Seq2SeqView(base)
+        return base
     return None

@@ -144,19 +144,26 @@ class OobleckEngine:
 
         self.model = build_model(args.model.model_name, args.model.model_args,
                                  execution=args.execution)
-        if not getattr(self.model, "engine_compatible", True):
-            raise NotImplementedError(
-                f"{args.model.model_name} trains through the model-level API "
-                "(encoder/enc-dec/image objectives); engine integration for "
-                "non-causal-LM objectives lands in a later round"
+        if (args.execution.resolved_path() == "fused"
+                and not getattr(self.model, "fused_supported", False)):
+            raise ValueError(
+                f"{args.model.model_name} ({getattr(self.model, 'data_kind', '?')}) "
+                "is not supported by the fused SPMD step (causal LM only); "
+                "set execution.engine_path: mpmd"
             )
-        seq_len = min(self.model.config.max_position_embeddings, 1024)
+        cfg = self.model.config
+        seq_len = min(getattr(cfg, "max_position_embeddings", 1024), 1024)
         self.seq_len = seq_len
         self.dataset = build_dataset(
             args.model.dataset_path, args.model.dataset_name,
             model_name=args.model.model_name,
-            vocab_size=self.model.config.vocab_size,
+            vocab_size=getattr(cfg, "vocab_size", 0),
             seq_length=seq_len,
+            data_kind=getattr(self.model, "data_kind", "causal_lm"),
+            mask_token_id=getattr(cfg, "mask_token_id", 103),
+            image_size=getattr(cfg, "image_size", 224),
+            num_classes=getattr(cfg, "num_classes", 1000),
+            num_channels=getattr(cfg, "num_channels", 3),
         )
         # Real validation split when the data source has one; else
         # evaluate() holds out the eval_fraction tail of the train set.
@@ -702,6 +709,10 @@ class OobleckEngine:
                     self.args.model.dataset_name,
                     model_name=self.args.model.model_name,
                     seq_length=self.seq_len,
+                    data_kind=getattr(self.model, "data_kind", "causal_lm"),
+                    vocab_size=getattr(self.model.config, "vocab_size", 0),
+                    mask_token_id=getattr(self.model.config,
+                                          "mask_token_id", 103),
                 )
                 if len(ds) == 0:
                     logger.warning(

@@ -249,15 +249,24 @@ class FusedPipeline:
 
     # ---- engine dialect ---- #
 
-    def train_step(self, batch: np.ndarray):
-        """batch: [num_microbatches, microbatch, seq] int32 tokens."""
+    @staticmethod
+    def _tokens_of(batch) -> np.ndarray:
+        """The fused step is causal-LM only: accept a batch dict's input_ids
+        or a bare token array."""
+        if isinstance(batch, dict):
+            batch = batch["input_ids"]
+        return np.asarray(batch)
+
+    def train_step(self, batch):
+        """batch: {input_ids: [num_microbatches, microbatch, seq]} int32."""
+        batch = self._tokens_of(batch)
         assert batch.shape[0] == self.num_microbatches, batch.shape
-        tokens = np.asarray(batch).reshape(-1, batch.shape[-1])
+        tokens = batch.reshape(-1, batch.shape[-1])
         self.state, metrics = self._step_fn(self.state, tokens)
         return metrics.loss
 
-    def eval_step(self, batch: np.ndarray):
-        tokens_mb = np.asarray(batch)
+    def eval_step(self, batch):
+        tokens_mb = self._tokens_of(batch)
         if jax.process_count() > 1:
             tokens_mb = jax.make_array_from_callback(
                 tokens_mb.shape, self._step_fn.token_sharding,

@@ -36,7 +36,6 @@ import optax
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
 from oobleck_tpu.execution.schedule import Instruction, Op, all_instructions
-from oobleck_tpu.models.gpt import cross_entropy_loss
 from oobleck_tpu.planning.templates import PipelineTemplate
 
 logger = logging.getLogger("oobleck.pipeline")
@@ -60,11 +59,12 @@ class StageRuntime:
     layer_ids: tuple[int, ...]
     ranks: tuple[int, ...]
     mesh: Mesh
-    batch_sharding: NamedSharding          # [mb, seq(, emb)] layouts
+    batch_sharding: NamedSharding          # [mb, ...] layouts (dim 0 = sample)
     param_shardings: dict[int, Any]        # layer -> NamedSharding tree
     param_pspecs: dict[int, Any]           # layer -> PartitionSpec tree
     tp: int = 1                            # tensor-parallel degree in-stage
     use_fsdp: bool = False                 # params + batch sharded over fsdp
+    needs_batch: bool = True               # any layer here reads the batch
     fwd: Callable | None = None
     bwd: Callable | None = None
 
@@ -187,6 +187,10 @@ class PipelineInstance:
                     param_pspecs[li],
                     is_leaf=lambda x: isinstance(x, P),
                 )
+            batch_layers = set(getattr(
+                model, "batch_layers",
+                {0, model.num_pipeline_layers - 1},
+            ))
             self.stages.append(StageRuntime(
                 stage_index=si,
                 layer_ids=tuple(stage.layer_indices),
@@ -197,6 +201,7 @@ class PipelineInstance:
                 param_pspecs=param_pspecs,
                 tp=tp,
                 use_fsdp=use_fsdp,
+                needs_batch=bool(batch_layers & set(stage.layer_indices)),
             ))
 
         # Parameters: dict layer -> pytree placed on the owning stage's mesh.
@@ -237,20 +242,24 @@ class PipelineInstance:
         ctx = st.ctx
 
         if ctx is None:
-            block = jax.checkpoint(model.apply_block) if remat else model.apply_block
+            # Generic stage program over the LayerListModel protocol: every
+            # family (causal LM, MLM encoder, enc-dec, image) runs through
+            # apply_layer, with the last layer's logits fed to the model's
+            # own loss_from_logits — the engine is objective-agnostic like
+            # the reference's (pipeline.py:169-216).
+            def layer_fn(li):
+                fn = lambda p, c, b: model.apply_layer(li, p, c, b)
+                if remat and 0 < li < last_layer:
+                    fn = jax.checkpoint(fn)
+                return fn
 
-            def apply(params_tuple, x, tokens):
+            def apply(params_tuple, x, batch):
                 carry = x
                 for li, p in zip(st.layer_ids, params_tuple):
-                    if li == 0:
-                        carry = model.embed(p, tokens)
-                    elif li == last_layer:
-                        logits = model.head(p, carry)
-                        return cross_entropy_loss(
-                            logits, tokens, model.config.vocab_size
-                        )
-                    else:
-                        carry = block(p, carry)
+                    if li == last_layer:
+                        logits = model.apply_layer(li, p, carry, batch)
+                        return model.loss_from_logits(logits, batch)
+                    carry = layer_fn(li)(p, carry, batch)
                 return carry
 
             return apply
@@ -301,7 +310,8 @@ class PipelineInstance:
             core, mesh=st.mesh, in_specs=tuple(in_specs), out_specs=out_spec
         )
 
-        def apply(params_tuple, x, tokens):
+        def apply(params_tuple, x, batch):
+            tokens = batch["input_ids"] if batch is not None else None
             ops: list[Any] = [params_tuple]
             if not is_first:
                 ops.append(x)
@@ -376,32 +386,43 @@ class PipelineInstance:
 
     # ------------------------------------------------------------------ #
 
-    def _place_tokens(self, batch: np.ndarray):
-        """Per-microbatch token placement: stage 0 consumes tokens (embed),
-        the last stage consumes them again (loss). Shared by train/eval."""
-        S, M = self.num_stages, batch.shape[0]
-        first_st, last_st = self.stages[0], self.stages[-1]
-        tokens_first = [
-            jax.device_put(batch[m], first_st.batch_sharding) for m in range(M)
-        ]
-        tokens_last = (
-            tokens_first if S == 1 else
-            [jax.device_put(batch[m], last_st.batch_sharding) for m in range(M)]
-        )
-        return tokens_first, tokens_last
+    @staticmethod
+    def _as_batch_dict(batch) -> dict[str, np.ndarray]:
+        """Accept legacy [num_mb, mb, seq] token arrays or batch dicts."""
+        if isinstance(batch, dict):
+            return {k: np.asarray(v) for k, v in batch.items()}
+        return {"input_ids": np.asarray(batch)}
 
-    def train_step(self, batch: np.ndarray):
+    def _place_batch(self, batch: dict[str, np.ndarray]):
+        """Per-microbatch batch placement onto every stage that reads it
+        (embed, loss head, and any model-declared mid-pipeline consumer
+        like T5's bridge). Shared by train/eval."""
+        M = next(iter(batch.values())).shape[0]
+        per_stage: dict[int, list[dict] | None] = {}
+        for st in self.stages:
+            if not st.needs_batch:
+                per_stage[st.stage_index] = None
+                continue
+            per_stage[st.stage_index] = [
+                {k: jax.device_put(batch[k][m], st.batch_sharding)
+                 for k in batch}
+                for m in range(M)
+            ]
+        return per_stage, M
+
+    def train_step(self, batch):
         """One iteration over this pipeline's microbatches.
 
-        batch: [num_microbatches, microbatch_size, seq] int32 tokens.
-        Fills self.grads (sum over microbatches, scaled by 1/total global
-        microbatches) and returns the mean loss over this pipeline's
-        microbatches as a device scalar.
+        batch: {field: [num_microbatches, microbatch_size, ...]} (or a bare
+        token array for causal LM). Fills self.grads (sum over microbatches,
+        scaled by 1/total global microbatches) and returns the mean loss
+        over this pipeline's microbatches as a device scalar.
         """
-        assert batch.shape[0] == self.num_microbatches, batch.shape
+        batch = self._as_batch_dict(batch)
         S, M = self.num_stages, self.num_microbatches
+        assert next(iter(batch.values())).shape[0] == M
         streams = [deque(s) for s in all_instructions(S, M)]
-        tokens_first, tokens_last = self._place_tokens(batch)
+        placed, _ = self._place_batch(batch)
 
         acts: dict[tuple[int, int], Any] = {}    # (stage, mb) -> input act
         gacts: dict[tuple[int, int], Any] = {}   # (stage, mb) -> output grad
@@ -432,14 +453,13 @@ class PipelineInstance:
             key = (ins.stage, m)
             is_first = ins.stage == 0
             is_last = ins.stage == S - 1
+            stage_batch = placed[ins.stage]
             if ins.op in (Op.LOAD_MICROBATCH, Op.RECV_ACTIVATION):
                 pass  # inputs materialize at FORWARD
             elif ins.op == Op.FORWARD:
                 x = None if is_first else acts[key]
-                tokens = tokens_first[m] if is_first else (
-                    tokens_last[m] if is_last else None
-                )
-                out = st.fwd(params_of(st), x, tokens)
+                mb = stage_batch[m] if stage_batch is not None else None
+                out = st.fwd(params_of(st), x, mb)
                 stash[key] = x
                 if is_last:
                     losses.append(out)
@@ -451,14 +471,12 @@ class PipelineInstance:
                 acts[(ins.stage + 1, m)] = jax.device_put(y, nxt.batch_sharding)
             elif ins.op == Op.BACKWARD:
                 x = stash.pop(key)
-                tokens = tokens_first[m] if is_first else (
-                    tokens_last[m] if is_last else None
-                )
+                mb = stage_batch[m] if stage_batch is not None else None
                 if is_last:
-                    stage_grads, dx = st.bwd(params_of(st), x, tokens)
+                    stage_grads, dx = st.bwd(params_of(st), x, mb)
                 else:
                     dy = gacts.pop(key)
-                    stage_grads, dx = st.bwd(params_of(st), x, tokens, dy)
+                    stage_grads, dx = st.bwd(params_of(st), x, mb, dy)
                 accumulate(st, stage_grads)
                 if dx is not None:
                     stash[(ins.stage, m, "dx")] = dx
@@ -491,22 +509,21 @@ class PipelineInstance:
 
     # ------------------------------------------------------------------ #
 
-    def eval_step(self, batch: np.ndarray):
+    def eval_step(self, batch):
         """Forward-only loss over this pipeline's microbatches (no backward
         instructions, no gradient memory); returns the mean loss."""
-        S, M = self.num_stages, batch.shape[0]
-        tokens_first, tokens_last = self._place_tokens(batch)
+        batch = self._as_batch_dict(batch)
+        S = self.num_stages
+        placed, M = self._place_batch(batch)
         losses = []
         for m in range(M):
             x = None
             for st in self.stages:
-                is_first = st.stage_index == 0
                 is_last = st.stage_index == S - 1
-                tokens = tokens_first[m] if is_first else (
-                    tokens_last[m] if is_last else None
-                )
+                stage_batch = placed[st.stage_index]
+                mb = stage_batch[m] if stage_batch is not None else None
                 out = st.fwd(tuple(self.params[li] for li in st.layer_ids),
-                             x, tokens)
+                             x, mb)
                 if is_last:
                     losses.append(out)
                 else:

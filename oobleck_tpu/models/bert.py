@@ -10,8 +10,9 @@ GPT block shape with `causal=False` attention. MLM batches are produced by
 `make_mlm_batch` (corrupt 15% of tokens: 80% [MASK], 10% random, 10% kept);
 the loss runs only over corrupted positions.
 
-Engine integration note: the fused SPMD train step is LM-shift specific;
-BERT trains through the model-level API and the MPMD path in a future round.
+Engine integration: the MPMD pipeline drives BERT through the generic
+apply_layer / loss_from_logits contract with MLMView batches (corruption
+done dataset-side); the fused SPMD step remains causal-LM-specific.
 """
 
 from __future__ import annotations
@@ -63,9 +64,10 @@ class BertConfig:
 
 
 class BertModel:
-    # MLM objective trains through the model-level API, not the causal-LM
-    # engine contract.
-    engine_compatible = False
+    # Engine contract: batches carry pre-corrupted inputs + labels + mask
+    # (execution.dataset.MLMView); the MPMD pipeline drives apply_layer +
+    # loss_from_logits. The fused SPMD step is causal-LM-specific.
+    data_kind = "mlm"
 
     def __init__(self, config: BertConfig):
         self.config = config
@@ -96,12 +98,27 @@ class BertModel:
             return self.head(params, carry)
         return self.apply_block(params, carry)
 
+    def loss_from_logits(self, logits, batch):
+        """Masked-LM loss over corrupted positions. `batch` carries
+        pre-corrupted input_ids plus the clean labels and the float mask of
+        corrupted positions (MLMView's contract)."""
+        labels = batch["labels"]
+        mask = batch["loss_mask"].astype(jnp.float32)
+        logits = logits.astype(jnp.float32)
+        logz = jax.nn.logsumexp(logits, axis=-1)
+        gold = jnp.take_along_axis(logits, labels[..., None], axis=-1)[..., 0]
+        per_pos = (logz - gold) * mask
+        return jnp.sum(per_pos) / jnp.maximum(jnp.sum(mask), 1.0)
+
     def sample_batch(self, batch_size: int, seq_len: int):
         tokens = jax.random.randint(
             jax.random.PRNGKey(0), (batch_size, seq_len), 0,
             self.config.vocab_size, dtype=jnp.int32,
         )
-        return {"input_ids": tokens}
+        corrupted, labels, mask = self.make_mlm_batch(
+            tokens, jax.random.PRNGKey(1)
+        )
+        return {"input_ids": corrupted, "labels": labels, "loss_mask": mask}
 
     # ---- init (GPT block shapes + ln_embed) ----
 
@@ -211,11 +228,10 @@ class BertModel:
         return corrupted, tokens, select.astype(jnp.float32)
 
     def mlm_loss(self, params, corrupted, labels, mask):
-        logits = self.forward(params, corrupted).astype(jnp.float32)
-        logz = jax.nn.logsumexp(logits, axis=-1)
-        gold = jnp.take_along_axis(logits, labels[..., None], axis=-1)[..., 0]
-        per_pos = (logz - gold) * mask
-        return jnp.sum(per_pos) / jnp.maximum(jnp.sum(mask), 1.0)
+        logits = self.forward(params, corrupted)
+        return self.loss_from_logits(
+            logits, {"labels": labels, "loss_mask": mask}
+        )
 
     def loss(self, params, batch, rng: jax.Array | None = None):
         """MLM loss. Pass a fresh `rng` per step so the corruption mask

@@ -139,6 +139,9 @@ def _maybe_unshard(p, axis, dim):
 class GPTModel:
     """Layer-list GPT decoder. See module docstring for the pipeline layout."""
 
+    data_kind = "causal_lm"
+    fused_supported = True  # the compiled SPMD step (parallel/train.py)
+
     def __init__(self, config: GPTConfig):
         self.config = config
 

@@ -110,6 +110,9 @@ def _maybe(fn, x, axis, *a):
 class LlamaModel:
     """Layer-list Llama decoder; same contract as GPTModel."""
 
+    data_kind = "causal_lm"
+    fused_supported = True
+
     def __init__(self, config: LlamaConfig):
         self.config = config
 

@@ -104,12 +104,19 @@ def _rel_bias(table: jax.Array, q_len: int, k_len: int, bidirectional: bool,
 
 
 class T5Model:
-    # Trains through the model-level API; the engine's causal-LM contract
-    # (single token stream, shift loss) does not fit seq2seq yet.
-    engine_compatible = False
+    # Engine contract: seq2seq batches (input_ids / decoder_input_ids /
+    # labels) drive the MPMD pipeline generically; the bridge layer consumes
+    # the batch mid-pipeline, so batch_layers lists it for stage placement.
+    data_kind = "seq2seq"
 
     def __init__(self, config: T5Config):
         self.config = config
+
+    @property
+    def batch_layers(self) -> set[int]:
+        """Layers that read `batch` (beyond the default first/last): the
+        bridge starts the decoder stream from decoder_input_ids."""
+        return {0, self.config.num_layers + 1, self.num_pipeline_layers - 1}
 
     # ---- layer list ----
 
@@ -336,10 +343,14 @@ class T5Model:
         _, y = carry
         return self.head(params["head"], y)
 
-    def loss(self, params, batch):
-        logits = self.forward(params, batch["input_ids"],
-                              batch["decoder_input_ids"])
+    def loss_from_logits(self, logits, batch):
         labels = batch["labels"]
+        logits = logits.astype(jnp.float32)
         logz = jax.nn.logsumexp(logits, axis=-1)
         gold = jnp.take_along_axis(logits, labels[..., None], axis=-1)[..., 0]
         return jnp.mean(logz - gold)
+
+    def loss(self, params, batch):
+        logits = self.forward(params, batch["input_ids"],
+                              batch["decoder_input_ids"])
+        return self.loss_from_logits(logits, batch)

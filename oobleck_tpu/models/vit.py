@@ -61,8 +61,9 @@ class ViTModel:
     """Reuses the BERT encoder block (bidirectional attention) with a patch
     embed front and a CLS classifier head."""
 
-    # Image classification trains through the model-level API.
-    engine_compatible = False
+    # Engine contract: image batches (pixel_values / labels) drive the MPMD
+    # pipeline through the generic apply_layer / loss_from_logits path.
+    data_kind = "image"
 
     def __init__(self, config: ViTConfig):
         self.config = config
@@ -182,10 +183,15 @@ class ViTModel:
         x, _ = jax.lax.scan(body, x, params["blocks"])
         return self.head(params["head"], x)
 
-    def loss(self, params, batch):
-        logits = self.forward(params, batch["pixel_values"])
+    def loss_from_logits(self, logits, batch):
+        logits = logits.astype(jnp.float32)
         logz = jax.nn.logsumexp(logits, axis=-1)
         gold = jnp.take_along_axis(
             logits, batch["labels"][..., None], axis=-1
         )[..., 0]
         return jnp.mean(logz - gold)
+
+    def loss(self, params, batch):
+        return self.loss_from_logits(
+            self.forward(params, batch["pixel_values"]), batch
+        )

@@ -156,63 +156,85 @@ def profile_execution_layers(model, microbatch_size: int, seq_len: int | None = 
     """
     c = model.config
     if seq_len is None:
-        seq_len = min(c.max_position_embeddings, 1024)
+        seq_len = min(getattr(c, "max_position_embeddings", 1024), 1024)
     rng = jax.random.PRNGKey(0)
-    tokens = model.sample_batch(microbatch_size, seq_len)["input_ids"]
+    batch = model.sample_batch(microbatch_size, seq_len)
     results = []
-    carry_shape = (microbatch_size, seq_len, c.hidden_size)
-    block_row: dict | None = None
-    for idx in range(model.num_pipeline_layers):
-        # Transformer blocks are structurally identical: measure the first
-        # one and reuse (the reference times each fx-split layer because its
-        # shards can differ; our layer list is homogeneous by construction).
-        if model.layer_name(idx).startswith("block_") and block_row is not None:
-            results.append(dict(block_row))
-            continue
-        params = model.init_layer(rng, idx)
-        pbytes = param_bytes(params)
+    last_layer = model.num_pipeline_layers - 1
+    # Layers whose name shares a numbered prefix (block_i, enc_i, dec_i) are
+    # structurally identical by construction: measure the first of each
+    # prefix and reuse (the reference times every fx-split layer because its
+    # shards can differ).
+    proto_rows: dict[str, dict] = {}
+    carry_t = None  # previous layer's output shape tree (eval_shape)
 
-        # Uniform layer signature: x is the layer's input (tokens for embed,
-        # activations otherwise) so the repeated-scan timer can chain it.
+    def _ones_like_tree(shapes):
+        return jax.tree.map(lambda s: jnp.ones(s.shape, s.dtype), shapes)
+
+    for idx in range(model.num_pipeline_layers):
+        name = model.layer_name(idx)
+        prefix = name.rsplit("_", 1)[0] if "_" in name else None
+
+        params = model.init_layer(rng, idx)
+
+        # Uniform layer signature: x is the layer's input (the batch for the
+        # embed layer, activations otherwise) so the repeated-scan timer can
+        # chain it. `batch` rides along for mid-pipeline consumers (T5's
+        # bridge reads decoder_input_ids).
         if idx == 0:
             def fwd(x, p=params):
-                return model.embed(p, x)
-            x0 = tokens
+                return model.apply_layer(0, p, None, x)
+            x0 = batch
         else:
             def fwd(x, p=params, i=idx):
-                return model.apply_layer(i, p, x, None)
-            x0 = jnp.ones(carry_shape, c.dtype)
+                return model.apply_layer(i, p, x, batch)
+            x0 = _ones_like_tree(carry_t)
 
+        out_t = jax.eval_shape(fwd, x0)
+        reused = proto_rows.get(prefix) if prefix else None
+        if reused is not None:
+            results.append(dict(reused))
+            carry_t = out_t
+            continue
+        pbytes = param_bytes(params)
         fwd_ms = _time_repeated(fwd, x0)
-
-        out_shape = jax.eval_shape(fwd, x0)
-        ct0 = jnp.ones(out_shape.shape, out_shape.dtype)
+        ct0 = _ones_like_tree(out_t)
 
         if idx == 0:
-            # Embed backward is a scatter-add wrt wte; int tokens provide no
-            # differentiable input to chain on — approximate as 2x forward.
-            bwd_ms = fwd_ms * 2
+            # Embed backward: VJP wrt params only (int inputs give no
+            # activation cotangent to chain on) — measured, not the
+            # reference's 3x-forward estimate (profiler.py:41-123) nor the
+            # earlier 2x guess here.
+            def bwd(ct, p=params):
+                _, vjp = jax.vjp(
+                    lambda p_: model.apply_layer(0, p_, None, batch), p
+                )
+                return vjp(ct)
         else:
             # VJP wrt (activations, params) — both cotangent paths, like the
             # real backward. jax.vjp re-runs the forward inside, so this cost
             # includes recompute, matching execution under jax.checkpoint.
             def bwd(ct, x=x0, p=params, i=idx):
                 _, vjp = jax.vjp(
-                    lambda x_, p_: model.apply_layer(i, p_, x_, None), x, p
+                    lambda x_, p_: model.apply_layer(i, p_, x_, batch), x, p
                 )
                 return vjp(ct)
 
-            bwd_ms = _time_repeated(bwd, ct0)
+        bwd_ms = _time_repeated(bwd, ct0)
 
-        act_bytes = math.prod(out_shape.shape) * out_shape.dtype.itemsize
+        act_bytes = sum(
+            math.prod(s.shape) * s.dtype.itemsize
+            for s in jax.tree.leaves(out_t)
+        )
         row = {
             "forward": fwd_ms,
             "backward": bwd_ms,
             "mem_required": [int(pbytes), int(act_bytes)],
         }
-        if model.layer_name(idx).startswith("block_"):
-            block_row = row
+        if prefix:
+            proto_rows[prefix] = row
         results.append(row)
+        carry_t = out_t
     return results
 
 

@@ -77,7 +77,7 @@ def test_resume_mid_stream():
 def test_dataloader_batch_shape():
     ds = SyntheticTextDataset(vocab_size=256, seq_length=32, num_samples=N)
     dl = OobleckDataLoader(ds, make_sampler(0))
-    batch = dl.next_batch()
+    batch = dl.next_batch()["input_ids"]
     assert batch.shape == (NUM_MB[0], MB_SIZE, 32)
     assert batch.dtype == np.int32
     assert (batch >= 0).all() and (batch < 256).all()

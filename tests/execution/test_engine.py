@@ -32,7 +32,8 @@ def cache_env(tmp_path_factory):
         os.environ["OOBLECK_TPU_CACHE"] = old
 
 
-def make_engine(num_hosts=4, steps=3, devices=None, microbatch=2, global_mb=16):
+def make_engine(num_hosts=4, steps=3, devices=None, microbatch=2, global_mb=16,
+                model_name="gpt2-tiny"):
     args = OobleckArguments(
         dist=DistributedArguments(
             node_ips=[f"10.0.0.{i}" for i in range(num_hosts)]
@@ -44,7 +45,7 @@ def make_engine(num_hosts=4, steps=3, devices=None, microbatch=2, global_mb=16):
             learning_rate=1e-3,
             warmup_steps=2,
         ),
-        model=ModelArguments(model_name="gpt2-tiny", dataset_path="synthetic"),
+        model=ModelArguments(model_name=model_name, dataset_path="synthetic"),
     )
     devices = devices or jax.devices()[:8]
     return OobleckEngine(args, devices=devices)

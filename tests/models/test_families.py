@@ -62,12 +62,18 @@ def test_bert_mlm():
     assert abs(losses[0] - np.log(model.config.vocab_size)) < 1.2
 
 
-def test_engine_rejects_non_lm_families():
-    from oobleck_tpu.config import OobleckArguments, ModelArguments
+def test_fused_path_rejects_non_lm_families():
+    """The fused SPMD step is causal-LM only; non-LM families must be told
+    to use the MPMD path instead of failing deep in tracing."""
+    from oobleck_tpu.config import (ExecutionArguments, ModelArguments,
+                                    OobleckArguments)
     from oobleck_tpu.execution.engine import OobleckEngine
 
-    args = OobleckArguments(model=ModelArguments(model_name="t5-tiny"))
-    with pytest.raises(NotImplementedError, match="model-level API"):
+    args = OobleckArguments(
+        model=ModelArguments(model_name="t5-tiny"),
+        execution=ExecutionArguments(engine_path="fused"),
+    )
+    with pytest.raises(ValueError, match="engine_path: mpmd"):
         OobleckEngine(args)
 
 
