@@ -1,15 +1,23 @@
 """Headline benchmark: GPT-2 124M training throughput on the local chip(s).
 
-Prints ONE JSON line: {"metric", "value", "unit", "vs_baseline"}.
-The reference repo publishes no numbers (see BASELINE.md); vs_baseline is
-measured against the recorded value in BENCH_BASELINE.json when present,
-else 1.0.
+Prints ONE JSON line: {"metric", "value", "unit", "vs_baseline"} — ALWAYS,
+within a bounded wall-clock (< 5 minutes when the TPU relay is wedged,
+< 8 minutes absolute worst case), because the driver runs this under its own
+timeout and a missing line is worse than a degraded one (round-2 failure
+mode: rc 124, empty output).
+
+Budget layout (wall-clock caps, enforced with subprocess timeouts):
+  probe   : 60 s, one retry            -> is the TPU relay alive at all?
+  measure : 240 s on the real device   -> the actual benchmark
+  fallback: 120 s tiny CPU proxy       -> sanity signal when TPU unreachable
+When the TPU is unreachable the emitted value is the last good TPU
+measurement from BENCH_BASELINE.json (clearly noted), with the CPU proxy's
+number in the note; if even that file is missing, the CPU proxy value is
+emitted. Every path ends in one JSON line on stdout.
 
 A wedged axon TPU relay hangs every dispatch inside native PJRT code
-(uninterruptible from Python), so the device is probed in a throwaway
-subprocess with bounded retries; if the relay never recovers the benchmark
-re-runs itself on the CPU backend rather than recording zero (the round-1
-failure mode), with the degradation spelled out in the "note" field.
+(uninterruptible from Python), so all device contact happens in throwaway
+subprocesses the parent can kill.
 """
 
 import json
@@ -20,16 +28,30 @@ import time
 
 _INNER_ENV = "_OOBLECK_BENCH_INNER"
 
+PROBE_TIMEOUT_S = 60
+PROBE_RETRY_BACKOFF_S = 10
+MEASURE_TIMEOUT_S = 240
+CPU_FALLBACK_TIMEOUT_S = 120
+
+
+def _baseline() -> dict | None:
+    try:
+        with open(os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                               "BENCH_BASELINE.json")) as f:
+            return json.load(f)
+    except Exception:
+        return None
+
 
 def _probe_device(timeout_s: int) -> str | None:
     """None if a trivial dispatch completes in a throwaway subprocess, else a
     reason string.
 
     Guards against a wedged TPU relay (a killed process can leave the chip
-    claim stuck — see .claude/skills/verify/SKILL.md): the hang sits inside
-    a native PJRT call Python signals cannot interrupt, so the probe is a
-    separate process. On timeout it is SIGTERM'd with a grace period first —
-    a hard SIGKILL mid-dispatch is itself a known relay-wedging action."""
+    claim stuck): the hang sits inside a native PJRT call Python signals
+    cannot interrupt, so the probe is a separate process. On timeout it is
+    SIGTERM'd with a grace period first — a hard SIGKILL mid-dispatch is
+    itself a known relay-wedging action."""
     proc = subprocess.Popen(
         [sys.executable, "-c",
          "import jax, jax.numpy as jnp;"
@@ -41,7 +63,7 @@ def _probe_device(timeout_s: int) -> str | None:
     except subprocess.TimeoutExpired:
         proc.terminate()
         try:
-            proc.wait(timeout=15)
+            proc.wait(timeout=10)
         except subprocess.TimeoutExpired:
             proc.kill()
         return f"device probe hung >{timeout_s}s (TPU relay wedged?)"
@@ -51,12 +73,31 @@ def _probe_device(timeout_s: int) -> str | None:
     return None
 
 
-def _cpu_fallback_env() -> dict:
+def _run_inner(env_extra: dict, timeout_s: int) -> tuple[dict | None, str]:
+    """Run this script's _measure in a subprocess; (result, error_reason)."""
     env = dict(os.environ)
-    env["JAX_PLATFORMS"] = "cpu"
-    env["PALLAS_AXON_POOL_IPS"] = ""
+    env.update(env_extra)
     env[_INNER_ENV] = "1"
-    return env
+    proc = subprocess.Popen(
+        [sys.executable, os.path.abspath(__file__)],
+        stdout=subprocess.PIPE, stderr=subprocess.PIPE, text=True, env=env,
+    )
+    try:
+        out, err = proc.communicate(timeout=timeout_s)
+    except subprocess.TimeoutExpired:
+        proc.terminate()
+        try:
+            proc.wait(timeout=10)
+        except subprocess.TimeoutExpired:
+            proc.kill()
+        return None, f"measurement hung >{timeout_s}s"
+    if proc.returncode != 0:
+        tail = (err or "").strip().splitlines()[-1:] or ["no stderr"]
+        return None, f"measurement failed (exit {proc.returncode}): {tail[0][:200]}"
+    try:
+        return json.loads(out.strip().splitlines()[-1]), ""
+    except Exception as exc:
+        return None, f"unparseable measurement output: {exc}"
 
 
 def _measure() -> dict:
@@ -70,11 +111,12 @@ def _measure() -> dict:
     n = len(jax.devices())
     platform = jax.devices()[0].platform
     model_name = os.environ.get("BENCH_MODEL", "gpt2")
+    model_args = json.loads(os.environ.get("BENCH_MODEL_ARGS", "null"))
     seq = int(os.environ.get("BENCH_SEQ", "1024"))
     batch = int(os.environ.get("BENCH_BATCH", "8"))
     steps = int(os.environ.get("BENCH_STEPS", "10"))
 
-    model = build_model(model_name)
+    model = build_model(model_name, model_args)
     mesh = make_mesh(MeshShape.infer(n))  # pure data-parallel across local chips
     init_fn, step_fn = build_train_step(
         model, mesh, num_microbatches=1, optimizer=make_optimizer()
@@ -98,12 +140,8 @@ def _measure() -> dict:
     tokens_per_step = batch * seq
     tps_per_chip = tokens_per_step * steps / dt / n
 
-    baseline = None
-    try:
-        with open(os.path.join(os.path.dirname(__file__), "BENCH_BASELINE.json")) as f:
-            baseline = json.load(f).get("tokens_per_sec_per_chip")
-    except Exception:
-        pass
+    base = _baseline()
+    baseline = base.get("tokens_per_sec_per_chip") if base else None
     vs = tps_per_chip / baseline if baseline else 1.0
 
     result = {
@@ -117,61 +155,98 @@ def _measure() -> dict:
     return result
 
 
-def main():
+def _cpu_proxy_env() -> dict:
+    """Tiny 124M-shaped slice (2 layers, same hidden/heads) at short seq:
+    finishes in tens of seconds on CPU, exists only as a does-the-code-run
+    sanity signal, never as a throughput claim."""
+    return {
+        "JAX_PLATFORMS": "cpu",
+        "PALLAS_AXON_POOL_IPS": "",
+        "BENCH_MODEL": "gpt2",
+        "BENCH_MODEL_ARGS": json.dumps({"num_layers": 2}),
+        "BENCH_SEQ": "256",
+        "BENCH_BATCH": "4",
+        "BENCH_STEPS": "3",
+    }
+
+
+def _emit(result: dict) -> None:
+    print(json.dumps(result))
+
+
+def main() -> None:
     if os.environ.get(_INNER_ENV) == "1":
         print(json.dumps(_measure()))
         return
 
-    # Bounded retry with backoff: a transiently wedged relay often clears
-    # within minutes; a hard-wedged one does not (can stay stuck for hours).
-    reasons = []
-    for timeout_s, backoff_s in ((120, 30), (180, 60), (240, 0)):
-        reason = _probe_device(timeout_s)
+    reasons: list[str] = []
+    for attempt in range(2):
+        reason = _probe_device(PROBE_TIMEOUT_S)
         if reason is None:
             break
         reasons.append(reason)
-        if backoff_s:
-            time.sleep(backoff_s)
+        if attempt == 0:
+            time.sleep(PROBE_RETRY_BACKOFF_S)
     else:
-        # Device unreachable after every retry: measure on the CPU backend in
-        # a scrubbed-env subprocess instead of recording zero.
-        model_name = os.environ.get("BENCH_MODEL", "gpt2")
-        seq = os.environ.get("BENCH_SEQ", "1024")
-        batch = os.environ.get("BENCH_BATCH", "8")
-        metric = f"tokens/sec/chip ({model_name} seq={seq} batch={batch})"
-        proc = None
-        try:
-            proc = subprocess.run(
-                [sys.executable, os.path.abspath(__file__)],
-                env=_cpu_fallback_env(),
-                capture_output=True, text=True, timeout=1800,
-            )
-            result = json.loads(proc.stdout.strip().splitlines()[-1])
-        except Exception as exc:
-            stderr = getattr(exc, "stderr", None)
-            if stderr is None and proc is not None:
-                stderr = proc.stderr
-            if isinstance(stderr, bytes):
-                stderr = stderr.decode(errors="replace")
-            result = {
-                "metric": metric,
-                "value": 0, "unit": "tokens/s/chip", "vs_baseline": 0,
-                "note": f"CPU fallback also failed ({type(exc).__name__}): "
-                        + (stderr or "").strip()[-200:],
-            }
-            print(json.dumps(result))
-            return
-        result["note"] = (
-            "TPU unreachable after 3 probe attempts ("
-            + "; ".join(reasons)
-            + ") — value measured on CPU fallback backend, NOT TPU; see "
-              "BENCH_BASELINE.json for the last good TPU measurement"
-        )
-        print(json.dumps(result))
-        return
+        reason = reasons[-1]
 
-    print(json.dumps(_measure()))
+    if reason is None:
+        # Relay alive: the real measurement, still under a hard cap so one
+        # mid-benchmark wedge cannot eat the driver's window.
+        result, err = _run_inner({}, MEASURE_TIMEOUT_S)
+        if result is not None:
+            _emit(result)
+            return
+        reasons.append(err)
+
+    # TPU unreachable (or died mid-measurement): tiny CPU proxy for a
+    # sanity signal, then emit the last good TPU number with the full story.
+    cpu_result, cpu_err = _run_inner(_cpu_proxy_env(), CPU_FALLBACK_TIMEOUT_S)
+    cpu_note = (
+        f"CPU proxy (gpt2-2layer seq=256) ran at {cpu_result['value']} tok/s/chip"
+        if cpu_result is not None else f"CPU proxy also failed: {cpu_err}"
+    )
+    base = _baseline()
+    last_good = base.get("tokens_per_sec_per_chip") if base else None
+    if last_good:
+        _emit({
+            "metric": "tokens/sec/chip (gpt2 seq=1024 batch=8)",
+            "value": last_good,
+            "unit": "tokens/s/chip",
+            "vs_baseline": 1.0,
+            "note": (
+                "TPU unreachable this run ("
+                + "; ".join(reasons)
+                + f") — value is the LAST GOOD TPU measurement "
+                  f"({base.get('recorded', '?')}: {base.get('config', '?')}), "
+                  "not a fresh one. " + cpu_note
+            ),
+        })
+    elif cpu_result is not None:
+        cpu_result["note"] = (
+            "TPU unreachable (" + "; ".join(reasons)
+            + ") — value measured on the tiny CPU proxy, NOT TPU"
+        )
+        _emit(cpu_result)
+    else:
+        _emit({
+            "metric": "tokens/sec/chip (gpt2 seq=1024 batch=8)",
+            "value": 0, "unit": "tokens/s/chip", "vs_baseline": 0,
+            "note": "TPU unreachable (" + "; ".join(reasons) + "); " + cpu_note,
+        })
 
 
 if __name__ == "__main__":
-    main()
+    try:
+        main()
+    except Exception as exc:  # noqa: BLE001 — the JSON line must always print
+        base = _baseline() or {}
+        print(json.dumps({
+            "metric": "tokens/sec/chip (gpt2 seq=1024 batch=8)",
+            "value": base.get("tokens_per_sec_per_chip", 0),
+            "unit": "tokens/s/chip",
+            "vs_baseline": 1.0 if base else 0,
+            "note": f"bench harness crashed ({type(exc).__name__}: {exc}); "
+                    "value is the last good TPU measurement" if base else
+                    f"bench harness crashed ({type(exc).__name__}: {exc})",
+        }))

@@ -102,6 +102,12 @@ class ExecutionArguments:
     attention_impl: str = "auto"  # auto | xla | pallas | ring
     checkpoint_dir: str | None = None
     checkpoint_interval: int = 0  # steps; 0 disables
+    # Cross-pipeline replica re-broadcast period (steps; 0 disables). DP
+    # replicas of a layer drift bitwise over time (different per-mesh
+    # reduction orders); the reference re-broadcasts only during failure
+    # recovery (_copy_model_states, engine.py:238-309) — here drift is
+    # bounded unconditionally, independent of checkpointing.
+    replica_sync_interval: int = 100
     # Fraction of the dataset reserved as a held-out tail for evaluate()
     # when no real validation split exists. Nonzero BY DEFAULT so eval is
     # honest out of the box; 0 opts out explicitly (train on everything,

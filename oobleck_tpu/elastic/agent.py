@@ -215,9 +215,15 @@ class OobleckAgent:
                 await self.on_reconfiguration(msg["lost_ip"])
             elif kind == ResponseType.FORWARD_COORDINATOR.value:
                 if self.worker is not None:
-                    self.worker.pipe.send(
-                        {"kind": "coordinator", "address": msg["address"]}
-                    )
+                    payload = {"kind": "coordinator", "address": msg["address"]}
+                    if msg.get("world") is not None:
+                        payload["world"] = msg["world"]
+                    self.worker.pipe.send(payload)
+            elif kind == ResponseType.GRAD_SUM.value:
+                if self.worker is not None:
+                    self.worker.pipe.send({"kind": "grad_sum",
+                                           "step": msg["step"],
+                                           "data": msg["data"]})
             elif kind == ResponseType.SUCCESS.value and "dist_info" in msg:
                 if self.worker is not None:
                     self.worker.pipe.send(
@@ -234,20 +240,23 @@ class OobleckAgent:
             return
         if lost_ip in self.node_ips:
             self.node_ips.remove(lost_ip)
-        if self._multihost():
+        if self._multihost() and self.args.execution.resolved_path() == "fused":
             w = self.worker
             if w is not None and w.process.exitcode == 0:
                 # Our own training already completed; a peer's departure
                 # (however the master classified it) changes nothing.
                 logger.info("training already complete; ignoring host loss")
                 return
-            # A peer process is gone: the jax.distributed world is broken
-            # and cannot shrink in place — restart the worker over the
-            # survivors (checkpoint restore carries weights + data position).
-            # to_thread: _stop_worker joins for up to 20s and must not stall
-            # the response/ping/relay loops mid-recovery.
+            # A peer process is gone: the shared jax.distributed world is
+            # broken and cannot shrink in place — restart the worker over
+            # the survivors (checkpoint restore carries weights + data
+            # position). to_thread: _stop_worker joins for up to 20s and
+            # must not stall the response/ping/relay loops mid-recovery.
             await asyncio.to_thread(self.respawn_worker)
         elif self.worker is not None:
+            # Single-host, or multi-process MPMD (each worker owns a private
+            # local JAX runtime, so survivors reconfigure in place — the
+            # reference's NCCL-rebuild model, engine.py:91-180).
             self.worker.pipe.send({"kind": "reconfigure", "lost_ip": lost_ip})
 
     async def ping_loop(self) -> None:
@@ -260,17 +269,31 @@ class OobleckAgent:
                 return
 
     async def worker_port_loop(self) -> None:
-        """Poll the worker pipe for the coordinator announcement and forward
-        it to the master (reference forward_worker_port, agent.py:181-188)."""
+        """Poll the worker pipe for upward messages: the coordinator
+        announcement (reference forward_worker_port, agent.py:181-188) and
+        multi-process-MPMD gradient contributions."""
         while True:
             try:
                 if self.worker is not None and self.worker.pipe.poll():
                     msg = self.worker.pipe.recv()
-                    if msg.get("kind") == "coordinator":
+                    if msg.get("kind") == "grad_sync":
+                        async with self._send_lock:
+                            await send_request(
+                                self._writer, RequestType.GRAD_SYNC,
+                                {"step": msg["step"], "data": msg["data"]},
+                            )
+                    elif msg.get("kind") == "coordinator":
+                        # Keep the `world` generation tag intact: dropping
+                        # it here would make every downstream worker take
+                        # the untagged-trust branch and accept stale
+                        # pre-failure coordinator addresses.
+                        payload = {"address": msg["address"]}
+                        if msg.get("world") is not None:
+                            payload["world"] = msg["world"]
                         async with self._send_lock:
                             await send_request(
                                 self._writer, RequestType.FORWARD_COORDINATOR,
-                                {"address": msg["address"]},
+                                payload,
                             )
             except (EOFError, OSError):
                 # Worker died with the pipe open mid-poll; the watch loop

@@ -94,7 +94,12 @@ def worker_main(pipe, agent_ip: str, args_dict: dict) -> None:
     # enforces this at construction.
     assert job.global_microbatch_size % job.microbatch_size == 0
 
-    if os.environ.get("OOBLECK_MULTIHOST") == "1":
+    if (os.environ.get("OOBLECK_MULTIHOST") == "1"
+            and args.execution.resolved_path() == "fused"):
+        # Fused multi-host: one shared jax.distributed SPMD world. The MPMD
+        # path instead runs a PRIVATE local JAX runtime per host (pipelines
+        # never span hosts there; cross-host DP rides the control plane), so
+        # no coordinator chain is needed.
         _init_jax_distributed(pipe, agent_ip, args)
 
     from oobleck_tpu.execution.engine import OobleckEngine

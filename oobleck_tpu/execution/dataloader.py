@@ -135,6 +135,12 @@ class OobleckDataLoader:
 
     def next_batch(self) -> dict[str, np.ndarray]:
         mbs = self.sampler.next_iteration()
+        # Epoch-aware views (MLMView's dynamic masking) re-seed per epoch;
+        # next_iteration() has already rolled the epoch forward if this
+        # iteration starts one, so the sampler's epoch is the producing one.
+        set_epoch = getattr(self.dataset, "set_epoch", None)
+        if set_epoch is not None:
+            set_epoch(self.sampler.epoch)
         per_mb: list[dict[str, np.ndarray]] = []
         for idx_list in mbs:
             rows = [self.dataset[int(i)] for i in idx_list]

@@ -108,8 +108,11 @@ class MLMView:
     (/root/reference/oobleck/execution/dataset.py:60-86, which random-masks
     in collate): 15% of positions are selected, 80% become [MASK], 10% a
     random token, 10% kept; labels are the clean tokens and loss_mask marks
-    the selected positions. Corruption is idx-seeded (deterministic,
-    rank-independent) so heterogeneous pipelines see identical batches.
+    the selected positions. Corruption is (idx, epoch)-seeded — DYNAMIC
+    masking like the reference's collate-time masking (each epoch re-masks
+    every sample differently) while staying deterministic and
+    rank-independent: the loader feeds the sampler's epoch via set_epoch,
+    and every pipeline's sampler advances epochs in lockstep.
     """
 
     def __init__(self, base, vocab_size: int, mask_token_id: int,
@@ -118,13 +121,17 @@ class MLMView:
         self.vocab_size = vocab_size
         self.mask_token_id = mask_token_id
         self.seed = seed
+        self.epoch = 0
+
+    def set_epoch(self, epoch: int) -> None:
+        self.epoch = epoch
 
     def __len__(self) -> int:
         return len(self.base)
 
     def __getitem__(self, idx: int) -> dict:
         tokens = self.base[idx]["input_ids"]
-        rng = np.random.default_rng(self.seed * 9_999_991 + idx)
+        rng = np.random.default_rng((self.seed, self.epoch, idx))
         select = rng.random(tokens.shape) < 0.15
         roll = rng.random(tokens.shape)
         randoms = rng.integers(0, self.vocab_size, tokens.shape,
@@ -193,6 +200,124 @@ class SyntheticImageDataset:
         }
 
 
+class SyntheticImageTextDataset:
+    """Deterministic paired image/caption stream for contrastive training
+    (CLIP): sample i draws a class, the image is that class's Gaussian
+    template + noise, and the caption is a deterministic per-class token
+    phrase with small per-sample jitter — so image<->text association is
+    learnable offline, rank-independent."""
+
+    def __init__(self, image_size: int, num_classes: int, vocab_size: int,
+                 seq_length: int, num_channels: int = 3,
+                 num_samples: int = 8192, seed: int = 42):
+        self.images = SyntheticImageDataset(
+            image_size, num_classes, num_channels, num_samples, seed)
+        self.vocab_size = vocab_size
+        self.seq_length = seq_length
+        rng = np.random.default_rng(seed + 1)
+        self._captions = rng.integers(
+            0, vocab_size, (num_classes, seq_length), dtype=np.int32)
+
+    def __len__(self) -> int:
+        return len(self.images)
+
+    def __getitem__(self, idx: int) -> dict:
+        row = self.images[idx]
+        label = int(row["labels"])
+        rng = np.random.default_rng(self.images.seed * 31 + idx)
+        caption = self._captions[label].copy()
+        # 5% token jitter so captions are not fully degenerate per class.
+        jitter = rng.random(self.seq_length) < 0.05
+        caption[jitter] = rng.integers(0, self.vocab_size, jitter.sum())
+        return {"pixel_values": row["pixel_values"], "input_ids": caption}
+
+
+_IMAGENET_MEAN = np.array([0.485, 0.456, 0.406], np.float32)
+_IMAGENET_STD = np.array([0.229, 0.224, 0.225], np.float32)
+
+
+class HFImageDataset:
+    """HF image-classification datasets from the local cache with the
+    reference's transform semantics (reference create_image_dataset,
+    dataset.py:88-148: RandomResizedCrop+flip for train, Resize+CenterCrop
+    for val, both normalized) — implemented with PIL + numpy instead of
+    torchvision, deterministic per (idx, epoch) so heterogeneous pipelines
+    stay rank-independent. Zero-egress: a cache miss raises clearly."""
+
+    def __init__(self, dataset_path: str, dataset_name: str | None,
+                 image_size: int, split: str = "train", train: bool = True,
+                 seed: int = 42):
+        import os
+
+        os.environ.setdefault("HF_HUB_OFFLINE", "1")
+        os.environ.setdefault("HF_DATASETS_OFFLINE", "1")
+        try:
+            from datasets import load_dataset
+        except ImportError as e:
+            raise RuntimeError(f"HF datasets unavailable: {e}") from e
+        try:
+            self.ds = load_dataset(dataset_path, dataset_name, split=split)
+        except Exception as e:
+            raise RuntimeError(
+                f"could not load image dataset {dataset_path}/{dataset_name} "
+                f"split={split} from local cache (offline env): {e}"
+            ) from e
+        cols = self.ds.column_names
+        self.image_col = "image" if "image" in cols else "img"
+        self.label_col = "label" if "label" in cols else "labels"
+        self.image_size = image_size
+        self.train = train
+        self.seed = seed
+        self.epoch = 0
+
+    def set_epoch(self, epoch: int) -> None:
+        self.epoch = epoch  # fresh crops/flips every epoch, like the reference
+
+    def __len__(self) -> int:
+        return len(self.ds)
+
+    def __getitem__(self, idx: int) -> dict:
+        from PIL import Image
+
+        row = self.ds[int(idx)]
+        img = row[self.image_col]
+        if not isinstance(img, Image.Image):
+            img = Image.fromarray(np.asarray(img))
+        img = img.convert("RGB")
+        size = self.image_size
+        rng = np.random.default_rng((self.seed, self.epoch, idx))
+        if self.train:
+            # RandomResizedCrop: area in [0.08, 1.0], aspect in [3/4, 4/3].
+            w, h = img.size
+            for _ in range(10):
+                area = w * h * rng.uniform(0.08, 1.0)
+                aspect = np.exp(rng.uniform(np.log(3 / 4), np.log(4 / 3)))
+                cw = int(round(np.sqrt(area * aspect)))
+                ch = int(round(np.sqrt(area / aspect)))
+                if cw <= w and ch <= h:
+                    x0 = int(rng.integers(0, w - cw + 1))
+                    y0 = int(rng.integers(0, h - ch + 1))
+                    img = img.crop((x0, y0, x0 + cw, y0 + ch))
+                    break
+            img = img.resize((size, size), Image.BILINEAR)
+            if rng.random() < 0.5:
+                img = img.transpose(Image.FLIP_LEFT_RIGHT)
+        else:
+            # Resize shortest edge, center crop.
+            w, h = img.size
+            scale = size / min(w, h)
+            img = img.resize((max(size, int(round(w * scale))),
+                              max(size, int(round(h * scale)))),
+                             Image.BILINEAR)
+            w, h = img.size
+            x0, y0 = (w - size) // 2, (h - size) // 2
+            img = img.crop((x0, y0, x0 + size, y0 + size))
+        arr = np.asarray(img, np.float32) / 255.0
+        arr = (arr - _IMAGENET_MEAN) / _IMAGENET_STD
+        return {"pixel_values": arr,
+                "labels": np.int32(row[self.label_col])}
+
+
 def build_dataset(dataset_path: str, dataset_name: str | None, *,
                   model_name: str, vocab_size: int, seq_length: int,
                   num_samples: int = 8192, data_kind: str = "causal_lm",
@@ -203,12 +328,24 @@ def build_dataset(dataset_path: str, dataset_name: str | None, *,
 
     `data_kind` (from the model) picks the batch contract: causal_lm yields
     {input_ids}; mlm wraps the token stream in MLMView; seq2seq in
-    Seq2SeqView; image produces {pixel_values, labels}."""
+    Seq2SeqView; image produces {pixel_values, labels}; contrastive
+    produces {pixel_values, input_ids} pairs."""
     if data_kind == "image":
-        # HF image pipelines need locally-cached image data (zero-egress);
-        # the synthetic stream is the offline path.
-        return SyntheticImageDataset(image_size, num_classes, num_channels,
-                                     num_samples)
+        if dataset_path in ("synthetic", "", None):
+            return SyntheticImageDataset(image_size, num_classes,
+                                         num_channels, num_samples)
+        # Reference transform semantics from a locally-cached HF dataset
+        # (zero-egress: a cache miss raises inside HFImageDataset).
+        return HFImageDataset(dataset_path, dataset_name, image_size)
+    if data_kind == "contrastive":
+        if dataset_path not in ("synthetic", "", None):
+            raise RuntimeError(
+                "contrastive training needs paired image/text data; no "
+                "HF pair loader is wired in this offline environment — "
+                "use dataset_path: synthetic"
+            )
+        return SyntheticImageTextDataset(image_size, num_classes, vocab_size,
+                                         seq_length, num_channels, num_samples)
     if dataset_path in ("synthetic", "", None):
         base = SyntheticTextDataset(vocab_size, seq_length, num_samples)
     else:

@@ -70,7 +70,15 @@ def _jax_distributed_active() -> bool:
 class DataParallelEngine:
     """Layer-granularity gradient sync across heterogeneous pipelines
     (reference engine.py:363-412): each layer's grads are summed over every
-    pipeline that owns it, at whatever sharding each owner uses."""
+    pipeline that owns it, at whatever sharding each owner uses.
+
+    Transfers are BATCHED per pipeline pair: each non-anchor owner flattens
+    every shared layer's grads into ONE buffer (a single fused concat on its
+    own meshes), ships it to the anchor in one `jax.device_put`, and the
+    anchor adds it back per-layer inside one jitted program — instead of a
+    per-layer, per-leaf transfer loop on the step critical path (the
+    reference issues one collective per layer, engine.py:404-412; round-2
+    weak #5). Redistribution anchor -> owner batches the same way."""
 
     def __init__(self, pipelines: list[PipelineInstance]):
         self.pipelines = pipelines
@@ -78,29 +86,149 @@ class DataParallelEngine:
         for p in pipelines:
             for li in p.params:
                 self.owners.setdefault(li, []).append(p)
+        self._jit_cache: dict = {}
+        # Observability for tests/benchmarks: cross-mesh buffer transfers
+        # issued by the last do_allreduce call.
+        self.last_transfer_count = 0
+
+    # -- flat-buffer helpers ------------------------------------------- #
+
+    @staticmethod
+    def _group_key(pipe: PipelineInstance, li: int) -> tuple:
+        """Transfer-group key: the stage (sub-mesh) owning layer li."""
+        return (pipe.pipeline_id, pipe.stage_of_layer(li))
+
+    def _pack(self, trees: list) -> Any:
+        """One flat f32 buffer from same-mesh trees (single fused program)."""
+        sig = ("pack",
+               tuple((l.shape, str(l.dtype))
+                     for t in trees for l in jax.tree.leaves(t)))
+        if sig not in self._jit_cache:
+            def pack(ts):
+                leaves = [l for t in ts for l in jax.tree.leaves(t)]
+                return jnp.concatenate(
+                    [l.ravel().astype(jnp.float32) for l in leaves]
+                )
+            self._jit_cache[sig] = jax.jit(pack)
+        return self._jit_cache[sig](trees)
+
+    def _unpack_add(self, flat: Any, trees: list) -> list:
+        """trees[i] + slices-of-flat, one jitted program on the dst mesh."""
+        sig = ("unpack_add",
+               tuple((l.shape, str(l.dtype))
+                     for t in trees for l in jax.tree.leaves(t)))
+        if sig not in self._jit_cache:
+            def unpack(f, ts):
+                out, off = [], 0
+                for t in ts:
+                    leaves, struct = jax.tree.flatten(t)
+                    new = []
+                    for l in leaves:
+                        seg = f[off:off + l.size].reshape(l.shape).astype(l.dtype)
+                        new.append(l + seg)
+                        off += l.size
+                    out.append(jax.tree.unflatten(struct, new))
+                return out
+            self._jit_cache[sig] = jax.jit(unpack)
+        return self._jit_cache[sig](flat, trees)
+
+    def _unpack_to(self, flat: Any, metas: list, shardings: list,
+                   group: tuple) -> list:
+        """Slice flat into trees with `metas` shapes, placed on `shardings`
+        (one jitted program with explicit out_shardings on the dst mesh).
+        `group` keys the cache: identical shapes on different destination
+        stages need different baked-in out_shardings."""
+        sig = ("unpack_to", group,
+               tuple((shape, str(dtype))
+                     for layer in metas for shape, dtype in layer[0]))
+        if sig not in self._jit_cache:
+            structs = [struct for _, struct in metas]
+            leaf_metas = [lm for lm, _ in metas]
+
+            def unpack(f):
+                out, off = [], 0
+                for lm, struct in zip(leaf_metas, structs):
+                    new = []
+                    for shape, dtype in lm:
+                        size = int(np.prod(shape)) if shape else 1
+                        new.append(
+                            f[off:off + size].reshape(shape).astype(dtype)
+                        )
+                        off += size
+                    out.append(jax.tree.unflatten(struct, new))
+                return out
+            self._jit_cache[sig] = jax.jit(
+                unpack, out_shardings=shardings
+            )
+        return self._jit_cache[sig](flat)
 
     def do_allreduce(self) -> dict[int, dict[int, Any]]:
-        """Returns {pipeline_id: {layer: synced_grad_tree}}."""
+        """Returns {pipeline_id: {layer: synced_grad_tree}}.
+
+        Transfer granularity is (src stage) -> (anchor stage): one packed
+        buffer per stage pair per direction, because a jitted program's
+        inputs must share one mesh — a stage IS a mesh here. The
+        replicated-flat hop is the single-controller stand-in for the DCN
+        allreduce a multi-slice deployment would issue."""
         synced: dict[int, dict[int, Any]] = {p.pipeline_id: {} for p in self.pipelines}
+        self.last_transfer_count = 0
+        # Group shared layers by (src stage, anchor stage).
+        fwd_groups: dict[tuple, list[int]] = {}
+        anchors: dict[int, PipelineInstance] = {}
         for li, owners in self.owners.items():
             if len(owners) == 1:
                 synced[owners[0].pipeline_id][li] = owners[0].grads[li]
                 continue
-            # Sum on the first owner's placement, then redistribute. On a
-            # multi-slice deployment this is the DCN allreduce; single-
-            # controller it is a cross-mesh transfer + add.
             anchor = owners[0]
-            target = anchor.stages[anchor.stage_of_layer(li)].param_shardings[li]
-            total = anchor.grads[li]
+            anchors[li] = anchor
             for other in owners[1:]:
-                moved = jax.device_put(other.grads[li], target)
-                total = jax.tree.map(jnp.add, total, moved)
-            for p in owners:
-                if p is anchor:
-                    synced[p.pipeline_id][li] = total
-                else:
-                    dst = p.stages[p.stage_of_layer(li)].param_shardings[li]
-                    synced[p.pipeline_id][li] = jax.device_put(total, dst)
+                key = (self._group_key(other, li), self._group_key(anchor, li))
+                fwd_groups.setdefault(key, []).append(li)
+        by_id = {p.pipeline_id: p for p in self.pipelines}
+
+        # Phase 1 — sum every remote stage's contribution on the anchor.
+        totals: dict[int, Any] = {li: anchors[li].grads[li] for li in anchors}
+        for ((src_id, _), (dst_id, dst_st)), lis in sorted(fwd_groups.items()):
+            lis = sorted(lis)
+            src, dst = by_id[src_id], by_id[dst_id]
+            flat = self._pack([src.grads[li] for li in lis])
+            flat = jax.device_put(flat, NamedSharding(
+                dst.stages[dst_st].mesh, jax.sharding.PartitionSpec()
+            ))
+            self.last_transfer_count += 1
+            added = self._unpack_add(flat, [totals[li] for li in lis])
+            for li, tree in zip(lis, added):
+                totals[li] = tree
+
+        # Phase 2 — redistribute anchor totals to the other owners.
+        bwd_groups: dict[tuple, list[int]] = {}
+        for li, anchor in anchors.items():
+            synced[anchor.pipeline_id][li] = totals[li]
+            for other in self.owners[li][1:]:
+                key = (self._group_key(anchor, li), self._group_key(other, li))
+                bwd_groups.setdefault(key, []).append(li)
+        for ((_, _), (dst_id, dst_st)), lis in sorted(bwd_groups.items()):
+            lis = sorted(lis)
+            dst = by_id[dst_id]
+            flat = self._pack([totals[li] for li in lis])
+            flat = jax.device_put(flat, NamedSharding(
+                dst.stages[dst_st].mesh, jax.sharding.PartitionSpec()
+            ))
+            self.last_transfer_count += 1
+            metas = []
+            shardings = []
+            for li in lis:
+                tree = totals[li]
+                leaves, struct = jax.tree.flatten(tree)
+                metas.append(
+                    ([(l.shape, l.dtype) for l in leaves], struct)
+                )
+                sh = dst.stages[dst_st].param_shardings[li]
+                shardings.append(sh)
+            unpacked = self._unpack_to(flat, metas, shardings,
+                                       group=(dst_id, dst_st))
+            for li, tree in zip(lis, unpacked):
+                synced[dst.pipeline_id][li] = tree
         return synced
 
 
@@ -202,6 +330,10 @@ class OobleckEngine:
         # Wall-clock seconds per completed reconfiguration — the paper's
         # headline recovery metric (BASELINE.md targets <60 s/failure).
         self.recovery_times: list[float] = []
+        # Chips left idle by each fused-path recovery (shrink_to_fit drops
+        # devices until microbatch divisibility holds); first-class next to
+        # recovery_times so silent capacity loss is visible.
+        self.stranded_chips: list[int] = []
         self.dataloaders: list[OobleckDataLoader] = []
         self.opt_states: dict[int, dict[int, Any]] = {}
         self.plan: HeterogeneousPlan | None = None
@@ -600,6 +732,7 @@ class OobleckEngine:
 
         max_steps = self.args.job.steps
         interval = self.args.execution.checkpoint_interval
+        sync_interval = self.args.execution.replica_sync_interval
         tracer = StepTracer()
         try:
             while self.step < max_steps:
@@ -611,6 +744,8 @@ class OobleckEngine:
                     timers = sync_timers()
                     logger.info("step timer: %s | %s",
                                 timers.get("step"), _device_memory_summary())
+                if sync_interval and self.step % sync_interval == 0:
+                    self._sync_replicas()
                 if interval and self.step % interval == 0:
                     self.save_checkpoint()
             if interval and self.step % interval != 0:
@@ -915,9 +1050,12 @@ class OobleckEngine:
         self.fused = new_fused
         elapsed = time.perf_counter() - t0
         self.recovery_times.append(elapsed)
+        stranded = len(devices) - mesh.devices.size
+        self.stranded_chips.append(stranded)
         logger.warning(
-            "reconfigured (fused) after losing %s in %.2fs: mesh %s",
-            lost_ip, elapsed, dict(mesh.shape),
+            "reconfigured (fused) after losing %s in %.2fs: mesh %s"
+            "%s", lost_ip, elapsed, dict(mesh.shape),
+            f" ({stranded} surviving chips STRANDED)" if stranded else "",
         )
 
 
@@ -938,6 +1076,10 @@ class _CyclicView:
     def __getitem__(self, i: int):
         return self.ds[i % len(self.ds)]
 
+    def set_epoch(self, epoch: int) -> None:
+        if hasattr(self.ds, "set_epoch"):
+            self.ds.set_epoch(epoch)
+
 
 class _TailView:
     """A length-`length` window of `ds` starting at `offset` (the held-out
@@ -953,6 +1095,10 @@ class _TailView:
 
     def __getitem__(self, i: int):
         return self.ds[self.offset + i]
+
+    def set_epoch(self, epoch: int) -> None:
+        if hasattr(self.ds, "set_epoch"):
+            self.ds.set_epoch(epoch)
 
 
 def _scale_template_chips(t: PipelineTemplate, tp: int) -> PipelineTemplate:

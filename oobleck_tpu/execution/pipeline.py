@@ -64,14 +64,21 @@ class StageRuntime:
     param_pspecs: dict[int, Any]           # layer -> PartitionSpec tree
     tp: int = 1                            # tensor-parallel degree in-stage
     use_fsdp: bool = False                 # params + batch sharded over fsdp
+    manual: bool = True                    # model has the ShardCtx path
     needs_batch: bool = True               # any layer here reads the batch
     fwd: Callable | None = None
     bwd: Callable | None = None
 
     @property
     def ctx(self):
-        """ShardCtx for manual-collective execution; None = plain program."""
-        if self.tp == 1 and not self.use_fsdp:
+        """ShardCtx for manual-collective execution; None = plain program.
+
+        Only causal-LM families (gpt/llama) implement the Megatron-style
+        embed/apply_block/head_loss_shifted contract the manual shard_map
+        program calls; every other family runs the generic apply_layer
+        program, where GSPMD handles any batch sharding (use_fsdp then means
+        within-stage data parallelism with replicated params)."""
+        if not self.manual or (self.tp == 1 and not self.use_fsdp):
             return None
         from oobleck_tpu.models.gpt import ShardCtx
 
@@ -125,6 +132,32 @@ class PipelineInstance:
                     f"tensor_parallel={tp}"
                 )
 
+        # Per-layer PartitionSpec trees. Families with manual-TP sharding
+        # rules (gpt/llama) declare them via param_specs; everything else
+        # (bert/t5/vit/resnet/clip/swin, reference module/model.py:21-33)
+        # gets replicated specs synthesized from the layer's abstract shape —
+        # the reference's equivalent is NO_SHARD FlatParamHandles
+        # (layer.py:96-111) for any family, no per-family code.
+        manual = hasattr(model, "head_loss_shifted")
+        if hasattr(model, "param_specs"):
+            _specs = model.param_specs(stacked=False)
+
+            def spec_tree(li: int):
+                name = model.layer_name(li)
+                return (
+                    _specs["embed"] if name == "embed"
+                    else _specs["head"] if name == "head"
+                    else _specs["blocks"]
+                )
+        else:
+            _spec_rng = jax.random.PRNGKey(0)
+
+            def spec_tree(li: int):
+                shapes = jax.eval_shape(
+                    lambda r: model.init_layer(r, li), _spec_rng
+                )
+                return jax.tree.map(lambda _: P(), shapes)
+
         self.stages: list[StageRuntime] = []
         cursor = 0
         for si, stage in enumerate(template.stages):
@@ -167,19 +200,12 @@ class PipelineInstance:
                 a for a, on in (("fsdp", use_fsdp), ("tensor", tp > 1)) if on
             )
             batch_spec = P("fsdp") if use_fsdp else P(None)
-            specs = model.param_specs(stacked=False)
             param_shardings: dict[int, Any] = {}
             param_pspecs: dict[int, Any] = {}
             for li in stage.layer_indices:
-                name = model.layer_name(li)
-                tree = (
-                    specs["embed"] if name == "embed"
-                    else specs["head"] if name == "head"
-                    else specs["blocks"]
-                )
                 param_pspecs[li] = jax.tree.map(
                     lambda s: _project_spec(s, keep),
-                    tree,
+                    spec_tree(li),
                     is_leaf=lambda x: isinstance(x, P),
                 )
                 param_shardings[li] = jax.tree.map(
@@ -201,6 +227,7 @@ class PipelineInstance:
                 param_pspecs=param_pspecs,
                 tp=tp,
                 use_fsdp=use_fsdp,
+                manual=manual,
                 needs_batch=bool(batch_layers & set(stage.layer_indices)),
             ))
 

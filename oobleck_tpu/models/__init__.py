@@ -95,6 +95,42 @@ register("vit-large-patch16-224")(lambda o: _vit(o, hidden_size=1024, num_layers
 register("vit-tiny")(lambda o: _vit(o, image_size=32, patch_size=8, num_classes=10, hidden_size=64, num_layers=4, num_heads=4))
 
 
+def _resnet(overrides, **preset):
+    from oobleck_tpu.models.resnet import ResNetConfig, ResNetModel
+
+    return ResNetModel(ResNetConfig().override(**preset).override(**overrides))
+
+
+# ResNet family (conv pipeline; reference sharding.py:37-41 splits per block)
+register("resnet-50")(lambda o: _resnet(o, depths=(3, 4, 6, 3)))
+register("resnet-152")(lambda o: _resnet(o, depths=(3, 8, 36, 3)))
+register("resnet-tiny")(lambda o: _resnet(o, image_size=32, num_classes=10, embedding_size=16, hidden_sizes=(32, 64), depths=(1, 1)))
+
+
+def _swin(overrides, **preset):
+    from oobleck_tpu.models.swin import SwinConfig, SwinModel
+
+    return SwinModel(SwinConfig().override(**preset).override(**overrides))
+
+
+# Swin family (HF names per released checkpoints; "-micro" is the test config)
+register("swin-tiny-patch4-window7-224")(lambda o: _swin(o, embed_dim=96, depths=(2, 2, 6, 2), num_heads=(3, 6, 12, 24)))
+register("swin-base-patch4-window7-224")(lambda o: _swin(o, embed_dim=128, depths=(2, 2, 18, 2), num_heads=(4, 8, 16, 32)))
+register("swin-micro")(lambda o: _swin(o, image_size=32, patch_size=4, num_classes=10, embed_dim=32, depths=(2, 1), num_heads=(2, 4), window_size=4))
+
+
+def _clip(overrides, **preset):
+    from oobleck_tpu.models.clip import CLIPConfig, CLIPModel
+
+    return CLIPModel(CLIPConfig().override(**preset).override(**overrides))
+
+
+# CLIP family (dual-encoder contrastive)
+register("clip-vit-base-patch32")(lambda o: _clip(o))
+register("clip-vit-base-patch16")(lambda o: _clip(o, patch_size=16))
+register("clip-tiny")(lambda o: _clip(o, image_size=32, patch_size=8, vision_hidden_size=64, vision_layers=3, vision_heads=4, vocab_size=256, max_position_embeddings=32, text_hidden_size=64, text_layers=3, text_heads=4, projection_dim=32))
+
+
 def build_model(model_name: str, model_args: dict[str, Any] | None = None,
                 execution=None):
     """Resolve a model name (+ overrides) to a layer-list model instance.

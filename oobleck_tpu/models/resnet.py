@@ -1,0 +1,250 @@
+"""ResNet image classifier as an explicit layer list.
+
+Capability match for the reference's resnet family
+(AutoModelForImageClassification + fx split at every bottleneck block,
+/root/reference/oobleck/module/model.py:26-33, sharding.py:37-41: one split
+point per `resnet.encoder.stages.{i}.layers.{j}` plus the pooler).
+
+Layer list: [stem, one layer per bottleneck block (stage-major), head] —
+exactly the reference's split granularity, so templates plan over the same
+units. Activations change shape across stages (spatial /2, channels x2);
+the MPMD pipeline handles that naturally since every stage program is
+jit-compiled for its own carry shape.
+
+TPU-first choices:
+  * NHWC layout + HWIO kernels (`lax.conv_general_dilated`) — the layout XLA
+    tiles onto the MXU without transposes;
+  * normalization is batch-statistics BatchNorm with trainable scale/shift
+    but NO running-average state (train and eval both use batch stats):
+    pipeline stages are pure functions of (params, carry), and running
+    stats would be mutable cross-step state threaded through every stage.
+    Deviation from HF ResNet's eval-time running stats, documented here.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass, field, replace
+from typing import Any
+
+import jax
+import jax.numpy as jnp
+
+
+@dataclass(frozen=True)
+class ResNetConfig:
+    image_size: int = 224
+    num_channels: int = 3
+    num_classes: int = 1000
+    embedding_size: int = 64                   # stem output channels
+    hidden_sizes: tuple = (256, 512, 1024, 2048)
+    depths: tuple = (3, 4, 6, 3)
+    reduction: int = 4                         # bottleneck squeeze factor
+    initializer_range: float = 0.02
+    bn_epsilon: float = 1e-5
+    dtype: Any = jnp.bfloat16
+    param_dtype: Any = jnp.float32
+    remat: bool = True
+
+    def override(self, **kwargs) -> "ResNetConfig":
+        unknown = [k for k in kwargs
+                   if k not in ResNetConfig.__dataclass_fields__]
+        if unknown:
+            raise ValueError(f"unknown model_args {unknown}")
+        for key in ("hidden_sizes", "depths"):
+            if key in kwargs:
+                kwargs[key] = tuple(kwargs[key])
+        return replace(self, **kwargs)
+
+
+def _conv(x, w, stride: int = 1, padding="SAME"):
+    return jax.lax.conv_general_dilated(
+        x, w, window_strides=(stride, stride), padding=padding,
+        dimension_numbers=("NHWC", "HWIO", "NHWC"),
+    )
+
+
+def _batch_norm(x, p, eps: float):
+    """Batch-stats normalization over (N, H, W) with trainable scale/shift."""
+    mean = jnp.mean(x, axis=(0, 1, 2), keepdims=True)
+    var = jnp.var(x, axis=(0, 1, 2), keepdims=True)
+    inv = jax.lax.rsqrt(var + eps)
+    return (x - mean) * inv * p["scale"].astype(x.dtype) + p["bias"].astype(x.dtype)
+
+
+class ResNetModel:
+    # Engine contract: image batches through the generic MPMD path.
+    data_kind = "image"
+
+    def __init__(self, config: ResNetConfig):
+        self.config = config
+        # blocks[i] = (stage, index_in_stage) in stage-major order.
+        self._blocks: list[tuple[int, int]] = [
+            (s, j) for s, depth in enumerate(config.depths)
+            for j in range(depth)
+        ]
+
+    # ---- layer list ----
+
+    @property
+    def num_pipeline_layers(self) -> int:
+        return len(self._blocks) + 2
+
+    def layer_name(self, index: int) -> str:
+        if index == 0:
+            return "stem"
+        if index == self.num_pipeline_layers - 1:
+            return "head"
+        s, j = self._blocks[index - 1]
+        return f"stage{s}_block{j}"
+
+    def _block_shape(self, s: int, j: int) -> tuple[int, int, int]:
+        """(in_channels, out_channels, stride) of block (s, j)."""
+        c = self.config
+        out = c.hidden_sizes[s]
+        if j > 0:
+            return out, out, 1
+        prev = c.embedding_size if s == 0 else c.hidden_sizes[s - 1]
+        return prev, out, (1 if s == 0 else 2)
+
+    def init_layer(self, rng, index):
+        ks = jax.random.split(rng, 3)
+        if index == 0:
+            return self._init_stem(ks[0])
+        if index == self.num_pipeline_layers - 1:
+            return self._init_head(ks[2])
+        s, j = self._blocks[index - 1]
+        return self._init_block(jax.random.fold_in(ks[1], index), s, j)
+
+    def apply_layer(self, index, params, carry, batch, ctx=None):
+        if index == 0:
+            return self.stem(params, batch["pixel_values"])
+        if index == self.num_pipeline_layers - 1:
+            return self.head(params, carry)
+        s, j = self._blocks[index - 1]
+        return self.apply_block(params, carry, *self._block_shape(s, j)[2:])
+
+    def sample_batch(self, batch_size: int, *_ignored):
+        c = self.config
+        rng = jax.random.PRNGKey(0)
+        return {
+            "pixel_values": jax.random.normal(
+                rng, (batch_size, c.image_size, c.image_size, c.num_channels),
+                jnp.float32,
+            ),
+            "labels": jax.random.randint(
+                jax.random.fold_in(rng, 1), (batch_size,), 0, c.num_classes,
+                dtype=jnp.int32,
+            ),
+        }
+
+    # ---- init ----
+
+    def _bn_init(self, ch: int):
+        c = self.config
+        return {"scale": jnp.ones((ch,), c.param_dtype),
+                "bias": jnp.zeros((ch,), c.param_dtype)}
+
+    def _conv_init(self, rng, kh, kw, cin, cout):
+        c = self.config
+        fan_in = kh * kw * cin
+        std = (2.0 / fan_in) ** 0.5  # He init for ReLU stacks
+        return jax.random.normal(rng, (kh, kw, cin, cout), c.param_dtype) * std
+
+    def _init_stem(self, rng):
+        c = self.config
+        return {
+            "conv": self._conv_init(rng, 7, 7, c.num_channels, c.embedding_size),
+            "bn": self._bn_init(c.embedding_size),
+        }
+
+    def _init_block(self, rng, s: int, j: int):
+        c = self.config
+        cin, cout, stride = self._block_shape(s, j)
+        mid = cout // c.reduction
+        ks = jax.random.split(rng, 4)
+        p = {
+            "conv1": self._conv_init(ks[0], 1, 1, cin, mid),
+            "bn1": self._bn_init(mid),
+            "conv2": self._conv_init(ks[1], 3, 3, mid, mid),
+            "bn2": self._bn_init(mid),
+            "conv3": self._conv_init(ks[2], 1, 1, mid, cout),
+            "bn3": self._bn_init(cout),
+        }
+        if cin != cout or stride != 1:
+            p["shortcut"] = {
+                "conv": self._conv_init(ks[3], 1, 1, cin, cout),
+                "bn": self._bn_init(cout),
+            }
+        return p
+
+    def _init_head(self, rng):
+        c = self.config
+        cout = c.hidden_sizes[-1]
+        return {
+            "w": jax.random.normal(rng, (cout, c.num_classes), c.param_dtype)
+            * c.initializer_range,
+            "b": jnp.zeros((c.num_classes,), c.param_dtype),
+        }
+
+    def init_params(self, rng):
+        """Per-layer dict keyed by layer name (blocks are heterogeneous in
+        shape, so there is no stacked view; the fused SPMD path does not
+        apply to conv pipelines)."""
+        return {self.layer_name(i): self.init_layer(rng, i)
+                for i in range(self.num_pipeline_layers)}
+
+    # ---- forward ----
+
+    def stem(self, p, pixels):
+        c = self.config
+        x = pixels.astype(c.dtype)
+        x = _conv(x, p["conv"].astype(c.dtype), stride=2)
+        x = jax.nn.relu(_batch_norm(x, p["bn"], c.bn_epsilon))
+        # 3x3 max pool, stride 2.
+        return jax.lax.reduce_window(
+            x, -jnp.inf, jax.lax.max, (1, 3, 3, 1), (1, 2, 2, 1), "SAME"
+        )
+
+    def apply_block(self, p, x, stride: int = 1):
+        c = self.config
+        dt = c.dtype
+        h = jax.nn.relu(_batch_norm(
+            _conv(x, p["conv1"].astype(dt)), p["bn1"], c.bn_epsilon))
+        h = jax.nn.relu(_batch_norm(
+            _conv(h, p["conv2"].astype(dt), stride=stride), p["bn2"],
+            c.bn_epsilon))
+        h = _batch_norm(_conv(h, p["conv3"].astype(dt)), p["bn3"], c.bn_epsilon)
+        if "shortcut" in p:
+            x = _batch_norm(
+                _conv(x, p["shortcut"]["conv"].astype(dt), stride=stride),
+                p["shortcut"]["bn"], c.bn_epsilon)
+        return jax.nn.relu(x + h)
+
+    def head(self, p, x):
+        c = self.config
+        pooled = jnp.mean(x, axis=(1, 2))  # global average pool
+        return (pooled @ p["w"].astype(c.dtype)
+                + p["b"].astype(c.dtype)).astype(jnp.float32)
+
+    def forward(self, params, pixels):
+        x = self.stem(params["stem"], pixels)
+        for i, (s, j) in enumerate(self._blocks):
+            name = self.layer_name(i + 1)
+            block = self.apply_block
+            if self.config.remat:
+                block = jax.checkpoint(block, static_argnums=(2,))
+            x = block(params[name], x, self._block_shape(s, j)[2])
+        return self.head(params["head"], x)
+
+    def loss_from_logits(self, logits, batch):
+        logits = logits.astype(jnp.float32)
+        logz = jax.nn.logsumexp(logits, axis=-1)
+        gold = jnp.take_along_axis(
+            logits, batch["labels"][..., None], axis=-1
+        )[..., 0]
+        return jnp.mean(logz - gold)
+
+    def loss(self, params, batch):
+        return self.loss_from_logits(
+            self.forward(params, batch["pixel_values"]), batch
+        )

@@ -127,7 +127,9 @@ def causal_attention(
     cross-attention (seq_q != seq_k) always take the XLA path.
     """
     fn = select_attention_impl(impl)
-    if fn.__name__ == "ring_attention":
+    from oobleck_tpu.ops.ring_attention import ring_attention
+
+    if fn is ring_attention:
         # Ring handles unbiased causal self-attention only; anything else
         # falls back to XLA (single-device call — the sequence-parallel path
         # reaches ring_attention directly with its own checks).

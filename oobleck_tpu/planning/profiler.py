@@ -132,7 +132,9 @@ def _measure_allreduce(nbytes: int, devices: list) -> float:
     from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
     mesh = Mesh(devices, ("x",))
-    elems = max(nbytes // 4, n)
+    # Round up to a multiple of n: P("x") requires dim 0 divisible by the
+    # mesh size (layer param counts are arbitrary, e.g. t5-tiny's 778).
+    elems = -(-max(nbytes // 4, n) // n) * n
     arr = jnp.ones((elems,), jnp.float32)
     arr = jax.device_put(arr, NamedSharding(mesh, P("x")))
 

@@ -143,11 +143,29 @@ async def test_coordinator_relay(job_args):
     r2, w2, _ = await register_agent(daemon, "10.0.0.2")
 
     await send_request(w1, RequestType.FORWARD_COORDINATOR,
-                       {"address": "10.0.0.1:9999"})
+                       {"address": "10.0.0.1:9999", "world": 2})
     msg1 = await recv_msg(r1, timeout=5)
     msg2 = await recv_msg(r2, timeout=5)
     for msg in (msg1, msg2):
         assert msg["kind"] == ResponseType.FORWARD_COORDINATOR.value
         assert msg["address"] == "10.0.0.1:9999"
+        # The generation tag must survive the relay: without it every
+        # downstream worker takes the untagged-trust branch and a respawned
+        # worker can adopt a stale pre-failure coordinator (round-2 advisor).
+        assert msg["world"] == 2
     assert daemon.coordinator == "10.0.0.1:9999"
+
+    # A replayed announcement to a late registrant carries the tag too.
+    r3, w3, _ = await register_agent(daemon, "10.0.0.3")
+    # register_agent consumed the SUCCESS; next message is the replay.
+    msg3 = await recv_msg(r3, timeout=5)
+    assert msg3["kind"] == ResponseType.FORWARD_COORDINATOR.value
+    assert msg3["world"] == 2
+
+    # The stale-generation guard actually fires on mismatched worlds.
+    from oobleck_tpu.elastic.worker import coordinator_address_if_current
+    relay = {"kind": "coordinator", "address": msg3["address"],
+             "world": msg3["world"]}
+    assert coordinator_address_if_current(relay, world=2) == "10.0.0.1:9999"
+    assert coordinator_address_if_current(relay, world=1) is None
     task.cancel()
