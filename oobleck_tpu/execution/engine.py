@@ -33,6 +33,7 @@ from typing import Any
 import jax
 import jax.numpy as jnp
 import numpy as np
+from jax.sharding import NamedSharding
 
 from oobleck_tpu.config import OobleckArguments
 from oobleck_tpu.execution.dataloader import OobleckDataLoader, OobleckSampler
@@ -87,8 +88,8 @@ class DataParallelEngine:
             for li in p.params:
                 self.owners.setdefault(li, []).append(p)
         self._jit_cache: dict = {}
-        # Observability for tests/benchmarks: cross-mesh buffer transfers
-        # issued by the last do_allreduce call.
+        # Observability for tests/benchmarks: batched cross-mesh device_put
+        # calls issued by the last do_allreduce (at most one per phase).
         self.last_transfer_count = 0
 
     # -- flat-buffer helpers ------------------------------------------- #
@@ -187,18 +188,28 @@ class DataParallelEngine:
         by_id = {p.pipeline_id: p for p in self.pipelines}
 
         # Phase 1 — sum every remote stage's contribution on the anchor.
+        # Pack one buffer per (src stage, anchor stage) pair, then ship ALL
+        # buffers in a single jax.device_put: handing the runtime the whole
+        # transfer set at once lets the copies ride ICI/DCN concurrently
+        # instead of serializing through the Python loop.
         totals: dict[int, Any] = {li: anchors[li].grads[li] for li in anchors}
+        fwd = []
         for ((src_id, _), (dst_id, dst_st)), lis in sorted(fwd_groups.items()):
             lis = sorted(lis)
             src, dst = by_id[src_id], by_id[dst_id]
             flat = self._pack([src.grads[li] for li in lis])
-            flat = jax.device_put(flat, NamedSharding(
+            sharding = NamedSharding(
                 dst.stages[dst_st].mesh, jax.sharding.PartitionSpec()
-            ))
+            )
+            fwd.append((lis, flat, sharding))
+        if fwd:
+            group_lis, flats, dst_shardings = zip(*fwd)
+            moved = jax.device_put(list(flats), list(dst_shardings))
             self.last_transfer_count += 1
-            added = self._unpack_add(flat, [totals[li] for li in lis])
-            for li, tree in zip(lis, added):
-                totals[li] = tree
+            for lis, flat in zip(group_lis, moved):
+                added = self._unpack_add(flat, [totals[li] for li in lis])
+                for li, tree in zip(lis, added):
+                    totals[li] = tree
 
         # Phase 2 — redistribute anchor totals to the other owners.
         bwd_groups: dict[tuple, list[int]] = {}
@@ -207,28 +218,34 @@ class DataParallelEngine:
             for other in self.owners[li][1:]:
                 key = (self._group_key(anchor, li), self._group_key(other, li))
                 bwd_groups.setdefault(key, []).append(li)
+        bwd = []
         for ((_, _), (dst_id, dst_st)), lis in sorted(bwd_groups.items()):
             lis = sorted(lis)
             dst = by_id[dst_id]
             flat = self._pack([totals[li] for li in lis])
-            flat = jax.device_put(flat, NamedSharding(
+            sharding = NamedSharding(
                 dst.stages[dst_st].mesh, jax.sharding.PartitionSpec()
-            ))
+            )
+            bwd.append((lis, flat, sharding, dst, dst_st))
+        if bwd:
+            group_lis, flats, dst_shardings, dsts, dst_sts = zip(*bwd)
+            moved = jax.device_put(list(flats), list(dst_shardings))
             self.last_transfer_count += 1
-            metas = []
-            shardings = []
-            for li in lis:
-                tree = totals[li]
-                leaves, struct = jax.tree.flatten(tree)
-                metas.append(
-                    ([(l.shape, l.dtype) for l in leaves], struct)
-                )
-                sh = dst.stages[dst_st].param_shardings[li]
-                shardings.append(sh)
-            unpacked = self._unpack_to(flat, metas, shardings,
-                                       group=(dst_id, dst_st))
-            for li, tree in zip(lis, unpacked):
-                synced[dst.pipeline_id][li] = tree
+            for lis, flat, dst, dst_st in zip(group_lis, moved, dsts, dst_sts):
+                metas = []
+                shardings = []
+                for li in lis:
+                    tree = totals[li]
+                    leaves, struct = jax.tree.flatten(tree)
+                    metas.append(
+                        ([(l.shape, l.dtype) for l in leaves], struct)
+                    )
+                    sh = dst.stages[dst_st].param_shardings[li]
+                    shardings.append(sh)
+                unpacked = self._unpack_to(flat, metas, shardings,
+                                           group=(dst.pipeline_id, dst_st))
+                for li, tree in zip(lis, unpacked):
+                    synced[dst.pipeline_id][li] = tree
         return synced
 
 

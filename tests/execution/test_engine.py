@@ -78,27 +78,64 @@ def test_train_steps_decrease_loss(trained_engine):
     assert losses[-1] < losses[0]
 
 
+def _np_leaves(tree):
+    return [np.asarray(l, np.float32) for l in jax.tree.leaves(tree)]
+
+
 def test_dp_sync_consistency(trained_engine):
-    """After a step, every pipeline owning a layer holds identical params
-    (the layer-granularity allreduce guarantee, reference engine.py:363-412)."""
+    """Layer-granularity DP sync, end to end (reference engine.py:363-412):
+    run the pipeline passes explicitly, hand-compute each shared layer's
+    gradient sum from the captured per-pipeline local grads, and assert
+    (a) do_allreduce returns exactly that sum to EVERY owner, (b) the local
+    grads genuinely differ across owners (different microbatches — so a
+    no-op do_allreduce cannot pass), and (c) after the optimizer step every
+    owner holds identical, *changed* params. Self-contained: no dependence
+    on params being init-identical or on fixture ordering (round-3 weak #2)."""
     e = trained_engine
     if len(e.pipelines) < 2:
         pytest.skip("plan chose a single pipeline")
-    owners: dict[int, list] = {}
-    for p in e.pipelines:
-        for li in p.params:
-            owners.setdefault(li, []).append(p)
-    shared = [li for li, ps in owners.items() if len(ps) > 1]
+    for pipe, dl in zip(e.pipelines, e.dataloaders):
+        pipe.train_step(dl.next_batch())
+    owners = e.dp_engine.owners
+    shared = [li for li, ow in owners.items() if len(ow) > 1]
     assert shared, "no layer shared across pipelines in this plan"
+
+    local = {li: [_np_leaves(p.grads[li]) for p in owners[li]]
+             for li in shared}
+    pre_params = {li: _np_leaves(owners[li][0].params[li]) for li in shared}
+
+    synced = e.dp_engine.do_allreduce()
+
+    for li in shared:
+        want = [np.sum(ls, axis=0)
+                for ls in zip(*local[li])]
+        # Different pipelines consumed different microbatches, so the sum
+        # must differ from any single owner's contribution; this is what
+        # makes a no-op (return-local-grads) do_allreduce fail here.
+        assert any(
+            not np.allclose(w, l, rtol=1e-5, atol=1e-7)
+            for w, l in zip(want, local[li][0])
+        ), f"layer {li}: summed grads indistinguishable from local grads"
+        for p in owners[li]:
+            got = _np_leaves(synced[p.pipeline_id][li])
+            for g, w in zip(got, want):
+                np.testing.assert_allclose(g, w, rtol=1e-5, atol=1e-6)
+
+    for pipe in e.pipelines:
+        e.opt_states[pipe.pipeline_id] = pipe.apply_updates(
+            e.optimizer, e.opt_states[pipe.pipeline_id],
+            synced[pipe.pipeline_id],
+        )
     for li in shared:
         ps = owners[li]
-        a = jax.tree.leaves(ps[0].params[li])
-        b = jax.tree.leaves(ps[1].params[li])
-        for x, y in zip(a, b):
-            np.testing.assert_allclose(
-                np.asarray(x, np.float32), np.asarray(y, np.float32),
-                rtol=1e-5, atol=1e-6,
-            )
+        ref = _np_leaves(ps[0].params[li])
+        assert any(
+            not np.allclose(r, old, rtol=1e-6, atol=1e-8)
+            for r, old in zip(ref, pre_params[li])
+        ), f"layer {li}: params did not change after the optimizer step"
+        for other in ps[1:]:
+            for x, y in zip(ref, _np_leaves(other.params[li])):
+                np.testing.assert_allclose(x, y, rtol=1e-5, atol=1e-6)
 
 
 def test_reconfiguration_resumes(cache_env, devices8):
@@ -296,9 +333,10 @@ def test_dp_allreduce_batched_transfers_and_exactness(trained_engine):
     batched_s = _time.perf_counter() - t0
     shared = [li for li, ow in e.dp_engine.owners.items() if len(ow) > 1]
     assert shared
-    # Transfer count: bounded by stage pairs, strictly below the per-layer
-    # floor the old implementation paid (2 transfers per shared layer).
-    assert 0 < e.dp_engine.last_transfer_count < 2 * len(shared)
+    # Transfer count: at most ONE batched device_put per phase (the whole
+    # transfer set is handed to the runtime at once), vs the 2-per-shared-
+    # layer floor the unbatched implementation paid.
+    assert 0 < e.dp_engine.last_transfer_count <= 2
 
     # Unbatched reference: per-layer device_put + add (the round-2 code).
     t0 = _time.perf_counter()
@@ -315,8 +353,8 @@ def test_dp_allreduce_batched_transfers_and_exactness(trained_engine):
     unbatched_s = _time.perf_counter() - t0
     print(f"\ndp_allreduce batched={batched_s * 1e3:.1f}ms "
           f"unbatched={unbatched_s * 1e3:.1f}ms "
-          f"transfers={e.dp_engine.last_transfer_count} "
-          f"(vs >= {2 * len(shared)} per-layer)")
+          f"device_put calls={e.dp_engine.last_transfer_count} "
+          f"(vs >= {2 * len(shared)} unbatched per-layer)")
 
     for li in shared:
         anchor_id = e.dp_engine.owners[li][0].pipeline_id
