@@ -102,6 +102,15 @@ class ExecutionArguments:
     attention_impl: str = "auto"  # auto | xla | pallas | ring
     checkpoint_dir: str | None = None
     checkpoint_interval: int = 0  # steps; 0 disables
+    # Checkpoint-FREE multi-host recovery (reference engine.py:238-309:
+    # survivors broadcast live states, no checkpoint reload): each worker
+    # mirrors its LOCAL layers' live state to a host-local file every
+    # mirror_interval steps; after a failure the respawned world refills
+    # every layer from the freshest surviving mirror with one collective,
+    # falling back to a checkpoint only for layers no survivor holds.
+    # mirror_dir must be HOST-LOCAL storage (e.g. /dev/shm); None disables.
+    mirror_dir: str | None = None
+    mirror_interval: int = 1
     # Cross-pipeline replica re-broadcast period (steps; 0 disables). DP
     # replicas of a layer drift bitwise over time (different per-mesh
     # reduction orders); the reference re-broadcasts only during failure

@@ -188,7 +188,8 @@ class OobleckAgent:
         """Multi-host recovery: restart the worker against the surviving
         hosts. The fresh worker re-runs the coordinator chain (a new
         jax.distributed world of the survivors) and restores position and
-        weights from the latest checkpoint."""
+        weights from the surviving live-state mirrors (checkpoint-free)
+        or, failing that, the latest checkpoint."""
         t0 = time.monotonic()
         self._stop_worker()
         self.args.dist.node_ips = list(self.node_ips)
@@ -219,11 +220,6 @@ class OobleckAgent:
                     if msg.get("world") is not None:
                         payload["world"] = msg["world"]
                     self.worker.pipe.send(payload)
-            elif kind == ResponseType.GRAD_SUM.value:
-                if self.worker is not None:
-                    self.worker.pipe.send({"kind": "grad_sum",
-                                           "step": msg["step"],
-                                           "data": msg["data"]})
             elif kind == ResponseType.SUCCESS.value and "dist_info" in msg:
                 if self.worker is not None:
                     self.worker.pipe.send(
@@ -240,7 +236,7 @@ class OobleckAgent:
             return
         if lost_ip in self.node_ips:
             self.node_ips.remove(lost_ip)
-        if self._multihost() and self.args.execution.resolved_path() == "fused":
+        if self._multihost():
             w = self.worker
             if w is not None and w.process.exitcode == 0:
                 # Our own training already completed; a peer's departure
@@ -249,14 +245,15 @@ class OobleckAgent:
                 return
             # A peer process is gone: the shared jax.distributed world is
             # broken and cannot shrink in place — restart the worker over
-            # the survivors (checkpoint restore carries weights + data
-            # position). to_thread: _stop_worker joins for up to 20s and
-            # must not stall the response/ping/relay loops mid-recovery.
+            # the survivors. Weights + data position come from the live
+            # state mirror when configured (checkpoint-free recovery), else
+            # the latest checkpoint. to_thread: _stop_worker joins for up
+            # to 20s and must not stall the response/ping/relay loops
+            # mid-recovery.
             await asyncio.to_thread(self.respawn_worker)
         elif self.worker is not None:
-            # Single-host, or multi-process MPMD (each worker owns a private
-            # local JAX runtime, so survivors reconfigure in place — the
-            # reference's NCCL-rebuild model, engine.py:91-180).
+            # Single-host: the engine reconfigures in place — the
+            # reference's NCCL-rebuild model (engine.py:91-180).
             self.worker.pipe.send({"kind": "reconfigure", "lost_ip": lost_ip})
 
     async def ping_loop(self) -> None:
@@ -270,19 +267,12 @@ class OobleckAgent:
 
     async def worker_port_loop(self) -> None:
         """Poll the worker pipe for upward messages: the coordinator
-        announcement (reference forward_worker_port, agent.py:181-188) and
-        multi-process-MPMD gradient contributions."""
+        announcement (reference forward_worker_port, agent.py:181-188)."""
         while True:
             try:
                 if self.worker is not None and self.worker.pipe.poll():
                     msg = self.worker.pipe.recv()
-                    if msg.get("kind") == "grad_sync":
-                        async with self._send_lock:
-                            await send_request(
-                                self._writer, RequestType.GRAD_SYNC,
-                                {"step": msg["step"], "data": msg["data"]},
-                            )
-                    elif msg.get("kind") == "coordinator":
+                    if msg.get("kind") == "coordinator":
                         # Keep the `world` generation tag intact: dropping
                         # it here would make every downstream worker take
                         # the untagged-trust branch and accept stale

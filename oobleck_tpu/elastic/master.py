@@ -102,8 +102,6 @@ class OobleckMasterDaemon:
         self.coordinator_world: int | None = None  # its generation tag
         self._server: asyncio.Server | None = None
         self._pending_ips: list[str] = []
-        # Multi-process MPMD gradient reduction: step -> {agent_ip: bytes}.
-        self._grad_contribs: dict[int, dict[str, bytes]] = {}
 
     # ------------------------------------------------------------------ #
 
@@ -230,9 +228,6 @@ class OobleckMasterDaemon:
             elif kind == RequestType.JOB_DONE.value:
                 logger.info("agent %s reports training complete", agent.ip)
                 agent.clean_exit = True
-            elif kind == RequestType.GRAD_SYNC.value:
-                await self._on_grad_contrib(agent.ip, int(msg["step"]),
-                                            msg["data"])
             elif kind == RequestType.FORWARD_COORDINATOR.value:
                 # First agent's worker announces the JAX coordinator address;
                 # relay to everyone (reference forward_rank0_port_handler,
@@ -250,38 +245,6 @@ class OobleckMasterDaemon:
                 await send_response(agent.writer, ResponseType.FAILURE,
                                     {"error": f"unknown request {kind}"})
 
-    async def _on_grad_contrib(self, ip: str, step: int, data_b64: str) -> None:
-        """Collect one agent's flat-gradient contribution; when every live
-        agent has contributed for `step`, sum and broadcast GRAD_SUM."""
-        self._grad_contribs.setdefault(step, {})[ip] = data_b64
-        await self._flush_grad_steps()
-
-    async def _flush_grad_steps(self) -> None:
-        import base64
-
-        import numpy as np
-
-        live = set(self.agents)
-        done = [s for s, c in self._grad_contribs.items()
-                if live and live <= set(c)]
-        for step in sorted(done):
-            contribs = self._grad_contribs.pop(step)
-            # Sum over LIVE contributors only: a dead host's pipeline left
-            # the plan, so its samples (and grads) leave the step with it.
-            bufs = [np.frombuffer(base64.b64decode(contribs[ip]), np.float32)
-                    for ip in sorted(live)]
-            total = bufs[0].copy()
-            for b in bufs[1:]:
-                total += b
-            payload = {"step": step,
-                       "data": base64.b64encode(total.tobytes()).decode()}
-            for agent in list(self.agents.values()):
-                try:
-                    await send_response(agent.writer, ResponseType.GRAD_SUM,
-                                        payload)
-                except ConnectionError:
-                    pass
-
     async def _close_agent(self, ip: str) -> None:
         """Reference close_agent (master.py:192-203): drop the agent and
         broadcast the loss to survivors — unless the agent announced a clean
@@ -297,9 +260,6 @@ class OobleckMasterDaemon:
                                     {"lost_ip": ip})
             except ConnectionError:
                 pass
-        # A lost agent can no longer contribute: re-evaluate pending
-        # gradient steps so survivors blocked on GRAD_SUM make progress.
-        await self._flush_grad_steps()
 
 
 async def _amain(port: int) -> None:

@@ -33,11 +33,6 @@ class RequestType(str, Enum):
     # drops the agent WITHOUT broadcasting RECONFIGURATION — completion must
     # not look like a failure to the surviving agents.
     JOB_DONE = "job_done"
-    # Multi-process MPMD: a worker's per-step flat gradient contribution
-    # (base64 f32). The master sums contributions from every live agent and
-    # broadcasts GRAD_SUM — the control-plane stand-in for the cross-host
-    # DCN allreduce (reference DataParallelEngine, engine.py:363-412).
-    GRAD_SYNC = "grad_sync"
 
 
 class ResponseType(str, Enum):
@@ -46,7 +41,6 @@ class ResponseType(str, Enum):
     PONG = "pong"
     RECONFIGURATION = "reconfiguration"
     FORWARD_COORDINATOR = "forward_coordinator"
-    GRAD_SUM = "grad_sum"
 
 
 @dataclass

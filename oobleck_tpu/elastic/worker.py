@@ -94,12 +94,14 @@ def worker_main(pipe, agent_ip: str, args_dict: dict) -> None:
     # enforces this at construction.
     assert job.global_microbatch_size % job.microbatch_size == 0
 
-    if (os.environ.get("OOBLECK_MULTIHOST") == "1"
-            and args.execution.resolved_path() == "fused"):
-        # Fused multi-host: one shared jax.distributed SPMD world. The MPMD
-        # path instead runs a PRIVATE local JAX runtime per host (pipelines
-        # never span hosts there; cross-host DP rides the control plane), so
-        # no coordinator chain is needed.
+    if os.environ.get("OOBLECK_MULTIHOST") == "1":
+        # One shared jax.distributed world for BOTH paths: the fused SPMD
+        # program spans it directly; the MPMD engine runs host-local stage
+        # jits inside it, with cross-host pipeline edges and the layer-
+        # granularity DP allreduce riding XLA collectives over process
+        # meshes (parallel/cross_host.py) — the TPU-native equivalent of
+        # the reference's node-spanning NCCL pipelines + DP groups
+        # (pipeline.py:582-617, engine.py:363-412).
         _init_jax_distributed(pipe, agent_ip, args)
 
     from oobleck_tpu.execution.engine import OobleckEngine

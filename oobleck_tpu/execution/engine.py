@@ -249,6 +249,70 @@ class DataParallelEngine:
         return synced
 
 
+class MultiHostDataParallelEngine:
+    """Layer-granularity DP sync when pipelines live across jax.distributed
+    processes: ONE flat f32 allreduce over a process mesh per step carries
+    every (pipeline, layer) gradient contribution plus the per-pipeline
+    weighted losses — the grand fused version of the reference's per-(layer,
+    fsdp-shard) NCCL allreduce grid (engine.py:363-412). On hardware the
+    buffer rides DCN/ICI; nothing touches the control plane.
+
+    Each (pipeline, layer) gradient is owned by exactly one process (stages
+    are host-local), so summing local contributions into the shared layout
+    and psumming across processes double-counts nothing."""
+
+    def __init__(self, pipelines: list[PipelineInstance], model, comm):
+        from oobleck_tpu.parallel.cross_host import FlatLayout, layer_avals
+
+        self.pipelines = pipelines
+        self.comm = comm
+        # Union of owners across ALL pipelines (remote included): needed so
+        # every process agrees on which layers are DP-shared.
+        self.owners: dict[int, list[PipelineInstance]] = {}
+        for p in pipelines:
+            for st in p.stages:
+                for li in st.layer_ids:
+                    self.owners.setdefault(li, []).append(p)
+        # 2 extra slots per pipeline: [weight * loss, weight].
+        self.layout = FlatLayout(layer_avals(model),
+                                 extra=2 * len(pipelines))
+        self.last_transfer_count = 0
+
+    def allreduce(self, local_losses: dict[int, tuple[float, int]]
+                  ) -> tuple[dict[int, dict[int, Any]], float]:
+        """local_losses: {pipeline_id: (loss, weight)} for pipelines whose
+        last stage is local. Returns ({pipeline_id: {layer: summed grads}}
+        for LOCAL (pipeline, layer) pairs, global weighted mean loss)."""
+        buf = np.zeros(self.layout.length, np.float32)
+        for pipe in self.pipelines:
+            for li, g in pipe.grads.items():
+                self.layout.pack_into(buf, li, g)
+        base = self.layout.param_length
+        for i, pipe in enumerate(self.pipelines):
+            if pipe.pipeline_id in local_losses:
+                loss, weight = local_losses[pipe.pipeline_id]
+                buf[base + 2 * i] += float(loss) * weight
+                buf[base + 2 * i + 1] += weight
+        total = self.comm.group_sum(
+            buf, self.layout.length, range(self.comm.process_count)
+        )
+        self.last_transfer_count = 1
+        synced: dict[int, dict[int, Any]] = {}
+        for pipe in self.pipelines:
+            if not pipe.participates_locally:
+                continue
+            synced[pipe.pipeline_id] = {
+                li: jax.device_put(
+                    self.layout.unpack(total, li),
+                    pipe.stages[pipe.stage_of_layer(li)].param_shardings[li],
+                )
+                for li in pipe.params
+            }
+        wl = total[base::2][:len(self.pipelines)].sum()
+        w = total[base + 1::2][:len(self.pipelines)].sum()
+        return synced, float(wl / w) if w else float("nan")
+
+
 class ReconfigurationEngine:
     """Listens on the agent pipe for lost-host notifications and drives the
     engine's reconfiguration (reference engine.py:39-89, daemon thread)."""
@@ -340,6 +404,9 @@ class OobleckEngine:
         self._host_index = {ip: i for i, ip in enumerate(self.host_ips)}
         self.devices: list | None = None
         self.chips_per_host: int | None = None
+        # Multi-host MPMD: one jax.distributed world, host h == process h.
+        self.multihost = False
+        self.comm = None
         self.templates: list[PipelineTemplate] = []
         self.pipelines: list[PipelineInstance] = []
         self.fused = None                    # FusedPipeline when engine_path=fused
@@ -394,16 +461,52 @@ class OobleckEngine:
             # was built (backends must not initialize first); this is the
             # embedded-engine path.
             self._initialize_multihost()
-        self.devices = (
-            list(self._injected_devices) if self._injected_devices is not None
-            else list(jax.devices())
-        )
         n_hosts = len(self.host_ips)
-        if len(self.devices) % n_hosts != 0:
-            raise ValueError(
-                f"{len(self.devices)} devices not divisible by {n_hosts} hosts"
+        multihost_world = (
+            jax.process_count() > 1
+            # A 1-host survivor world stays on the multihost path (degenerate
+            # 1-process collectives) so mirror-based recovery still runs.
+            or (os.environ.get("OOBLECK_MULTIHOST") == "1"
+                and _jax_distributed_active())
+        )
+        if (self._injected_devices is None and multihost_world
+                and self.args.execution.resolved_path() == "mpmd"):
+            # Multi-host MPMD: host h IS jax process h (worker_main passes
+            # process_id = node_ips.index(agent_ip)). Order the global
+            # device list host-major so rank = host * chips_per_host +
+            # local, and bring up the cross-process comm backend.
+            from oobleck_tpu.parallel.cross_host import ProcessComm
+
+            if jax.process_count() != n_hosts:
+                raise RuntimeError(
+                    f"{jax.process_count()} jax processes != {n_hosts} hosts"
+                )
+            per_host = [
+                sorted((d for d in jax.devices() if d.process_index == p),
+                       key=lambda d: d.id)
+                for p in range(n_hosts)
+            ]
+            if len({len(l) for l in per_host}) != 1:
+                raise RuntimeError(
+                    f"uneven chips per host: {[len(l) for l in per_host]}"
+                )
+            self.devices = [d for l in per_host for d in l]
+            self.chips_per_host = len(per_host[0])
+            self.multihost = True
+            self.comm = ProcessComm()
+            self._broadcast_profiles()
+        else:
+            self.devices = (
+                list(self._injected_devices)
+                if self._injected_devices is not None
+                else list(jax.devices())
             )
-        self.chips_per_host = len(self.devices) // n_hosts
+            if len(self.devices) % n_hosts != 0:
+                raise ValueError(
+                    f"{len(self.devices)} devices not divisible by "
+                    f"{n_hosts} hosts"
+                )
+            self.chips_per_host = len(self.devices) // n_hosts
 
         if self.args.execution.resolved_path() == "fused":
             # Fused path: one global mesh instead of per-pipeline templates;
@@ -449,6 +552,38 @@ class OobleckEngine:
             self.templates = filtered
         logger.info("templates for host counts %s",
                     [t.num_hosts for t in self.templates])
+
+    def _broadcast_profiles(self) -> None:
+        """Adopt process 0's layer profile on every process. Planning is
+        cost-driven; per-process timing noise would otherwise produce
+        different templates/plans per process and the global schedule (whose
+        cross-process collectives rely on identical interpretation order)
+        would diverge. One collective, at startup only."""
+        import dataclasses
+
+        vec: list[float] = []
+        for p in self.profiles:
+            vec.extend([p.forward, p.backward,
+                        float(p.mem_params), float(p.mem_activation)])
+            vec.extend(v for _, v in sorted(p.allreduce_in_host.items()))
+            vec.extend(v for _, v in sorted(p.allreduce_across_hosts.items()))
+        arr = np.asarray(vec, np.float32)
+        if self.comm.process_index != 0:
+            arr = np.zeros_like(arr)
+        total = self.comm.group_sum(arr, arr.shape[0],
+                                    range(self.comm.process_count))
+        it = iter(total.tolist())
+        adopted = []
+        for p in self.profiles:
+            fwd, bwd, mp, ma = (next(it) for _ in range(4))
+            in_host = {k: next(it) for k in sorted(p.allreduce_in_host)}
+            across = {k: next(it) for k in sorted(p.allreduce_across_hosts)}
+            adopted.append(dataclasses.replace(
+                p, forward=fwd, backward=bwd,
+                mem_params=int(mp), mem_activation=int(ma),
+                allreduce_in_host=in_host, allreduce_across_hosts=across,
+            ))
+        self.profiles = adopted
 
     def _initialize_multihost(self, timeout_s: float = 120.0) -> None:
         """Coordinator chain: host 0 announces, everyone initializes.
@@ -520,6 +655,24 @@ class OobleckEngine:
                               num_iterations_done: int = 0, epoch: int = 0) -> None:
         old_params = old_opt = None
         restored = self.try_restore_checkpoint()
+        if self.multihost and self.args.execution.mirror_dir:
+            # Collective — every process calls regardless of mirror state.
+            mirrored = self._try_restore_mirror()
+            if mirrored is not None and (
+                restored is None
+                or mirrored["meta"]["step"] >= restored["meta"]["step"]
+            ):
+                if restored is not None:
+                    # Layers absent from every mirror keep checkpoint state.
+                    for li, v in restored["params"].items():
+                        mirrored["params"].setdefault(li, v)
+                    for li, v in restored["opt"].items():
+                        mirrored["opt"].setdefault(li, v)
+                logger.info(
+                    "recovered live state from surviving mirrors (step %s, "
+                    "checkpoint-free)", mirrored["meta"]["step"],
+                )
+                restored = mirrored
         if restored is not None:
             old_params = restored["params"]
             # Optimizer leaves were stored flat; rebuild the optax structure.
@@ -666,6 +819,10 @@ class OobleckEngine:
         self.dataloaders = []
         self.opt_states = {}
         train_samples = len(self.dataset) - self._eval_reserve()
+        process_of_rank = (
+            [r // self.chips_per_host for r in range(len(self.devices))]
+            if self.multihost else None
+        )
         for a in assignments:
             pipe = PipelineInstance(
                 pipeline_id=a.pipeline_index,
@@ -681,6 +838,8 @@ class OobleckEngine:
                 exec_cache=self._exec_cache,
                 tensor_parallel=self.args.execution.tensor_parallel,
                 fsdp=self.args.execution.fsdp,
+                process_of_rank=process_of_rank,
+                comm=self.comm,
             )
             self.pipelines.append(pipe)
             # Train over the head split only; the tail is evaluate()'s
@@ -708,7 +867,10 @@ class OobleckEngine:
                 }
             else:
                 self.opt_states[pipe.pipeline_id] = pipe.init_opt_state(self.optimizer)
-        self.dp_engine = DataParallelEngine(self.pipelines)
+        self.dp_engine = (
+            MultiHostDataParallelEngine(self.pipelines, self.model, self.comm)
+            if self.multihost else DataParallelEngine(self.pipelines)
+        )
 
     # ------------------------------------------------------------------ #
 
@@ -721,6 +883,9 @@ class OobleckEngine:
                 loss = self.fused.train_step(self.dataloaders[0].next_batch())
             self.step += 1
             return float(loss)
+
+        if self.multihost:
+            return self._train_step_multihost()
 
         losses = []
         weights = []
@@ -741,6 +906,42 @@ class OobleckEngine:
         loss = sum(float(l) * w for l, w in zip(losses, weights)) / total
         self.step += 1
         return loss
+
+    def _train_step_multihost(self) -> float:
+        """One step across the jax.distributed world: every process
+        interprets every pipeline (executing only its own stages and the
+        cross-process edges it borders), then ONE flat allreduce syncs all
+        layer grads and the per-pipeline losses, then each process steps its
+        local layers. The reference's cross-node train step decomposes the
+        same way (pipeline.train per rank + DataParallelEngine.do_allreduce,
+        engine.py:645-649)."""
+        from oobleck_tpu.utils.tracing import annotate
+
+        local_losses: dict[int, tuple[float, int]] = {}
+        with annotate("pipelines"):
+            for pipe, dl in zip(self.pipelines, self.dataloaders):
+                # EVERY process advances EVERY dataloader: samplers are
+                # deterministic, so batch contents agree wherever the
+                # pipeline's batch-consuming stages live.
+                batch = dl.next_batch()
+                if not pipe.participates_locally:
+                    continue
+                loss = pipe.train_step(batch)
+                if loss is not None:
+                    local_losses[pipe.pipeline_id] = (
+                        float(loss), pipe.num_microbatches
+                    )
+        with annotate("dp_allreduce"):
+            synced, global_loss = self.dp_engine.allreduce(local_losses)
+        with annotate("optimizer"):
+            for pipe in self.pipelines:
+                if pipe.participates_locally:
+                    self.opt_states[pipe.pipeline_id] = pipe.apply_updates(
+                        self.optimizer, self.opt_states[pipe.pipeline_id],
+                        synced[pipe.pipeline_id],
+                    )
+        self.step += 1
+        return global_loss
 
     def train(self) -> None:
         """Reference train loop (engine.py:651-668) + loss reporting and
@@ -765,6 +966,11 @@ class OobleckEngine:
                     self._sync_replicas()
                 if interval and self.step % interval == 0:
                     self.save_checkpoint()
+                mirror_every = self.args.execution.mirror_interval
+                if (self.multihost and self.args.execution.mirror_dir
+                        and mirror_every
+                        and self.step % mirror_every == 0):
+                    self._write_mirror()
             if interval and self.step % interval != 0:
                 self.save_checkpoint()
         finally:
@@ -789,6 +995,9 @@ class OobleckEngine:
         owner, engine.py:238-309; here a cross-mesh device_put)."""
         if not self.dp_engine:
             return
+        if self.multihost:
+            self._sync_replicas_multihost()
+            return
         for li, owners in self.dp_engine.owners.items():
             if len(owners) <= 1:
                 continue
@@ -802,6 +1011,61 @@ class OobleckEngine:
                     dst,
                 )
 
+    def _fill_full_state(self) -> dict[int, Any]:
+        """COLLECTIVE: elect, per layer, the lowest process holding it
+        live, and refill the FULL {layer: {"p": params, "o": opt}} state on
+        every process with one psum — the workhorse behind multi-host
+        replica sync and multi-host checkpoint collection (the reference's
+        _copy_model_states broadcast, engine.py:238-309)."""
+        layout = self._live_layout
+        nl = len(layout.layers)
+        P = self.comm.process_count
+        me = self.comm.process_index
+        local_state: dict[int, Any] = {}
+        for pipe in self.pipelines:
+            if not pipe.participates_locally:
+                continue
+            for li in pipe.params:
+                if li not in local_state:
+                    local_state[li] = {
+                        "p": pipe.params[li],
+                        "o": self.opt_states[pipe.pipeline_id][li],
+                    }
+        votes = np.full(nl, np.inf, np.float32)
+        for i, li in enumerate(layout.layers):
+            if li in local_state:
+                votes[i] = me
+        winners = self.comm.group_min(votes, nl, range(P))
+        contrib = np.zeros(layout.length, np.float32)
+        for i, li in enumerate(layout.layers):
+            if np.isfinite(winners[i]) and winners[i] == me:
+                layout.pack_into(contrib, li, local_state[li])
+        total = self.comm.group_sum(contrib, layout.length, range(P))
+        return {
+            li: layout.unpack(total, li)
+            for i, li in enumerate(layout.layers) if np.isfinite(winners[i])
+        }
+
+    def _sync_replicas_multihost(self) -> None:
+        """COLLECTIVE anchor re-broadcast across processes: every local
+        owner of a DP-shared layer adopts the elected anchor's replica."""
+        shared = {li for li, ow in self.dp_engine.owners.items()
+                  if len(ow) > 1}
+        if not shared:
+            return
+        full = self._fill_full_state()
+        for pipe in self.pipelines:
+            if not pipe.participates_locally:
+                continue
+            for li in pipe.params:
+                if li not in shared or li not in full:
+                    continue
+                dst = pipe.stages[pipe.stage_of_layer(li)].param_shardings[li]
+                pipe.params[li] = jax.device_put(full[li]["p"], dst)
+                self.opt_states[pipe.pipeline_id][li] = _place_opt_state(
+                    self.optimizer, full[li]["o"], dst,
+                )
+
     def save_checkpoint(self) -> None:
         from oobleck_tpu.execution.checkpoint import save_checkpoint
 
@@ -813,6 +1077,12 @@ class OobleckEngine:
         # barrier inside save(); gating non-zero processes out deadlocks it.
         if self.fused is not None:
             params, opt = self.fused.layer_state()
+        elif self.multihost:
+            # COLLECTIVE: every process assembles the identical full state
+            # (orbax then writes host values from the primary only).
+            full = self._fill_full_state()
+            params = {li: v["p"] for li, v in full.items()}
+            opt = {li: v["o"] for li, v in full.items()}
         else:
             self._sync_replicas()
             params, opt = self._collect_layer_state()
@@ -837,6 +1107,150 @@ class OobleckEngine:
         payload = load_checkpoint(target)
         logger.info("restoring from %s (step %s)", target, payload["meta"]["step"])
         return payload
+
+    # -- checkpoint-free live-state mirror (multi-host MPMD) ------------ #
+
+    _MAX_MIRROR_STEP = 2**18 - 1  # election votes must fit f32 exactly
+
+    @property
+    def _live_layout(self):
+        """FlatLayout over {layer: {"p": params, "o": opt leaves}} — the
+        shared wire format for mirrors, recovery fill, and replica sync."""
+        if getattr(self, "_live_layout_cache", None) is None:
+            from oobleck_tpu.parallel.cross_host import FlatLayout, layer_avals
+
+            avals = layer_avals(self.model)
+            self._live_layout_cache = FlatLayout({
+                li: {"p": avals[li],
+                     "o": jax.eval_shape(self.optimizer.init, avals[li])}
+                for li in avals
+            })
+        return self._live_layout_cache
+
+    def _mirror_file(self):
+        """Mirror path. mirror_dir should be host-local storage; the file
+        name still carries the host identity so same-machine test clusters
+        (loopback-alias "hosts" sharing a filesystem) don't collide."""
+        from pathlib import Path
+
+        d = self.args.execution.mirror_dir
+        if not d:
+            return None
+        tag = (self.agent_ip or "local").replace(":", "_").replace("/", "_")
+        return Path(d) / f"live_state_{tag}.npz"
+
+    def _write_mirror(self) -> None:
+        """Persist this process's LOCAL layers' live state to host-local
+        storage (atomic replace). The failure-time cost this buys: recovery
+        needs no checkpoint reload and loses at most mirror_interval-1
+        steps (reference in-memory recovery loses none but requires
+        survivors' processes to outlive the broken world, which the JAX
+        runtime cannot guarantee — respawn + mirror is the TPU-shaped
+        equivalent)."""
+        import os as _os
+
+        path = self._mirror_file()
+        if path is None:
+            return
+        layout = self._live_layout
+        params, opt = self._collect_layer_state()
+        buf = np.zeros(layout.length, np.float32)
+        have = np.zeros(len(layout.layers), bool)
+        for li, p in params.items():
+            layout.pack_into(buf, li, {"p": p, "o": opt[li]})
+            have[layout.layers.index(li)] = True
+        path.parent.mkdir(parents=True, exist_ok=True)
+        tmp = path.with_suffix(".tmp.npz")
+        np.savez(tmp, buf=buf, have=have, step=self.step,
+                 num_iterations_done=self.dataloaders[0].num_iterations_done,
+                 epoch=self.dataloaders[0].epoch)
+        _os.replace(tmp, path)
+
+    def _try_restore_mirror(self) -> dict | None:
+        """COLLECTIVE (every process must call, mirror or not): elect, per
+        layer, the surviving mirror with the freshest step (ties -> lowest
+        process), refill the full state with one psum, and return a payload
+        shaped like try_restore_checkpoint's. None when no process holds a
+        mirror. Matches the reference's survivor-broadcast recovery
+        (engine.py:238-309) with the state moving over DCN collectives."""
+        layout = self._live_layout
+        nl = len(layout.layers)
+        P = self.comm.process_count
+        me = self.comm.process_index
+        path = self._mirror_file()
+        local = None
+        if path is not None and path.exists():
+            try:
+                local = np.load(path)
+            except Exception as e:
+                logger.warning("unreadable mirror %s: %s", path, e)
+        # Vote encoding (MAX-step)*64 + process must stay exact in f32 and
+        # decode via % 64: both break past 64 processes (the control plane
+        # caps clusters at MAX_NUM_HOSTS=32, master.py).
+        if P > 64:
+            raise RuntimeError(
+                f"mirror election supports <= 64 processes, got {P}"
+            )
+        INF = np.float32(np.inf)
+        votes = np.full(nl, INF, np.float32)
+        if local is not None:
+            step = int(local["step"])
+            if step > self._MAX_MIRROR_STEP:
+                # Clamped steps tie in the election (lowest process wins
+                # regardless of freshness) — keep recovering, but say so.
+                logger.warning(
+                    "mirror step %d exceeds the election's exact range "
+                    "(%d); freshness ties break by process index",
+                    step, self._MAX_MIRROR_STEP,
+                )
+                step = self._MAX_MIRROR_STEP
+            enc = np.float32((self._MAX_MIRROR_STEP - step) * 64 + me)
+            votes[np.asarray(local["have"], bool)] = enc
+        winners = self.comm.group_min(votes, nl, range(P))
+        if not np.isfinite(winners).any():
+            return None
+        contrib = np.zeros(layout.length + 3, np.float32)
+        if local is not None:
+            # Vote encodings embed the process index, so winners are unique:
+            # votes[i] == winners[i] iff this process won layer i.
+            buf = np.asarray(local["buf"], np.float32)
+            for i, li in enumerate(layout.layers):
+                if np.isfinite(winners[i]) and votes[i] == winners[i]:
+                    off, size = layout.slices[li]
+                    contrib[off:off + size] = buf[off:off + size]
+        # Meta (step / data position) rides with the process holding the
+        # globally freshest mirror: enc % 64 recovers its process index.
+        best = winners[np.isfinite(winners)].min()
+        if local is not None and int(best) % 64 == me and np.isfinite(
+            votes
+        ).any() and votes[np.isfinite(votes)].min() == best:
+            contrib[layout.length + 0] = float(local["step"])
+            contrib[layout.length + 1] = float(local["num_iterations_done"])
+            contrib[layout.length + 2] = float(local["epoch"])
+        total = self.comm.group_sum(contrib, layout.length + 3, range(P))
+        covered = [li for i, li in enumerate(layout.layers)
+                   if np.isfinite(winners[i])]
+        missing = [li for li in layout.layers if li not in covered]
+        if missing:
+            logger.warning(
+                "no surviving mirror holds layers %s; they fall back to "
+                "checkpoint or fresh init", missing,
+            )
+        params = {}
+        opt = {}
+        for li in covered:
+            tree = layout.unpack(total, li)
+            params[li] = tree["p"]
+            opt[li] = jax.tree.leaves(tree["o"])
+        return {
+            "params": params,
+            "opt": opt,
+            "meta": {
+                "step": int(total[layout.length + 0]),
+                "num_iterations_done": int(total[layout.length + 1]),
+                "epoch": int(total[layout.length + 2]),
+            },
+        }
 
     # ------------------------------------------------------------------ #
 
@@ -957,10 +1371,21 @@ class OobleckEngine:
                 weight_sum += 1
             else:
                 for pipe, dl in zip(self.pipelines, loaders):
-                    loss = float(pipe.eval_step(dl.next_batch()))
-                    loss_sum += loss * pipe.num_microbatches
+                    batch = dl.next_batch()  # advance on every process
+                    if self.multihost and not pipe.participates_locally:
+                        continue
+                    loss = pipe.eval_step(batch)
+                    if loss is None:
+                        continue  # last stage lives on another process
+                    loss_sum += float(loss) * pipe.num_microbatches
                     weight_sum += pipe.num_microbatches
         self._eval_state = (samplers[0].num_iterations_done, samplers[0].epoch)
+        if self.multihost:
+            total = self.comm.group_sum(
+                np.asarray([loss_sum, weight_sum], np.float32), 2,
+                range(self.comm.process_count),
+            )
+            return float(total[0] / total[1])
         return loss_sum / weight_sum
 
     def request_reconfiguration(self, lost_ip: str) -> None:
@@ -980,6 +1405,16 @@ class OobleckEngine:
         re-instantiate reusing surviving weights + optimizer state and the
         data position."""
         t0 = time.perf_counter()
+        if self.multihost:
+            # A lost peer breaks the shared jax.distributed world; the agent
+            # respawns the worker over the survivors (live mirrors make the
+            # restart checkpoint-free). In-place reconfiguration is the
+            # single-controller path only.
+            logger.warning(
+                "multihost MPMD reconfigures by respawn; ignoring in-place "
+                "request for %s", lost_ip,
+            )
+            return
         if lost_ip not in self.host_ips:
             logger.warning("unknown lost host %s", lost_ip)
             return

@@ -41,6 +41,51 @@ from oobleck_tpu.planning.templates import PipelineTemplate
 logger = logging.getLogger("oobleck.pipeline")
 
 
+_ORDER_CACHE: dict[tuple[int, int], list[Instruction]] = {}
+
+
+def canonical_order(S: int, M: int) -> list[Instruction]:
+    """The total execution order the dependency-driven greedy interpreter
+    produces for the 1F1B streams — a pure function of (stages,
+    microbatches), so every jax.distributed process derives the IDENTICAL
+    order without communicating. This is what makes cross-process edge
+    collectives deadlock-free: any two processes issue their shared
+    transfers in the same relative order."""
+    key = (S, M)
+    if key in _ORDER_CACHE:
+        return _ORDER_CACHE[key]
+    streams = [deque(s) for s in all_instructions(S, M)]
+    acts: set[tuple[int, int]] = set()
+    gacts: set[tuple[int, int]] = set()
+    order: list[Instruction] = []
+
+    def ready(ins: Instruction) -> bool:
+        if ins.op == Op.RECV_ACTIVATION:
+            return (ins.stage, ins.microbatch) in acts
+        if ins.op == Op.RECV_GRAD:
+            return (ins.stage, ins.microbatch) in gacts
+        return True
+
+    progress = True
+    while any(streams):
+        if not progress:
+            pending = [(s[0].op, s[0].stage, s[0].microbatch)
+                       for s in streams if s]
+            raise RuntimeError(f"pipeline schedule deadlock: {pending}")
+        progress = False
+        for q in streams:
+            while q and ready(q[0]):
+                ins = q.popleft()
+                order.append(ins)
+                if ins.op == Op.SEND_ACTIVATION:
+                    acts.add((ins.stage + 1, ins.microbatch))
+                elif ins.op == Op.SEND_GRAD:
+                    gacts.add((ins.stage - 1, ins.microbatch))
+                progress = True
+    _ORDER_CACHE[key] = order
+    return order
+
+
 def _project_spec(spec: P, keep: frozenset) -> P:
     """Project a model PartitionSpec onto a stage mesh, keeping only the axis
     names in `keep` (subset of {"fsdp", "tensor"}); everything else becomes
@@ -66,6 +111,8 @@ class StageRuntime:
     use_fsdp: bool = False                 # params + batch sharded over fsdp
     manual: bool = True                    # model has the ShardCtx path
     needs_batch: bool = True               # any layer here reads the batch
+    process: int | None = None             # owning process (multi-host MPMD)
+    is_local: bool = True                  # this process owns the stage
     fwd: Callable | None = None
     bwd: Callable | None = None
 
@@ -106,7 +153,15 @@ class PipelineInstance:
         exec_cache: dict | None = None,
         tensor_parallel: int = 1,
         fsdp: int = -1,
+        process_of_rank: list[int] | None = None,
+        comm=None,
     ):
+        """`process_of_rank` + `comm` switch on multi-host MPMD execution:
+        stages owned by other jax.distributed processes are skipped locally
+        and stage-to-stage edges that cross processes ride `comm` (a
+        parallel.cross_host.ProcessComm) — the TPU-native analog of the
+        reference's node-spanning pipelines over NCCL p2p
+        (/root/reference/oobleck/execution/pipeline.py:582-617)."""
         assert len(ranks) == template.num_chips, (len(ranks), template.num_chips)
         self.pipeline_id = pipeline_id
         self.template = template
@@ -117,6 +172,9 @@ class PipelineInstance:
         self.microbatch_size = microbatch_size
         self.seq_len = seq_len
         self._exec_cache = exec_cache if exec_cache is not None else {}
+        self.comm = comm
+        self._process_of_rank = process_of_rank
+        my_process = comm.process_index if comm is not None else None
 
         tp = max(1, tensor_parallel)
         if tp > 1:
@@ -217,6 +275,21 @@ class PipelineInstance:
                 model, "batch_layers",
                 {0, model.num_pipeline_layers - 1},
             ))
+            if process_of_rank is not None:
+                stage_procs = {process_of_rank[r] for r in stage_ranks}
+                if len(stage_procs) != 1:
+                    # Mirrors the reference's planner feasibility rule that
+                    # two nodes never share one stage
+                    # (pipeline_template.cpp:193-214): a stage is one host's
+                    # chips, so its jits stay process-local.
+                    raise ValueError(
+                        f"stage {si} spans processes {sorted(stage_procs)}; "
+                        "multi-host MPMD requires host-local stages"
+                    )
+                stage_process = stage_procs.pop()
+                stage_local = stage_process == my_process
+            else:
+                stage_process, stage_local = None, True
             self.stages.append(StageRuntime(
                 stage_index=si,
                 layer_ids=tuple(stage.layer_indices),
@@ -229,12 +302,19 @@ class PipelineInstance:
                 use_fsdp=use_fsdp,
                 manual=manual,
                 needs_batch=bool(batch_layers & set(stage.layer_indices)),
+                process=stage_process,
+                is_local=stage_local,
             ))
 
         # Parameters: dict layer -> pytree placed on the owning stage's mesh.
+        # Multi-host: only this process's stages materialize (remote device
+        # placement is neither possible nor needed — the owning process
+        # materializes its own, from the same seed-42 stream).
         self.params: dict[int, Any] = {}
         rng = jax.random.PRNGKey(42)  # reference fixes seed 42 (model.py:18)
         for st in self.stages:
+            if not st.is_local:
+                continue
             for li in st.layer_ids:
                 if params is not None and li in params:
                     src = params[li]
@@ -243,6 +323,9 @@ class PipelineInstance:
                 self.params[li] = jax.device_put(src, st.param_shardings[li])
 
         self.grads: dict[int, Any] = {}
+        # Static activation avals for cross-process edges (computed lazily:
+        # single-controller runs never need them).
+        self._act_avals: list | None = None
         self._build_stage_fns()
 
     # ------------------------------------------------------------------ #
@@ -367,6 +450,8 @@ class PipelineInstance:
         S = self.num_stages
         scale = 1.0 / self.total_num_microbatches
         for st in self.stages:
+            if not st.is_local:
+                continue
             is_first = st.stage_index == 0
             is_last = st.stage_index == S - 1
             key = (
@@ -423,11 +508,13 @@ class PipelineInstance:
     def _place_batch(self, batch: dict[str, np.ndarray]):
         """Per-microbatch batch placement onto every stage that reads it
         (embed, loss head, and any model-declared mid-pipeline consumer
-        like T5's bridge). Shared by train/eval."""
+        like T5's bridge). Shared by train/eval. Remote stages place
+        nothing (their owning process places its own copy — dataloaders are
+        deterministic and advanced in lockstep on every process)."""
         M = next(iter(batch.values())).shape[0]
         per_stage: dict[int, list[dict] | None] = {}
         for st in self.stages:
-            if not st.needs_batch:
+            if not st.needs_batch or not st.is_local:
                 per_stage[st.stage_index] = None
                 continue
             per_stage[st.stage_index] = [
@@ -436,6 +523,41 @@ class PipelineInstance:
                 for m in range(M)
             ]
         return per_stage, M
+
+    # -- multi-host participation --------------------------------------- #
+
+    @property
+    def participates_locally(self) -> bool:
+        """Whether this process owns any stage of this pipeline."""
+        return any(st.is_local for st in self.stages)
+
+    def _edge_aval(self, src_stage: int):
+        """Static aval of the activation flowing from src_stage to
+        src_stage+1 (gradients mirror it)."""
+        if self._act_avals is None:
+            from oobleck_tpu.parallel.cross_host import activation_avals
+
+            self._act_avals = activation_avals(
+                self.model, self.microbatch_size, self.seq_len
+            )
+        return self._act_avals[self.stages[src_stage].layer_ids[-1]]
+
+    def _move_edge(self, value, src: StageRuntime, dst: StageRuntime,
+                   aval_stage: int):
+        """Move an activation/gradient across a stage edge. Same-process:
+        a device_put between sub-meshes (ICI path). Cross-process: a
+        2-process collective (parallel/cross_host.ProcessComm.send).
+        Returns the value placed on dst's batch sharding, or None when this
+        process does not own dst."""
+        if src.is_local and dst.is_local:
+            return jax.device_put(value, dst.batch_sharding)
+        received = self.comm.send(
+            value if src.is_local else None,
+            src.process, dst.process, self._edge_aval(aval_stage),
+        )
+        if dst.is_local:
+            return jax.device_put(received, dst.batch_sharding)
+        return None
 
     def train_step(self, batch):
         """One iteration over this pipeline's microbatches.
@@ -448,7 +570,6 @@ class PipelineInstance:
         batch = self._as_batch_dict(batch)
         S, M = self.num_stages, self.num_microbatches
         assert next(iter(batch.values())).shape[0] == M
-        streams = [deque(s) for s in all_instructions(S, M)]
         placed, _ = self._place_batch(batch)
 
         acts: dict[tuple[int, int], Any] = {}    # (stage, mb) -> input act
@@ -467,13 +588,6 @@ class PipelineInstance:
                 else:
                     grads[li] = g
 
-        def ready(ins: Instruction) -> bool:
-            if ins.op == Op.RECV_ACTIVATION:
-                return (ins.stage, ins.microbatch) in acts
-            if ins.op == Op.RECV_GRAD:
-                return (ins.stage, ins.microbatch) in gacts
-            return True
-
         def execute(ins: Instruction) -> None:
             st = self.stages[ins.stage]
             m = ins.microbatch
@@ -481,9 +595,12 @@ class PipelineInstance:
             is_first = ins.stage == 0
             is_last = ins.stage == S - 1
             stage_batch = placed[ins.stage]
-            if ins.op in (Op.LOAD_MICROBATCH, Op.RECV_ACTIVATION):
-                pass  # inputs materialize at FORWARD
+            if ins.op in (Op.LOAD_MICROBATCH, Op.RECV_ACTIVATION,
+                          Op.RECV_GRAD):
+                pass  # inputs materialize at FORWARD / BACKWARD
             elif ins.op == Op.FORWARD:
+                if not st.is_local:
+                    return
                 x = None if is_first else acts[key]
                 mb = stage_batch[m] if stage_batch is not None else None
                 out = st.fwd(params_of(st), x, mb)
@@ -494,9 +611,15 @@ class PipelineInstance:
                     stash[(ins.stage, m, "out")] = out
             elif ins.op == Op.SEND_ACTIVATION:
                 nxt = self.stages[ins.stage + 1]
-                y = stash.pop((ins.stage, m, "out"))
-                acts[(ins.stage + 1, m)] = jax.device_put(y, nxt.batch_sharding)
+                if not (st.is_local or nxt.is_local):
+                    return
+                y = stash.pop((ins.stage, m, "out"), None)
+                moved = self._move_edge(y, st, nxt, aval_stage=ins.stage)
+                if moved is not None:
+                    acts[(ins.stage + 1, m)] = moved
             elif ins.op == Op.BACKWARD:
+                if not st.is_local:
+                    return
                 x = stash.pop(key)
                 mb = stage_batch[m] if stage_batch is not None else None
                 if is_last:
@@ -510,27 +633,22 @@ class PipelineInstance:
                 acts.pop(key, None)
             elif ins.op == Op.SEND_GRAD:
                 prev = self.stages[ins.stage - 1]
-                dx = stash.pop((ins.stage, m, "dx"))
-                gacts[(ins.stage - 1, m)] = jax.device_put(
-                    dx, prev.batch_sharding
-                )
-            elif ins.op == Op.RECV_GRAD:
-                pass
+                if not (st.is_local or prev.is_local):
+                    return
+                dx = stash.pop((ins.stage, m, "dx"), None)
+                moved = self._move_edge(dx, st, prev,
+                                        aval_stage=ins.stage - 1)
+                if moved is not None:
+                    gacts[(ins.stage - 1, m)] = moved
 
-        # Dependency-driven interpretation of the 1F1B streams.
-        progress = True
-        while any(streams):
-            if not progress:
-                pending = [(s[0].op, s[0].stage, s[0].microbatch)
-                           for s in streams if s]
-                raise RuntimeError(f"pipeline schedule deadlock: {pending}")
-            progress = False
-            for q in streams:
-                while q and ready(q[0]):
-                    execute(q.popleft())
-                    progress = True
+        # Execute the canonical total order (identical on every process;
+        # dependency-valid by construction — see canonical_order).
+        for ins in canonical_order(S, M):
+            execute(ins)
 
         self.grads = grads
+        if not losses:
+            return None  # last stage lives on another process
         loss = sum(losses[1:], start=losses[0]) / len(losses)
         return loss
 
@@ -547,15 +665,25 @@ class PipelineInstance:
             x = None
             for st in self.stages:
                 is_last = st.stage_index == S - 1
-                stage_batch = placed[st.stage_index]
-                mb = stage_batch[m] if stage_batch is not None else None
-                out = st.fwd(tuple(self.params[li] for li in st.layer_ids),
-                             x, mb)
+                out = None
+                if st.is_local:
+                    stage_batch = placed[st.stage_index]
+                    mb = stage_batch[m] if stage_batch is not None else None
+                    out = st.fwd(
+                        tuple(self.params[li] for li in st.layer_ids), x, mb
+                    )
                 if is_last:
-                    losses.append(out)
+                    if st.is_local:
+                        losses.append(out)
                 else:
                     nxt = self.stages[st.stage_index + 1]
-                    x = jax.device_put(out, nxt.batch_sharding)
+                    if st.is_local or nxt.is_local:
+                        x = self._move_edge(out, st, nxt,
+                                            aval_stage=st.stage_index)
+                    else:
+                        x = None
+        if not losses:
+            return None  # last stage lives on another process
         return sum(losses[1:], start=losses[0]) / len(losses)
 
     def apply_updates(self, optimizer, opt_state: dict[int, Any],

@@ -1,0 +1,232 @@
+"""Cross-process device collectives for the multi-host MPMD path.
+
+The reference syncs heterogeneous pipelines across nodes with NCCL process
+groups (/root/reference/oobleck/execution/engine.py:363-412, per-(layer,
+shard) allreduce; pipeline.py:582-617, node-spanning p2p). The TPU-native
+equivalent here: every worker joins ONE jax.distributed world, and all
+cross-host data-plane traffic rides XLA collectives compiled over small
+"process meshes" — one device per participating process — so on real
+hardware the bytes move over ICI/DCN, never through the control plane
+(which the round-3 GRAD_SYNC TCP relay violated; deleted in favor of this).
+
+Three primitives, all built on the same mechanism
+(`jax.make_array_from_single_device_arrays` over a process mesh + a jitted
+reduction with replicated out_sharding):
+
+  * `group_sum`:   sum of per-process f32 vectors over any process subset —
+                   the grand DP gradient allreduce (all processes) and
+                   point-to-point activation transfer (2 processes, receiver
+                   contributes zeros) are both this;
+  * `group_min`:   element-wise min — used as a "lowest owner" election for
+                   layer-state recovery (each process votes its process
+                   index where it holds a layer, +inf elsewhere);
+  * flat pack/unpack helpers with a deterministic per-layer layout shared by
+    every process (layouts derive from model avals, so no metadata protocol
+    is needed — shapes are static, as everywhere else on TPU).
+
+Every participating process MUST call the same primitive with the same
+(participants, length) in the same relative order; the engine guarantees
+this by having every process interpret the same global schedule.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Sequence
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+
+class ProcessComm:
+    """Collectives over jax.distributed processes (cached meshes + jits)."""
+
+    def __init__(self):
+        self._mesh_cache: dict[tuple[int, ...], Mesh] = {}
+        self._jit_cache: dict[tuple, Any] = {}
+        self._local_device = jax.local_devices()[0]
+        self.process_index = jax.process_index()
+        self.process_count = jax.process_count()
+
+    # -- process meshes ------------------------------------------------- #
+
+    def _mesh(self, participants: tuple[int, ...]) -> Mesh:
+        if participants not in self._mesh_cache:
+            devs = jax.devices()
+            picked = [
+                min((d for d in devs if d.process_index == p),
+                    key=lambda d: d.id)
+                for p in participants
+            ]
+            self._mesh_cache[participants] = Mesh(np.array(picked), ("proc",))
+        return self._mesh_cache[participants]
+
+    def _reduce_device(self, local_vec, length: int,
+                       participants: Sequence[int], op: str):
+        """Shared machinery: stack per-process rows, reduce over `proc`.
+        Accepts a host OR device f32 vector; returns the reduced vector as
+        a DEVICE array on this process's local device (no host round-trip
+        on the receive side)."""
+        participants = tuple(sorted(participants))
+        assert self.process_index in participants, (
+            f"process {self.process_index} is not in {participants}"
+        )
+        if len(participants) == 1:
+            return jax.device_put(
+                jnp.asarray(local_vec, jnp.float32), self._local_device
+            )
+        mesh = self._mesh(participants)
+        n = len(participants)
+        sharding = NamedSharding(mesh, P("proc"))
+        row = jax.device_put(
+            jnp.asarray(local_vec, jnp.float32)[None, :], self._local_device
+        )
+        garr = jax.make_array_from_single_device_arrays(
+            (n, length), sharding, [row]
+        )
+        key = (participants, n, length, op)
+        if key not in self._jit_cache:
+            fn = {"sum": lambda a: a.sum(0), "min": lambda a: a.min(0)}[op]
+            self._jit_cache[key] = jax.jit(
+                fn, out_shardings=NamedSharding(mesh, P())
+            )
+        out = self._jit_cache[key](garr)
+        return out.addressable_data(0)
+
+    # -- public primitives ---------------------------------------------- #
+
+    def group_sum(self, local_vec, length: int,
+                  participants: Sequence[int]) -> np.ndarray:
+        """Element-wise sum of each participant's f32 vector (all get it)."""
+        return np.asarray(
+            self._reduce_device(local_vec, length, participants, "sum")
+        )
+
+    def group_min(self, local_vec, length: int,
+                  participants: Sequence[int]) -> np.ndarray:
+        return np.asarray(
+            self._reduce_device(local_vec, length, participants, "min")
+        )
+
+    def send(self, value, src: int, dst: int, aval):
+        """Point-to-point: move the pytree `value` (on src) to dst; returns
+        it on dst (leaves on this process's local device), None on src.
+        Compiles to a 2-process collective — the multi-host analog of the
+        reference's stage-to-stage NCCL p2p (pipeline.py:288-333). `aval`
+        is the static pytree of ShapeDtypeStructs (tuple carries — T5
+        bridge, CLIP towers — flatten like any pytree); pack/unpack run on
+        device, so the bytes never stage through host numpy."""
+        leaf_avals = jax.tree.leaves(aval)
+        struct = jax.tree.structure(aval)
+        sizes = [int(np.prod(l.shape)) if l.shape else 1 for l in leaf_avals]
+        size = sum(sizes)
+        if self.process_index == src:
+            # Consolidate onto the local proc-mesh device (D2D within the
+            # host), then fuse ravel/cast/concat in one jitted program.
+            leaves = jax.device_put(
+                jax.tree.leaves(value),
+                jax.sharding.SingleDeviceSharding(self._local_device),
+            )
+            key = ("pack", tuple((l.shape, str(l.dtype)) for l in leaf_avals))
+            if key not in self._jit_cache:
+                self._jit_cache[key] = jax.jit(lambda ls: jnp.concatenate(
+                    [l.ravel().astype(jnp.float32) for l in ls]
+                ))
+            flat = self._jit_cache[key](leaves)
+        else:
+            flat = jnp.zeros(size, jnp.float32)
+        total = self._reduce_device(flat, size, (src, dst), "sum")
+        if self.process_index == src:
+            return None
+        key = ("unpack", tuple((l.shape, str(l.dtype)) for l in leaf_avals))
+        if key not in self._jit_cache:
+            def unpack(f):
+                out, off = [], 0
+                for l, n in zip(leaf_avals, sizes):
+                    out.append(f[off:off + n].reshape(l.shape)
+                               .astype(l.dtype))
+                    off += n
+                return out
+            self._jit_cache[key] = jax.jit(unpack)
+        return jax.tree.unflatten(struct, self._jit_cache[key](total))
+
+
+# ---------------------------------------------------------------------- #
+# Flat layouts for layer-keyed pytrees.
+
+
+class FlatLayout:
+    """Deterministic f32 flat layout for a {layer_index: pytree} mapping,
+    derived from abstract shapes only — every process computes the identical
+    layout without communicating (static shapes, the TPU discipline)."""
+
+    def __init__(self, avals_by_layer: dict[int, Any], extra: int = 0):
+        self.layers = sorted(avals_by_layer)
+        self.slices: dict[int, tuple[int, int]] = {}
+        self.structs: dict[int, Any] = {}
+        self.leaf_metas: dict[int, list] = {}
+        off = 0
+        for li in self.layers:
+            leaves, struct = jax.tree.flatten(avals_by_layer[li])
+            metas = [(tuple(l.shape), l.dtype) for l in leaves]
+            size = sum(int(np.prod(s)) if s else 1 for s, _ in metas)
+            self.slices[li] = (off, size)
+            self.structs[li] = struct
+            self.leaf_metas[li] = metas
+            off += size
+        self.param_length = off
+        self.extra = extra
+        self.length = off + extra
+
+    def pack_into(self, buf: np.ndarray, li: int, tree) -> None:
+        off, size = self.slices[li]
+        flat = np.concatenate([
+            np.asarray(jax.device_get(l), np.float32).reshape(-1)
+            for l in jax.tree.leaves(tree)
+        ]) if jax.tree.leaves(tree) else np.zeros(0, np.float32)
+        assert flat.shape[0] == size, (li, flat.shape, size)
+        buf[off:off + size] += flat
+
+    def unpack(self, buf: np.ndarray, li: int):
+        off, _ = self.slices[li]
+        leaves = []
+        for shape, dtype in self.leaf_metas[li]:
+            n = int(np.prod(shape)) if shape else 1
+            leaves.append(buf[off:off + n].reshape(shape).astype(dtype))
+            off += n
+        return jax.tree.unflatten(self.structs[li], leaves)
+
+
+def layer_avals(model) -> dict[int, Any]:
+    """Abstract param trees per pipeline layer (no device use)."""
+    rng = jax.random.PRNGKey(0)
+    return {
+        li: jax.eval_shape(lambda r, _li=li: model.init_layer(r, _li), rng)
+        for li in range(model.num_pipeline_layers)
+    }
+
+
+def activation_avals(model, microbatch_size: int, seq_len: int) -> list:
+    """Abstract activation (carry) aval AFTER each non-final layer, chained
+    through jax.eval_shape — the static shape contract for cross-host
+    stage-to-stage transfers (no metadata handshake, unlike the reference's
+    first-transfer header protocol, pipeline.py:288-333)."""
+    avals = layer_avals(model)
+    batch = jax.tree.map(
+        lambda a: jax.ShapeDtypeStruct(np.shape(a), np.asarray(a).dtype),
+        model.sample_batch(microbatch_size, seq_len),
+    )
+    out: list = []
+
+    def step(li, carry):
+        return jax.eval_shape(
+            lambda p, c, b: model.apply_layer(li, p, c, b),
+            avals[li], carry, batch,
+        )
+
+    carry = None
+    for li in range(model.num_pipeline_layers - 1):
+        carry = step(li, carry)
+        out.append(carry)
+    return out

@@ -1,0 +1,159 @@
+"""Driver for the multi-process MPMD exactness test (not a test module).
+
+Runs TWO hand-built heterogeneous pipelines over gpt2-tiny:
+
+  * pipeline A: 4 chips, 2 stages (layers 0-2 / 3-5) — spans hosts 0 and 1;
+  * pipeline B: 2 chips, 1 stage — host 2;
+
+either inside a 3-process jax.distributed world (`--proc I --nproc 3`,
+cross-host edges + flat DP allreduce over parallel/cross_host) or
+single-controller (`--proc -1`, 6 local devices, in-process DP engine).
+Both modes consume identical deterministic batches and write final params +
+per-step losses to --out; the test asserts they match bit-for-tolerance —
+the "gradient-exact vs the single-controller run" bar from the round-3
+verdict (multi-host MPMD, reference pipelines spanning nodes,
+/root/reference/oobleck/execution/pipeline.py:582-617).
+"""
+
+from __future__ import annotations
+
+import argparse
+import os
+
+
+def main() -> None:
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--proc", type=int, required=True)
+    ap.add_argument("--nproc", type=int, default=3)
+    ap.add_argument("--port", type=int, default=0)
+    ap.add_argument("--out", required=True)
+    ap.add_argument("--steps", type=int, default=2)
+    args = ap.parse_args()
+
+    multihost = args.proc >= 0
+    os.environ["JAX_PLATFORMS"] = "cpu"
+    os.environ["PALLAS_AXON_POOL_IPS"] = ""
+    os.environ["XLA_FLAGS"] = (
+        "--xla_force_host_platform_device_count="
+        + ("2" if multihost else "6")
+    )
+
+    import jax
+    import numpy as np
+
+    if multihost:
+        jax.distributed.initialize(
+            f"127.0.0.1:{args.port}", num_processes=args.nproc,
+            process_id=args.proc,
+        )
+
+    from oobleck_tpu.execution.engine import (
+        DataParallelEngine,
+        MultiHostDataParallelEngine,
+    )
+    from oobleck_tpu.execution.pipeline import PipelineInstance
+    from oobleck_tpu.models import build_model
+    from oobleck_tpu.parallel.train import make_optimizer
+    from oobleck_tpu.planning.templates import PipelineTemplate, StageSpec
+
+    SEQ, MB = 32, 2
+    model = build_model("gpt2-tiny")
+    nl = model.num_pipeline_layers  # 6 for gpt2-tiny (embed, 4 blocks, head)
+
+    def stage(lo, hi, chips):
+        return StageSpec(layer_indices=tuple(range(lo, hi)), num_chips=chips,
+                        forward=1.0, backward=3.0, mem_required=1 << 20)
+
+    tmpl_a = PipelineTemplate(
+        stages=(stage(0, nl // 2, 2), stage(nl // 2, nl, 2)),
+        iteration_time=8.0, num_layers=nl, num_hosts=2, chips_per_host=2,
+    )
+    tmpl_b = PipelineTemplate(
+        stages=(stage(0, nl, 2),),
+        iteration_time=8.0, num_layers=nl, num_hosts=1, chips_per_host=2,
+    )
+
+    if multihost:
+        from oobleck_tpu.parallel.cross_host import ProcessComm
+
+        comm = ProcessComm()
+        per_host = [
+            sorted((d for d in jax.devices() if d.process_index == p),
+                   key=lambda d: d.id)
+            for p in range(args.nproc)
+        ]
+        devices = [d for l in per_host for d in l]
+        process_of_rank = [r // 2 for r in range(6)]
+    else:
+        comm = None
+        devices = jax.devices()[:6]
+        process_of_rank = None
+
+    common = dict(
+        model=model, devices=devices, total_num_microbatches=4,
+        microbatch_size=MB, seq_len=SEQ, exec_cache={},
+        process_of_rank=process_of_rank, comm=comm,
+    )
+    pipe_a = PipelineInstance(pipeline_id=0, template=tmpl_a,
+                              ranks=[0, 1, 2, 3], num_microbatches=2, **common)
+    pipe_b = PipelineInstance(pipeline_id=1, template=tmpl_b,
+                              ranks=[4, 5], num_microbatches=2, **common)
+    pipelines = [pipe_a, pipe_b]
+
+    optimizer = make_optimizer(learning_rate=1e-3, warmup_steps=1)
+    opt_states = {p.pipeline_id: p.init_opt_state(optimizer)
+                  for p in pipelines}
+    dp = (MultiHostDataParallelEngine(pipelines, model, comm)
+          if multihost else DataParallelEngine(pipelines))
+
+    def batch_for(step: int, pipe_id: int, num_mb: int) -> np.ndarray:
+        rs = np.random.RandomState(1000 * step + pipe_id)
+        return rs.randint(0, model.config.vocab_size,
+                          size=(num_mb, MB, SEQ)).astype(np.int32)
+
+    losses = []
+    for step in range(args.steps):
+        if multihost:
+            local_losses = {}
+            for p in pipelines:
+                b = batch_for(step, p.pipeline_id, p.num_microbatches)
+                if not p.participates_locally:
+                    continue
+                loss = p.train_step(b)
+                if loss is not None:
+                    local_losses[p.pipeline_id] = (float(loss),
+                                                   p.num_microbatches)
+            synced, global_loss = dp.allreduce(local_losses)
+            for p in pipelines:
+                if p.participates_locally:
+                    opt_states[p.pipeline_id] = p.apply_updates(
+                        optimizer, opt_states[p.pipeline_id],
+                        synced[p.pipeline_id],
+                    )
+            losses.append(global_loss)
+        else:
+            per = []
+            for p in pipelines:
+                b = batch_for(step, p.pipeline_id, p.num_microbatches)
+                per.append((float(p.train_step(b)), p.num_microbatches))
+            synced = dp.do_allreduce()
+            for p in pipelines:
+                opt_states[p.pipeline_id] = p.apply_updates(
+                    optimizer, opt_states[p.pipeline_id], synced[p.pipeline_id],
+                )
+            losses.append(sum(l * w for l, w in per)
+                          / sum(w for _, w in per))
+
+    out = {"losses": np.asarray(losses, np.float64)}
+    for p in pipelines:
+        for li, tree in p.params.items():
+            for i, leaf in enumerate(jax.tree.leaves(tree)):
+                out[f"pipe{p.pipeline_id}_l{li}_{i}"] = np.asarray(
+                    jax.device_get(leaf), np.float32
+                )
+    np.savez(args.out, **out)
+    print(f"driver proc={args.proc} done: losses={losses}", flush=True)
+
+
+if __name__ == "__main__":
+    main()
