@@ -150,9 +150,31 @@ def _measure() -> dict:
         "unit": "tokens/s/chip",
         "vs_baseline": round(vs, 3),
     }
+    # Achieved FLOP/s and MFU next to raw tokens/s: 6N per token for the
+    # matmuls (fwd+bwd) + the causal attention term.
+    n_params = sum(l.size for l in jax.tree.leaves(state.params))
+    cfg = model.config
+    flops_per_token = 6 * n_params + 6 * (
+        getattr(cfg, "num_layers", 0) * getattr(cfg, "hidden_size", 0) * seq
+    )
+    achieved = flops_per_token * tps_per_chip  # per chip
+    result["tflops_per_chip"] = round(achieved / 1e12, 2)
+    peak = _peak_flops(jax.devices()[0].device_kind) if platform == "tpu" else None
+    if peak:
+        result["mfu"] = round(achieved / peak, 4)
     if platform != "tpu":
         result["platform"] = platform
     return result
+
+
+def _peak_flops(device_kind: str) -> float | None:
+    """Peak bf16 FLOP/s per chip by TPU generation (public specs)."""
+    kind = device_kind.lower()
+    for tag, peak in (("v5 lite", 197e12), ("v5e", 197e12),
+                      ("v5p", 459e12), ("v6", 918e12), ("v4", 275e12)):
+        if tag in kind:
+            return peak
+    return None
 
 
 def _cpu_proxy_env() -> dict:
@@ -214,6 +236,10 @@ def main() -> None:
             "value": last_good,
             "unit": "tokens/s/chip",
             "vs_baseline": 1.0,
+            # Machine-readable staleness: consumers parsing only
+            # value/vs_baseline must not mistake a replayed number for a
+            # fresh measurement (round-3 advisor finding).
+            "stale": True,
             "note": (
                 "TPU unreachable this run ("
                 + "; ".join(reasons)
@@ -246,6 +272,7 @@ if __name__ == "__main__":
             "value": base.get("tokens_per_sec_per_chip", 0),
             "unit": "tokens/s/chip",
             "vs_baseline": 1.0 if base else 0,
+            "stale": True,
             "note": f"bench harness crashed ({type(exc).__name__}: {exc}); "
                     "value is the last good TPU measurement" if base else
                     f"bench harness crashed ({type(exc).__name__}: {exc})",

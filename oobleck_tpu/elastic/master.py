@@ -22,9 +22,11 @@ from __future__ import annotations
 
 import asyncio
 import logging
+import os
 import shutil
 import subprocess
 import sys
+import time
 from dataclasses import dataclass, field
 
 from oobleck_tpu.config import OobleckArguments
@@ -68,13 +70,37 @@ class LocalLauncher:
 
 class SSHLauncher:
     """Launch agents over ssh (reference run_node_agents, master.py:60-91,
-    which uses asyncssh + conda; here: the system ssh client)."""
+    which uses asyncssh + conda; here: the system ssh client). Each agent's
+    combined stdout/stderr streams to a per-host log file under
+    {log_dir}/{timestamp}-{model}/{ip}.out (reference master.py:79-91) —
+    DEVNULLing them would make remote worker crashes invisible."""
 
-    def __init__(self, username: str | None, node_port: int = 22):
+    def __init__(self, username: str | None, node_port: int = 22,
+                 log_dir: str | None = None):
+        import tempfile
+
         self.username = username
         self.node_port = node_port
+        self.log_dir = log_dir or os.path.join(
+            tempfile.gettempdir(), "oobleck_tpu", "logs"
+        )
+        self._job_dir: str | None = None
         if shutil.which("ssh") is None:
             raise RuntimeError("no ssh client available; use LocalLauncher")
+
+    def start_job(self, args: OobleckArguments) -> None:
+        """New per-job log directory; the master calls this at LAUNCH_JOB so
+        a long-lived daemon never mixes two jobs' logs into one dir."""
+        ts = time.strftime("%Y%m%d-%H%M%S")
+        self._job_dir = os.path.join(
+            self.log_dir, f"{ts}-{args.model.model_name}"
+        )
+        os.makedirs(self._job_dir, exist_ok=True)
+
+    def _log_path(self, ip: str, args: OobleckArguments) -> str:
+        if self._job_dir is None:
+            self.start_job(args)
+        return os.path.join(self._job_dir, f"{ip}.out")
 
     async def launch(self, ip: str, master_ip: str, master_port: int,
                      args: OobleckArguments) -> None:
@@ -84,11 +110,17 @@ class SSHLauncher:
             f"--master-ip {master_ip} --master-port {master_port} "
             f"--agent-ip {ip}"
         )
-        proc = await asyncio.create_subprocess_exec(
-            "ssh", "-p", str(self.node_port), target, cmd,
-            stdout=asyncio.subprocess.DEVNULL, stderr=asyncio.subprocess.DEVNULL,
-        )
-        logger.info("launched agent on %s (ssh pid %s)", ip, proc.pid)
+        path = self._log_path(ip, args)
+        logf = open(path, "ab")
+        try:
+            proc = await asyncio.create_subprocess_exec(
+                "ssh", "-p", str(self.node_port), target, cmd,
+                stdout=logf, stderr=asyncio.subprocess.STDOUT,
+            )
+        finally:
+            logf.close()  # the child holds its own descriptor
+        logger.info("launched agent on %s (ssh pid %s, log %s)",
+                    ip, proc.pid, path)
 
 
 class OobleckMasterDaemon:
@@ -167,6 +199,8 @@ class OobleckMasterDaemon:
         self.job = args
         self._pending_ips = list(args.dist.node_ips)
         await send_response(writer, ResponseType.SUCCESS)
+        if self.launcher is not None and hasattr(self.launcher, "start_job"):
+            self.launcher.start_job(args)
         if self.launcher is not None:
             for ip in args.dist.node_ips:
                 for _ in range(args.dist.num_agents_per_node):
@@ -262,8 +296,13 @@ class OobleckMasterDaemon:
                 pass
 
 
-async def _amain(port: int) -> None:
-    daemon = OobleckMasterDaemon(port=port, launcher=LocalLauncher())
+async def _amain(port: int, launcher: str, username: str | None,
+                 node_port: int, log_dir: str | None) -> None:
+    if launcher == "ssh":
+        l = SSHLauncher(username, node_port=node_port, log_dir=log_dir)
+    else:
+        l = LocalLauncher()
+    daemon = OobleckMasterDaemon(port=port, launcher=l)
     await daemon.start()
     await daemon.serve_forever()
 
@@ -273,5 +312,12 @@ if __name__ == "__main__":
 
     p = argparse.ArgumentParser()
     p.add_argument("--port", type=int, default=19191)
+    p.add_argument("--launcher", choices=["local", "ssh"], default="local",
+                   help="ssh: one agent per host over ssh, with per-host "
+                        "log capture; local: subprocesses (single machine)")
+    p.add_argument("--username", default=None)
+    p.add_argument("--node-port", type=int, default=22)
+    p.add_argument("--log-dir", default=None)
+    a = p.parse_args()
     logging.basicConfig(level=logging.INFO)
-    asyncio.run(_amain(p.parse_args().port))
+    asyncio.run(_amain(a.port, a.launcher, a.username, a.node_port, a.log_dir))

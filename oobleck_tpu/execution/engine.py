@@ -36,7 +36,11 @@ import numpy as np
 from jax.sharding import NamedSharding
 
 from oobleck_tpu.config import OobleckArguments
-from oobleck_tpu.execution.dataloader import OobleckDataLoader, OobleckSampler
+from oobleck_tpu.execution.dataloader import (
+    OobleckDataLoader,
+    OobleckSampler,
+    PrefetchingLoader,
+)
 from oobleck_tpu.execution.dataset import build_dataset
 from oobleck_tpu.execution.pipeline import PipelineInstance
 from oobleck_tpu.execution.reconfigure import (
@@ -276,25 +280,99 @@ class MultiHostDataParallelEngine:
         # 2 extra slots per pipeline: [weight * loss, weight].
         self.layout = FlatLayout(layer_avals(model),
                                  extra=2 * len(pipelines))
+        self._jit_cache: dict = {}
         self.last_transfer_count = 0
+
+    def _pack_device(self, loss_vec: np.ndarray):
+        """One device-resident flat contribution vector: local grad leaves
+        are consolidated onto the local proc-mesh device (D2D) and a single
+        jitted program ravels/casts/sums/concats them into layout order —
+        no host staging on the step critical path."""
+        per_layer: dict[int, list] = {}
+        for pipe in self.pipelines:
+            for li in sorted(pipe.grads):
+                per_layer.setdefault(li, []).append(pipe.grads[li])
+        metas: list[tuple[int, list[int]]] = []
+        all_leaves: list = []
+        sig: list = []
+        for li in self.layout.layers:
+            counts = []
+            for tree in per_layer.get(li, []):
+                leaves = jax.tree.leaves(tree)
+                counts.append(len(leaves))
+                all_leaves.extend(leaves)
+                sig.append((li, tuple((l.shape, str(l.dtype))
+                                      for l in leaves)))
+            metas.append((li, counts))
+        if all_leaves:
+            all_leaves = jax.device_put(
+                all_leaves, self.comm.local_device_sharding
+            )
+        key = ("pack", tuple(sig))
+        if key not in self._jit_cache:
+            layout = self.layout
+
+            def pack(leaves, losses):
+                segs = []
+                it = iter(leaves)
+                for li, counts in metas:
+                    size = layout.slices[li][1]
+                    if not counts:
+                        segs.append(jnp.zeros(size, jnp.float32))
+                        continue
+                    acc = None
+                    for n in counts:
+                        part = jnp.concatenate([
+                            jnp.ravel(next(it)).astype(jnp.float32)
+                            for _ in range(n)
+                        ])
+                        acc = part if acc is None else acc + part
+                    segs.append(acc)
+                segs.append(losses)
+                return jnp.concatenate(segs)
+
+            self._jit_cache[key] = jax.jit(pack)
+        return self._jit_cache[key](
+            all_leaves, jnp.asarray(loss_vec, jnp.float32)
+        )
+
+    def _unpack_layer_device(self, total, li: int):
+        """Slice one layer's grad tree out of the reduced vector, on the
+        local device (the subsequent device_put to the stage sharding is a
+        D2D placement)."""
+        key = ("unpack", li)
+        if key not in self._jit_cache:
+            layout = self.layout
+            off0, _ = layout.slices[li]
+            lm = layout.leaf_metas[li]
+            struct = layout.structs[li]
+
+            def unpack(f):
+                out, off = [], off0
+                for shape, dtype in lm:
+                    n = int(np.prod(shape)) if shape else 1
+                    out.append(f[off:off + n].reshape(shape).astype(dtype))
+                    off += n
+                return jax.tree.unflatten(struct, out)
+
+            self._jit_cache[key] = jax.jit(unpack)
+        return self._jit_cache[key](total)
 
     def allreduce(self, local_losses: dict[int, tuple[float, int]]
                   ) -> tuple[dict[int, dict[int, Any]], float]:
         """local_losses: {pipeline_id: (loss, weight)} for pipelines whose
         last stage is local. Returns ({pipeline_id: {layer: summed grads}}
         for LOCAL (pipeline, layer) pairs, global weighted mean loss)."""
-        buf = np.zeros(self.layout.length, np.float32)
-        for pipe in self.pipelines:
-            for li, g in pipe.grads.items():
-                self.layout.pack_into(buf, li, g)
         base = self.layout.param_length
+        loss_vec = np.zeros(2 * len(self.pipelines), np.float32)
         for i, pipe in enumerate(self.pipelines):
             if pipe.pipeline_id in local_losses:
                 loss, weight = local_losses[pipe.pipeline_id]
-                buf[base + 2 * i] += float(loss) * weight
-                buf[base + 2 * i + 1] += weight
-        total = self.comm.group_sum(
-            buf, self.layout.length, range(self.comm.process_count)
+                loss_vec[2 * i] = float(loss) * weight
+                loss_vec[2 * i + 1] = weight
+        flat = self._pack_device(loss_vec)
+        total = self.comm.group_sum_device(
+            flat, self.layout.length, range(self.comm.process_count)
         )
         self.last_transfer_count = 1
         synced: dict[int, dict[int, Any]] = {}
@@ -303,13 +381,14 @@ class MultiHostDataParallelEngine:
                 continue
             synced[pipe.pipeline_id] = {
                 li: jax.device_put(
-                    self.layout.unpack(total, li),
+                    self._unpack_layer_device(total, li),
                     pipe.stages[pipe.stage_of_layer(li)].param_shardings[li],
                 )
                 for li in pipe.params
             }
-        wl = total[base::2][:len(self.pipelines)].sum()
-        w = total[base + 1::2][:len(self.pipelines)].sum()
+        tail = np.asarray(total[base:])  # 2 floats/pipeline: tiny readback
+        wl = tail[0::2].sum()
+        w = tail[1::2].sum()
         return synced, float(wl / w) if w else float("nan")
 
 
@@ -737,6 +816,7 @@ class OobleckEngine:
                 f"seq_len={self.seq_len} not divisible by "
                 f"sequence_parallel={ex.sequence_parallel}"
             )
+        hidden = int(getattr(self.model.config, "hidden_size", 0) or 0)
         if ex.fsdp > 0:
             fsdp = ex.fsdp
             data = len(devices) // (base * fsdp)
@@ -746,29 +826,78 @@ class OobleckEngine:
                     f"stage*tensor*seq*fsdp={base * fsdp}"
                 )
         else:
-            fsdp = len(devices) // base
-            data = 1
-        if mb % (data * fsdp) != 0:
-            if not shrink_to_fit:
+            # Free fsdp: maximize chips used subject to BOTH divisibility
+            # constraints (batch dim over data*fsdp, hidden dim over fsdp),
+            # preferring larger fsdp (ZeRO memory savings) on ties. The old
+            # "fsdp = all remaining chips" choice produced XLA sharding
+            # errors whenever hidden_size wasn't divisible by the remainder.
+            data, fsdp = _best_data_fsdp(len(devices) // base, mb, hidden)
+            if not shrink_to_fit and data * fsdp * base < len(devices):
+                # A config that strands chips must stay a LOUD startup
+                # error (recovery is the only time quietly dropping chips
+                # beats crashing the run it exists to save).
                 raise ValueError(
-                    f"microbatch_size={mb} not divisible by data*fsdp="
-                    f"{data * fsdp}: the fused path shards each microbatch's "
-                    "sample dim over (data, fsdp); raise microbatch_size or "
-                    "pin more devices to stage/tensor/seq via "
-                    "ExecutionArguments"
+                    f"no (data, fsdp) split uses all {len(devices)} devices: "
+                    f"best uses {data * fsdp * base} "
+                    f"(microbatch_size={mb} must divide by data*fsdp and "
+                    f"hidden_size={hidden} by fsdp); adjust microbatch_size "
+                    "or pin stage/tensor/seq via ExecutionArguments"
                 )
-            d = next((d for d in range(data, 0, -1)
-                      if mb % (d * fsdp) == 0), 0)
-            if d:
-                data = d
-            elif ex.fsdp <= 0:
-                fsdp = next(f for f in range(fsdp, 0, -1) if mb % f == 0)
-                data = 1
-            else:
+        if mb % (data * fsdp) != 0 and not shrink_to_fit:
+            raise ValueError(
+                f"microbatch_size={mb} not divisible by data*fsdp="
+                f"{data * fsdp}: the fused path shards each microbatch's "
+                "sample dim over (data, fsdp); raise microbatch_size or "
+                "pin more devices to stage/tensor/seq via "
+                "ExecutionArguments"
+            )
+        if shrink_to_fit and (
+            mb % (data * fsdp) != 0 or data * fsdp * base < len(devices)
+        ):
+            # Recovery re-plan: instead of only shrinking `data` (which can
+            # strand chips, round-3 weak #7), search every feasible
+            # (stage, fsdp, data) — stage must divide the model's blocks AND
+            # the microbatch count; data*fsdp must divide microbatch_size —
+            # and keep the one using the MOST surviving chips, preferring
+            # the configured stage count on ties.
+            num_mb = self.fused.num_microbatches if self.fused else 1
+            layers = getattr(self.model.config, "num_layers", stage)
+            best = None
+            for s in range(1, len(devices) // (ex.tensor_parallel
+                                               * ex.sequence_parallel) + 1):
+                if layers % s or num_mb % s:
+                    continue
+                s_base = s * ex.tensor_parallel * ex.sequence_parallel
+                cap = len(devices) // s_base
+                if cap < 1:
+                    continue
+                if ex.fsdp > 0:
+                    if mb % ex.fsdp:
+                        continue
+                    d = next((d for d in range(cap // ex.fsdp, 0, -1)
+                              if mb % (d * ex.fsdp) == 0), 0)
+                    if not d:
+                        continue
+                    cand = (d, ex.fsdp)
+                else:
+                    cand = _best_data_fsdp(cap, mb, hidden)
+                used_chips = cand[0] * cand[1] * s_base
+                rank = (used_chips, s == stage, -abs(s - stage))
+                if best is None or rank > best[0]:
+                    best = (rank, s, cand)
+            if best is None:
                 raise RuntimeError(
-                    f"microbatch_size={mb} not divisible by explicit "
-                    f"fsdp={fsdp}; cannot build a runnable recovery mesh"
+                    f"microbatch_size={mb} admits no runnable recovery mesh "
+                    f"over {len(devices)} devices"
                 )
+            _, new_stage, (data, fsdp) = best
+            if new_stage != stage:
+                logger.warning(
+                    "recovery re-plan: stage %d -> %d to reclaim chips",
+                    stage, new_stage,
+                )
+                stage = new_stage
+                base = stage * ex.tensor_parallel * ex.sequence_parallel
         used = data * fsdp * base
         if used < len(devices):
             logger.warning(
@@ -800,7 +929,9 @@ class OobleckEngine:
             num_iterations_done=num_iterations_done,
             epoch=epoch,
         )
-        self.dataloaders = [OobleckDataLoader(self.dataset, sampler)]
+        self.dataloaders = [
+            PrefetchingLoader(OobleckDataLoader(self.dataset, sampler))
+        ]
         self.pipelines = []
         self.dp_engine = None
 
@@ -816,6 +947,9 @@ class OobleckEngine:
         num_mb_list = [a.num_microbatches for a in assignments]
         total_mb = plan.total_num_microbatches
         self.pipelines = []
+        for old_dl in self.dataloaders:
+            if hasattr(old_dl, "close"):
+                old_dl.close()
         self.dataloaders = []
         self.opt_states = {}
         train_samples = len(self.dataset) - self._eval_reserve()
@@ -852,7 +986,12 @@ class OobleckEngine:
                 num_iterations_done=num_iterations_done,
                 epoch=epoch,
             )
-            self.dataloaders.append(OobleckDataLoader(self.dataset, sampler))
+            loader = OobleckDataLoader(self.dataset, sampler)
+            # Double-buffering only pays where batches are consumed;
+            # non-participating pipelines only track position (advance()).
+            if not self.multihost or pipe.participates_locally:
+                loader = PrefetchingLoader(loader)
+            self.dataloaders.append(loader)
             if old_opt is not None:
                 # Optimizer state mirrors params: re-place each layer's state
                 # on its new stage sharding (surviving state is reused, as the
@@ -891,7 +1030,8 @@ class OobleckEngine:
         weights = []
         with annotate("pipelines"):
             for pipe, dl in zip(self.pipelines, self.dataloaders):
-                batch = dl.next_batch()
+                with annotate("staging"):
+                    batch = dl.next_batch()
                 losses.append(pipe.train_step(batch))
                 weights.append(pipe.num_microbatches)
         with annotate("dp_allreduce"):
@@ -920,12 +1060,14 @@ class OobleckEngine:
         local_losses: dict[int, tuple[float, int]] = {}
         with annotate("pipelines"):
             for pipe, dl in zip(self.pipelines, self.dataloaders):
-                # EVERY process advances EVERY dataloader: samplers are
-                # deterministic, so batch contents agree wherever the
-                # pipeline's batch-consuming stages live.
-                batch = dl.next_batch()
+                # EVERY process advances EVERY sampler in lockstep
+                # (deterministic positions), but only participants pay for
+                # batch materialization — non-owners advance position only.
                 if not pipe.participates_locally:
+                    dl.advance()
                     continue
+                with annotate("staging"):
+                    batch = dl.next_batch()
                 loss = pipe.train_step(batch)
                 if loss is not None:
                     local_losses[pipe.pipeline_id] = (
@@ -1351,6 +1493,8 @@ class OobleckEngine:
             pool = _CyclicView(pool, bucket)
 
         it_done, epoch = self._eval_state
+        correct_sum = 0.0
+        count_sum = 0.0
         samplers = [
             OobleckSampler(
                 num_samples=len(pool),
@@ -1375,6 +1519,9 @@ class OobleckEngine:
                     if self.multihost and not pipe.participates_locally:
                         continue
                     loss = pipe.eval_step(batch)
+                    if pipe.last_eval_metrics is not None:
+                        correct_sum += pipe.last_eval_metrics[0]
+                        count_sum += pipe.last_eval_metrics[1]
                     if loss is None:
                         continue  # last stage lives on another process
                     loss_sum += float(loss) * pipe.num_microbatches
@@ -1382,11 +1529,24 @@ class OobleckEngine:
         self._eval_state = (samplers[0].num_iterations_done, samplers[0].epoch)
         if self.multihost:
             total = self.comm.group_sum(
-                np.asarray([loss_sum, weight_sum], np.float32), 2,
+                np.asarray([loss_sum, weight_sum, correct_sum, count_sum],
+                           np.float32), 4,
                 range(self.comm.process_count),
             )
-            return float(total[0] / total[1])
-        return loss_sum / weight_sum
+            loss_sum, weight_sum = float(total[0]), float(total[1])
+            correct_sum, count_sum = float(total[2]), float(total[3])
+        mean_loss = loss_sum / weight_sum
+        # Task metric alongside the loss (reference builds accuracy via
+        # `evaluate` but never reports it, dataset.py:39-54): reported for
+        # every non-causal-LM family through accuracy_from_logits.
+        self.last_eval_metrics = {"loss": mean_loss}
+        if count_sum > 0:
+            self.last_eval_metrics["accuracy"] = correct_sum / count_sum
+            logger.info("eval loss %.4f accuracy %.4f (%d predictions)",
+                        mean_loss, correct_sum / count_sum, int(count_sum))
+        else:
+            logger.info("eval loss %.4f", mean_loss)
+        return mean_loss
 
     def request_reconfiguration(self, lost_ip: str) -> None:
         with self._lock:
@@ -1551,6 +1711,21 @@ class _TailView:
     def set_epoch(self, epoch: int) -> None:
         if hasattr(self.ds, "set_epoch"):
             self.ds.set_epoch(epoch)
+
+
+def _best_data_fsdp(cap: int, mb: int, hidden: int) -> tuple[int, int]:
+    """Pick (data, fsdp) with data*fsdp <= cap maximizing chips used, s.t.
+    mb % (data*fsdp) == 0 and (when known) hidden % fsdp == 0; ties prefer
+    larger fsdp. (1, 1) always qualifies."""
+    best = (0, 1, 1)  # (used, fsdp, data)
+    for f in range(cap, 0, -1):
+        if hidden and hidden % f:
+            continue
+        d = next((d for d in range(cap // f, 0, -1)
+                  if mb % (d * f) == 0), 0)
+        if d and (d * f > best[0] or (d * f == best[0] and f > best[1])):
+            best = (d * f, f, d)
+    return best[2], best[1]
 
 
 def _scale_template_chips(t: PipelineTemplate, tp: int) -> PipelineTemplate:

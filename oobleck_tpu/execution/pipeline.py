@@ -115,6 +115,7 @@ class StageRuntime:
     is_local: bool = True                  # this process owns the stage
     fwd: Callable | None = None
     bwd: Callable | None = None
+    efwd: Callable | None = None           # eval fwd with task metrics
 
     @property
     def ctx(self):
@@ -323,6 +324,7 @@ class PipelineInstance:
                 self.params[li] = jax.device_put(src, st.param_shardings[li])
 
         self.grads: dict[int, Any] = {}
+        self.last_eval_metrics: tuple[float, float] | None = None
         # Static activation avals for cross-process edges (computed lazily:
         # single-controller runs never need them).
         self._act_avals: list | None = None
@@ -363,12 +365,19 @@ class PipelineInstance:
                     fn = jax.checkpoint(fn)
                 return fn
 
-            def apply(params_tuple, x, batch):
+            def apply(params_tuple, x, batch, with_metrics=False):
                 carry = x
                 for li, p in zip(st.layer_ids, params_tuple):
                     if li == last_layer:
                         logits = model.apply_layer(li, p, carry, batch)
-                        return model.loss_from_logits(logits, batch)
+                        loss = model.loss_from_logits(logits, batch)
+                        if with_metrics:
+                            # Task metric next to the loss (the reference
+                            # builds an accuracy metric the engine never
+                            # reports, dataset.py:39-54 — reported here).
+                            c, n = model.accuracy_from_logits(logits, batch)
+                            return loss, c, n
+                        return loss
                     carry = layer_fn(li)(p, carry, batch)
                 return carry
 
@@ -460,7 +469,7 @@ class PipelineInstance:
                 self.total_num_microbatches, st.tp, st.use_fsdp,
             )
             if key in self._exec_cache:
-                st.fwd, st.bwd = self._exec_cache[key]
+                st.fwd, st.bwd, st.efwd = self._exec_cache[key]
                 continue
             apply = self._stage_apply(st)
             shardings = tuple(st.param_shardings[li] for li in st.layer_ids)
@@ -494,7 +503,13 @@ class PipelineInstance:
 
             st.fwd = jax.jit(fwd)
             st.bwd = jax.jit(bwd)
-            self._exec_cache[key] = (st.fwd, st.bwd)
+            if (is_last and st.ctx is None
+                    and hasattr(self.model, "accuracy_from_logits")):
+                st.efwd = jax.jit(
+                    lambda params_tuple, x, tokens, _apply=apply:
+                    _apply(params_tuple, x, tokens, with_metrics=True)
+                )
+            self._exec_cache[key] = (st.fwd, st.bwd, st.efwd)
 
     # ------------------------------------------------------------------ #
 
@@ -661,6 +676,7 @@ class PipelineInstance:
         S = self.num_stages
         placed, M = self._place_batch(batch)
         losses = []
+        correct = count = None
         for m in range(M):
             x = None
             for st in self.stages:
@@ -669,9 +685,14 @@ class PipelineInstance:
                 if st.is_local:
                     stage_batch = placed[st.stage_index]
                     mb = stage_batch[m] if stage_batch is not None else None
-                    out = st.fwd(
-                        tuple(self.params[li] for li in st.layer_ids), x, mb
-                    )
+                    params = tuple(self.params[li] for li in st.layer_ids)
+                    if is_last and st.efwd is not None:
+                        loss, c, n = st.efwd(params, x, mb)
+                        correct = c if correct is None else correct + c
+                        count = n if count is None else count + n
+                        out = loss
+                    else:
+                        out = st.fwd(params, x, mb)
                 if is_last:
                     if st.is_local:
                         losses.append(out)
@@ -682,6 +703,10 @@ class PipelineInstance:
                                             aval_stage=st.stage_index)
                     else:
                         x = None
+        self.last_eval_metrics = (
+            None if count is None
+            else (float(correct), float(count))
+        )
         if not losses:
             return None  # last stage lives on another process
         return sum(losses[1:], start=losses[0]) / len(losses)

@@ -110,6 +110,14 @@ class BertModel:
         per_pos = (logz - gold) * mask
         return jnp.sum(per_pos) / jnp.maximum(jnp.sum(mask), 1.0)
 
+    def accuracy_from_logits(self, logits, batch):
+        """Masked-token accuracy over the corrupted positions (reference
+        accuracy metric parity, dataset.py:39-54)."""
+        mask = batch["loss_mask"].astype(jnp.float32)
+        pred = jnp.argmax(logits, axis=-1)
+        correct = (pred == batch["labels"]).astype(jnp.float32) * mask
+        return jnp.sum(correct), jnp.sum(mask)
+
     def sample_batch(self, batch_size: int, seq_len: int):
         tokens = jax.random.randint(
             jax.random.PRNGKey(0), (batch_size, seq_len), 0,

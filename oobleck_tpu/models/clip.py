@@ -229,6 +229,14 @@ class CLIPModel:
         diag = logits[targets, targets]
         return 0.5 * (jnp.mean(logz_i - diag) + jnp.mean(logz_t - diag))
 
+    def accuracy_from_logits(self, logits, batch):
+        """In-batch image->text retrieval accuracy: the matching caption is
+        the argmax of each image row (reference accuracy metric parity,
+        dataset.py:39-54)."""
+        n = logits.shape[0]
+        correct = (jnp.argmax(logits, axis=-1) == jnp.arange(n))
+        return jnp.sum(correct.astype(jnp.float32)), jnp.float32(n)
+
     def sample_batch(self, batch_size: int, seq_len: int | None = None):
         c = self.config
         seq = min(seq_len or c.max_position_embeddings,

@@ -191,6 +191,16 @@ class ViTModel:
         )[..., 0]
         return jnp.mean(logz - gold)
 
+
+    def accuracy_from_logits(self, logits, batch):
+        """Task metric for evaluate() (reference builds accuracy via
+        `evaluate`, dataset.py:39-54): (correct_count, total_count)."""
+        import jax.numpy as jnp
+
+        pred = jnp.argmax(logits, axis=-1)
+        correct = (pred == batch["labels"]).astype(jnp.float32)
+        return jnp.sum(correct), jnp.float32(correct.size)
+
     def loss(self, params, batch):
         return self.loss_from_logits(
             self.forward(params, batch["pixel_values"]), batch

@@ -109,6 +109,17 @@ class ProcessComm:
             self._reduce_device(local_vec, length, participants, "min")
         )
 
+    def group_sum_device(self, local_vec, length: int,
+                         participants: Sequence[int]):
+        """group_sum whose input AND output stay device arrays on this
+        process's local device — the hot-path form (per-step gradient
+        allreduce) with no host staging on either side."""
+        return self._reduce_device(local_vec, length, participants, "sum")
+
+    @property
+    def local_device_sharding(self):
+        return jax.sharding.SingleDeviceSharding(self._local_device)
+
     def send(self, value, src: int, dst: int, aval):
         """Point-to-point: move the pytree `value` (on src) to dst; returns
         it on dst (leaves on this process's local device), None on src.

@@ -169,3 +169,38 @@ async def test_coordinator_relay(job_args):
     assert coordinator_address_if_current(relay, world=2) == "10.0.0.1:9999"
     assert coordinator_address_if_current(relay, world=1) is None
     task.cancel()
+
+
+@pytest.mark.asyncio
+async def test_ssh_launcher_captures_per_host_logs(tmp_path, job_args,
+                                                   monkeypatch):
+    """SSHLauncher streams each agent's output to {log_dir}/{ts}-{model}/
+    {ip}.out (reference master.py:79-91) instead of DEVNULLing it."""
+    from oobleck_tpu.elastic.master import SSHLauncher
+
+    captured = {}
+
+    async def fake_exec(*cmd, stdout=None, stderr=None):
+        captured["cmd"] = cmd
+        captured["stdout"] = stdout
+        stdout.write(b"agent says hi\n")
+
+        class P:
+            pid = 4242
+        return P()
+
+    monkeypatch.setattr(asyncio, "create_subprocess_exec", fake_exec)
+    import shutil
+
+    monkeypatch.setattr(shutil, "which", lambda _: "/usr/bin/ssh")
+    launcher = SSHLauncher(username="tpu", node_port=2222,
+                           log_dir=str(tmp_path))
+    await launcher.launch("10.0.0.7", "127.0.0.1", 19191, job_args)
+
+    assert captured["cmd"][0] == "ssh"
+    assert "tpu@10.0.0.7" in captured["cmd"]
+    logs = list(tmp_path.rglob("10.0.0.7.out"))
+    assert len(logs) == 1
+    job_dir = logs[0].parent.name
+    assert job_dir.endswith(f"-{job_args.model.model_name}")
+    assert logs[0].read_bytes() == b"agent says hi\n"

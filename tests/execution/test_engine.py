@@ -366,10 +366,11 @@ def test_dp_allreduce_batched_transfers_and_exactness(trained_engine):
                                        rtol=1e-5, atol=1e-7)
 
 
-def test_fused_recovery_shrink_records_stranded_chips(cache_env, devices8):
-    """shrink_to_fit recovery must account for every surviving chip: the
-    post-recovery mesh size + stranded count == survivors, and the stranded
-    count is a first-class metric (round-2 weak #8)."""
+def test_fused_recovery_replan_reclaims_stranded_chips(cache_env, devices8):
+    """Fused recovery re-plans the mesh instead of only shrinking `data`:
+    a survivor count that doesn't divide the microbatch gets its stage
+    split adjusted so NO chip is stranded (round-3 weak #7 / next #9), and
+    the stranded count stays a first-class accounting metric."""
     from oobleck_tpu.config import ExecutionArguments
 
     args = OobleckArguments(
@@ -397,8 +398,10 @@ def test_fused_recovery_shrink_records_stranded_chips(cache_env, devices8):
     mesh_chips = engine.fused.mesh.devices.size
     assert len(engine.stranded_chips) == 1
     assert mesh_chips + engine.stranded_chips[0] == survivors
-    # mb=6 over 4 survivors: fsdp shrinks to 3 -> 3 used, 1 stranded
-    assert engine.stranded_chips[0] == 1
+    # mb=6 over 4 survivors with stage=1 would shrink fsdp to 3 and strand
+    # a chip; the re-plan switches to stage=2 x fsdp=2 and reclaims all 4.
+    assert engine.stranded_chips[0] == 0
+    assert dict(engine.fused.mesh.shape)["stage"] == 2
     assert np.isfinite(engine._train_step())
 
 

@@ -218,3 +218,43 @@ def test_t5_layerwise_matches_fused():
         carry = model.apply_layer(i, p, carry, batch)
     np.testing.assert_allclose(np.asarray(carry), np.asarray(fused),
                                rtol=1e-2, atol=1e-2)
+
+
+def test_accuracy_metrics_all_families():
+    """Every non-causal-LM family reports a task metric next to the loss
+    (reference builds an accuracy metric it never reports, dataset.py:39-54):
+    accuracy_from_logits returns (correct, count) with 0 <= correct <= count,
+    and a perfectly-predicting logit tensor scores 1.0."""
+    cases = [
+        ("vit-tiny", "labels"),
+        ("resnet-tiny", "labels"),
+        ("bert-tiny", "labels"),
+        ("t5-tiny", "labels"),
+        ("clip-tiny", None),
+    ]
+    for name, label_key in cases:
+        model = build_model(name)
+        batch = model.sample_batch(4, 16)
+        params = model.init_params(jax.random.PRNGKey(0))
+        if name == "t5-tiny":
+            logits = model.forward(params, batch["input_ids"],
+                                   batch["decoder_input_ids"])
+        elif name == "clip-tiny":
+            logits = model.forward(params, batch["pixel_values"],
+                                   batch["input_ids"])
+        elif model.data_kind == "image":
+            logits = model.forward(params, batch["pixel_values"])
+        else:
+            logits = model.forward(params, batch["input_ids"])
+        c, n = model.accuracy_from_logits(logits, batch)
+        c, n = float(c), float(n)
+        assert 0.0 <= c <= n and n > 0, (name, c, n)
+
+        # Oracle logits -> accuracy exactly 1.
+        if name == "clip-tiny":
+            oracle = jnp.eye(logits.shape[0]) * 10.0
+        else:
+            num_classes = logits.shape[-1]
+            oracle = jax.nn.one_hot(batch["labels"], num_classes) * 10.0
+        oc, on = model.accuracy_from_logits(oracle, batch)
+        assert float(oc) == float(on), (name, float(oc), float(on))
