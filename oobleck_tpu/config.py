@@ -99,7 +99,7 @@ class ExecutionArguments:
     sequence_parallel: int = 1    # ring-attention / context-parallel degree
     precision: str = "bfloat16"   # activation/compute dtype
     remat: bool = True            # rematerialize per-layer activations
-    attention_impl: str = "auto"  # auto | xla | pallas | ring
+    attention_impl: str = "auto"  # auto | xla | pallas | ring | ulysses
     checkpoint_dir: str | None = None
     checkpoint_interval: int = 0  # steps; 0 disables
     # Checkpoint-FREE multi-host recovery (reference engine.py:238-309:

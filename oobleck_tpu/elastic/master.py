@@ -85,6 +85,7 @@ class SSHLauncher:
             tempfile.gettempdir(), "oobleck_tpu", "logs"
         )
         self._job_dir: str | None = None
+        self._launch_counts: dict[str, int] = {}
         if shutil.which("ssh") is None:
             raise RuntimeError("no ssh client available; use LocalLauncher")
 
@@ -95,12 +96,19 @@ class SSHLauncher:
         self._job_dir = os.path.join(
             self.log_dir, f"{ts}-{args.model.model_name}"
         )
+        self._launch_counts = {}
         os.makedirs(self._job_dir, exist_ok=True)
 
     def _log_path(self, ip: str, args: OobleckArguments) -> str:
         if self._job_dir is None:
             self.start_job(args)
-        return os.path.join(self._job_dir, f"{ip}.out")
+        # Per-launch suffix: repeated launches for one host (the config
+        # allows num_agents_per_node in principle) must not interleave into
+        # one file.
+        k = self._launch_counts.get(ip, 0)
+        self._launch_counts[ip] = k + 1
+        name = f"{ip}.out" if k == 0 else f"{ip}-{k}.out"
+        return os.path.join(self._job_dir, name)
 
     async def launch(self, ip: str, master_ip: str, master_port: int,
                      args: OobleckArguments) -> None:

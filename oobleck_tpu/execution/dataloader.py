@@ -21,6 +21,7 @@ Resume-after-reconfiguration works the same way: construct with the saved
 
 from __future__ import annotations
 
+import threading
 from dataclasses import dataclass
 from enum import Enum
 
@@ -30,6 +31,13 @@ import numpy as np
 class LoaderType(Enum):
     TRAINING = 0
     EVALUATION = 1
+
+
+# Serializes (set_epoch, row gather) across every loader in the process —
+# see OobleckDataLoader.next_batch. Prefetch threads still overlap with
+# device compute; they just can't interleave epoch mutation on the shared
+# dataset with each other (or with eval on the main thread).
+_DATASET_EPOCH_LOCK = threading.Lock()
 
 
 class OobleckSampler:
@@ -144,15 +152,23 @@ class OobleckDataLoader:
         # Epoch-aware views (MLMView's dynamic masking) re-seed per epoch;
         # next_iteration() has already rolled the epoch forward if this
         # iteration starts one, so the sampler's epoch is the producing one.
-        set_epoch = getattr(self.dataset, "set_epoch", None)
-        if set_epoch is not None:
-            set_epoch(self.sampler.epoch)
-        per_mb: list[dict[str, np.ndarray]] = []
-        for idx_list in mbs:
-            rows = [self.dataset[int(i)] for i in idx_list]
-            per_mb.append({
-                k: np.stack([r[k] for r in rows]) for k in rows[0]
-            })
+        # The set_epoch + gather pair runs under ONE process-wide lock:
+        # loaders share the dataset object, and PrefetchingLoader assembles
+        # batches on background threads — without the lock, loader A
+        # rolling into epoch e+1 while loader B still gathers epoch-e rows
+        # silently corrupts B's batch (and, multi-host, makes processes
+        # materialize DIFFERENT tensors for the same iteration). Batch
+        # contents stay a pure function of (indices, sampler epoch).
+        with _DATASET_EPOCH_LOCK:
+            set_epoch = getattr(self.dataset, "set_epoch", None)
+            if set_epoch is not None:
+                set_epoch(self.sampler.epoch)
+            per_mb: list[dict[str, np.ndarray]] = []
+            for idx_list in mbs:
+                rows = [self.dataset[int(i)] for i in idx_list]
+                per_mb.append({
+                    k: np.stack([r[k] for r in rows]) for k in rows[0]
+                })
         return {k: np.stack([mb[k] for mb in per_mb]) for k in per_mb[0]}
 
 

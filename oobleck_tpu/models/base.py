@@ -68,3 +68,15 @@ def param_count(params: PyTree) -> int:
 
 def param_bytes(params: PyTree) -> int:
     return sum(x.size * x.dtype.itemsize for x in jax.tree.leaves(params))
+
+
+def argmax_accuracy(logits, labels):
+    """Shared task metric for evaluate() (the reference builds an accuracy
+    metric via `evaluate` but never reports it, dataset.py:39-54): returns
+    (correct_count, total_count) for argmax-vs-labels families
+    (classification, seq2seq token accuracy)."""
+    import jax.numpy as jnp
+
+    pred = jnp.argmax(logits, axis=-1)
+    correct = (pred == labels).astype(jnp.float32)
+    return jnp.sum(correct), jnp.float32(correct.size)

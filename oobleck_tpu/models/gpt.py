@@ -285,13 +285,37 @@ class GPTModel:
         bqkv = p["attn"]["bqkv"].astype(dt)                             # [3,Hl,D]
         qkv = jnp.einsum("bse,ethd->tbhsd", h, wqkv) + bqkv[:, None, :, None, :]
         if ctx and ctx.seq:
-            if c.position_embedding == "alibi":
-                raise NotImplementedError(
-                    "alibi + sequence parallelism needs ring-bias support"
-                )
-            from oobleck_tpu.ops.ring_attention import ring_attention
+            if c.attention_impl == "ulysses" or c.position_embedding == "alibi":
+                # Ulysses all-to-all layout: full sequence per device on
+                # H/P heads — position-dependent biases (ALiBi) work
+                # unchanged, which the ring layout cannot offer.
+                from oobleck_tpu.ops.ulysses import ulysses_attention
 
-            attn_out = ring_attention(qkv[0], qkv[1], qkv[2], axis_name=ctx.seq)
+                bias = None
+                if c.position_embedding == "alibi":
+                    from oobleck_tpu.ops.attention import alibi_bias
+
+                    s_global = qkv.shape[3] * lax.psum(1, ctx.seq)
+                    full = alibi_bias(c.num_heads, s_global, s_global)
+                    # TP-local head slice first (qkv holds Hl = H/tp heads,
+                    # like the non-SP branch below); ulysses then slices
+                    # its seq-rank's block out of the Hl heads.
+                    h_local = qkv.shape[2]
+                    if ctx.tensor:
+                        start = ctx.tp_rank() * h_local
+                        bias = lax.dynamic_slice_in_dim(
+                            full, start, h_local, axis=0
+                        )
+                    else:
+                        bias = full
+                attn_out = ulysses_attention(
+                    qkv[0], qkv[1], qkv[2], axis_name=ctx.seq, bias=bias,
+                )
+            else:
+                from oobleck_tpu.ops.ring_attention import ring_attention
+
+                attn_out = ring_attention(qkv[0], qkv[1], qkv[2],
+                                          axis_name=ctx.seq)
         else:
             bias = None
             if c.position_embedding == "alibi":

@@ -347,13 +347,9 @@ class SwinModel:
 
 
     def accuracy_from_logits(self, logits, batch):
-        """Task metric for evaluate() (reference builds accuracy via
-        `evaluate`, dataset.py:39-54): (correct_count, total_count)."""
-        import jax.numpy as jnp
+        from oobleck_tpu.models.base import argmax_accuracy
 
-        pred = jnp.argmax(logits, axis=-1)
-        correct = (pred == batch["labels"]).astype(jnp.float32)
-        return jnp.sum(correct), jnp.float32(correct.size)
+        return argmax_accuracy(logits, batch["labels"])
 
     def loss(self, params, batch):
         return self.loss_from_logits(

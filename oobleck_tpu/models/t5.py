@@ -352,13 +352,9 @@ class T5Model:
 
 
     def accuracy_from_logits(self, logits, batch):
-        """Task metric for evaluate() (reference builds accuracy via
-        `evaluate`, dataset.py:39-54): (correct_count, total_count)."""
-        import jax.numpy as jnp
+        from oobleck_tpu.models.base import argmax_accuracy
 
-        pred = jnp.argmax(logits, axis=-1)
-        correct = (pred == batch["labels"]).astype(jnp.float32)
-        return jnp.sum(correct), jnp.float32(correct.size)
+        return argmax_accuracy(logits, batch["labels"])
 
     def loss(self, params, batch):
         logits = self.forward(params, batch["input_ids"],

@@ -94,6 +94,12 @@ def select_attention_impl(impl: str = "auto"):
         from oobleck_tpu.ops.ring_attention import ring_attention
 
         return ring_attention
+    if impl == "ulysses":
+        # The Ulysses all-to-all layout only exists under a sequence-
+        # parallel mesh axis (models call ops.ulysses directly there);
+        # without one it degenerates to the "auto" single-device choice —
+        # flash on TPU, NOT the HBM-quadratic XLA path.
+        return select_attention_impl("auto")
     if impl == "auto":
         # On TPU the Pallas flash kernel (fwd + bwd) is the default — it
         # keeps HBM traffic linear in S where the XLA path materializes

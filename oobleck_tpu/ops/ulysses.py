@@ -1,0 +1,66 @@
+"""Ulysses sequence parallelism: all-to-all head-parallel attention.
+
+BEYOND-reference capability (SURVEY §2.2 "Ulysses: absent"), complementing
+ring attention as the second long-context layout:
+
+  * ring (`ops/ring_attention.py`): K/V shards rotate over `ppermute`;
+    memory per chip stays O(S_local), comm is P-1 hops of the K/V shard —
+    best when S is huge and heads are few.
+  * ulysses (this module): ONE `all_to_all` trades the sequence shard for a
+    head shard, every chip runs FULL-sequence attention over H/P heads with
+    any single-device kernel (Pallas flash included), then one `all_to_all`
+    trades back — two collectives total, and position-dependent biases
+    (ALiBi) work unchanged because the whole sequence is present. Best when
+    H >= P and S fits per-chip once attention is head-sliced.
+
+Layout contract matches ring: q, k, v are [B, H, S_local, D] shards over
+`axis_name`; the result is the same shard. Requires H % P == 0.
+"""
+
+from __future__ import annotations
+
+import jax
+from jax import lax
+
+
+def ulysses_attention(q: jax.Array, k: jax.Array, v: jax.Array, *,
+                      axis_name: str, scale: float | None = None,
+                      bias: jax.Array | None = None, causal: bool = True,
+                      inner_impl: str = "auto") -> jax.Array:
+    """All-to-all attention over a sequence-parallel mesh axis.
+
+    `bias` is the FULL-sequence bias ([H, S, S] or broadcastable), sliced
+    per-device to the local heads here; `inner_impl` picks the
+    single-device kernel for the full-sequence attention (the Pallas flash
+    path on TPU).
+    """
+    from oobleck_tpu.ops.attention import causal_attention
+
+    P = lax.psum(1, axis_name)
+    H = q.shape[1]
+    if H % P != 0:
+        raise ValueError(
+            f"ulysses needs heads % axis size == 0, got {H} % {P}"
+        )
+
+    def seq_to_heads(x):
+        # [B, H, S/P, D] -> [B, H/P, S, D]: each device keeps H/P heads of
+        # the full sequence.
+        return lax.all_to_all(x, axis_name, split_axis=1, concat_axis=2,
+                              tiled=True)
+
+    qh, kh, vh = seq_to_heads(q), seq_to_heads(k), seq_to_heads(v)
+    local_bias = bias
+    if bias is not None and bias.ndim >= 3 and bias.shape[-3] == H:
+        # Per-head bias over global heads: tiled all_to_all hands device i
+        # heads [i*H/P, (i+1)*H/P), so slice its block; head-broadcast
+        # biases (dim 1 or ndim<3) pass through unchanged.
+        idx = lax.axis_index(axis_name)
+        per = H // P
+        local_bias = lax.dynamic_slice_in_dim(bias, idx * per, per, axis=-3)
+    out = causal_attention(qh, kh, vh, impl=inner_impl, scale=scale,
+                           bias=local_bias, causal=causal,
+                           constant_bias=True)
+    # [B, H/P, S, D] -> [B, H, S/P, D]
+    return lax.all_to_all(out, axis_name, split_axis=2, concat_axis=1,
+                          tiled=True)
