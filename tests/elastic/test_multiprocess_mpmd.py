@@ -167,7 +167,6 @@ TINY_MODEL = {
     "vocab_size": 256,
 }
 STEPS = 6
-HOSTS = ["127.0.0.1", "127.0.0.2"]
 
 
 def _wait_for(pattern: str, log: Path, deadline: float, *,
@@ -190,13 +189,20 @@ def _kill(pid: int) -> None:
         pass
 
 
-def test_multiprocess_mpmd_checkpoint_free_recovery(tmp_path):
+@pytest.mark.parametrize("n_hosts", [2, 3])
+def test_multiprocess_mpmd_checkpoint_free_recovery(tmp_path, n_hosts):
+    """n_hosts=2 exercises the degenerate single-survivor world (1-process
+    collectives + own-mirror restore); n_hosts=3 exercises the REAL
+    multi-survivor respawn: two survivors re-form a 2-process
+    jax.distributed world and refill state through the cross-process
+    freshest-mirror election."""
+    hosts = [f"127.0.0.{i + 1}" for i in range(n_hosts)]
     env = _base_env(tmp_path / "cache", 2)
     env["OOBLECK_MULTIHOST"] = "1"
     port = _free_port()
     cfg = {
         "dist": {"master_ip": "127.0.0.1", "master_port": port,
-                 "node_ips": HOSTS},
+                 "node_ips": hosts},
         "job": {"microbatch_size": 2, "global_microbatch_size": 8,
                 "steps": STEPS},
         "model": {"model_name": "gpt2", "dataset_path": "synthetic",
@@ -243,35 +249,45 @@ def test_multiprocess_mpmd_checkpoint_free_recovery(tmp_path):
             ip: int(_wait_for(
                 rf"launched agent for {re.escape(ip)} \(pid (\d+)\)",
                 log, deadline).group(1))
-            for ip in HOSTS
+            for ip in hosts
         }
         worker_pids = {
             ip: int(_wait_for(
                 rf"agent {re.escape(ip)} launched worker pid=(\d+)",
                 log, deadline).group(1))
-            for ip in HOSTS
+            for ip in hosts
         }
         pids_to_kill.update(agent_pids.values())
         pids_to_kill.update(worker_pids.values())
 
-        _wait_for(r"jax\.distributed initialized: .* \(process 1/2\)",
-                  log, deadline)
+        _wait_for(
+            rf"jax\.distributed initialized: .* \(process {n_hosts - 1}/"
+            rf"{n_hosts}\)", log, deadline)
         _wait_for(rf"step 2/{STEPS} loss [\d.]+", log, deadline)
 
-        # ---- failure injection: SIGKILL host 2's worker AND agent ----
+        # ---- failure injection: SIGKILL the LAST host's worker + agent ----
+        victim = hosts[-1]
+        survivors = hosts[:-1]
         offset = log.stat().st_size
         t_kill = time.monotonic()
-        _kill(worker_pids[HOSTS[1]])
-        _kill(agent_pids[HOSTS[1]])
+        _kill(worker_pids[victim])
+        _kill(agent_pids[victim])
 
-        _wait_for(rf"agent {re.escape(HOSTS[1])} disconnected", log, deadline)
-        _wait_for(r"worker respawned for 1 survivors", log, deadline,
-                  after=offset)
-        new_worker = int(_wait_for(
-            rf"agent {re.escape(HOSTS[0])} launched worker pid=(\d+)",
-            log, deadline, after=offset).group(1))
-        pids_to_kill.add(new_worker)
-        # Checkpoint-free: state comes from the surviving live mirror.
+        _wait_for(rf"agent {re.escape(victim)} disconnected", log, deadline)
+        _wait_for(rf"worker respawned for {len(survivors)} survivors",
+                  log, deadline, after=offset)
+        for ip in survivors:
+            new_worker = int(_wait_for(
+                rf"agent {re.escape(ip)} launched worker pid=(\d+)",
+                log, deadline, after=offset).group(1))
+            pids_to_kill.add(new_worker)
+        if len(survivors) > 1:
+            # The survivors re-formed a REAL multi-process world.
+            _wait_for(
+                rf"jax\.distributed initialized: .* \(process "
+                rf"{len(survivors) - 1}/{len(survivors)}\)",
+                log, deadline, after=offset)
+        # Checkpoint-free: state comes from the surviving live mirrors.
         _wait_for(r"recovered live state from surviving mirrors",
                   log, deadline, after=offset)
         m = _wait_for(rf"step (\d+)/{STEPS} loss ([\d.]+)", log, deadline,
@@ -280,7 +296,8 @@ def test_multiprocess_mpmd_checkpoint_free_recovery(tmp_path):
         assert recovery_s < 60, f"recovery took {recovery_s:.1f}s"
         assert int(m.group(1)) >= 2, "restored step regressed to scratch"
         assert float(m.group(2)) > 0
-        print(f"mpmd checkpoint-free recovery in {recovery_s:.1f}s")
+        print(f"mpmd checkpoint-free recovery ({n_hosts} hosts) "
+              f"in {recovery_s:.1f}s")
 
         _wait_for(rf"step {STEPS}/{STEPS} loss [\d.]+", log, deadline,
                   after=offset)
