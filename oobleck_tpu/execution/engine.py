@@ -1,12 +1,18 @@
 """The worker-side engine: planning, pipelines, training loop, recovery.
 
 Capability match for the reference OobleckEngine / DataParallelEngine /
-ReconfigurationEngine (/root/reference/oobleck/execution/engine.py:39-668),
-single-controller JAX design: one engine process drives every visible chip.
-"Hosts" partition the chip list (chips_per_host each); on a physical
-multi-host deployment the same code runs under jax.distributed with the
-global device list, the control plane supplying the coordinator address
-(elastic/), and per-host agents supervising one engine each.
+ReconfigurationEngine (/root/reference/oobleck/execution/engine.py:39-668).
+Two deployment shapes share this code:
+
+  * single-controller (default): one engine process drives every visible
+    chip; "hosts" partition the chip list (chips_per_host each);
+  * multi-host MPMD (OOBLECK_MULTIHOST=1): every host's worker joins one
+    jax.distributed world (coordinator address via the control plane,
+    elastic/). Pipelines span hosts with host-local stages; cross-host
+    edges and the layer-granularity DP allreduce ride XLA collectives over
+    process meshes (parallel/cross_host.py); recovery is respawn + live
+    mirror refill (checkpoint-free, matching the reference's in-memory
+    recovery, engine.py:238-309).
 
 Key behaviors mirrored from the reference:
   * ctor builds dataset/model/profile/templates without any distributed
