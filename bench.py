@@ -206,7 +206,8 @@ def _validate_flash_on_device() -> bool:
                 rtol=5e-2, atol=5e-2,
             )
         return True
-    except AssertionError:
+    except Exception:  # noqa: BLE001 — a failed kernel must degrade the
+        # flag, never kill the measurement (lowering errors included).
         return False
 
 

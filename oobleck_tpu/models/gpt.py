@@ -272,6 +272,25 @@ class GPTModel:
         return x.astype(c.dtype)
 
     def apply_block(self, p, x: jax.Array, ctx: ShardCtx | None = None) -> jax.Array:
+        x = self.attention_sublayer(p, x, ctx)
+
+        # --- mlp ---
+        c = self.config
+        dt = c.dtype
+        t = ctx.tensor if ctx else None
+        f_ = ctx.fsdp if ctx else None
+        h = _layer_norm(x, p["ln2"]["scale"], p["ln2"]["bias"], c.layer_norm_epsilon)
+        wi = _maybe_unshard(p["mlp"]["wi"], f_, 0).astype(dt)           # [E,Fl]
+        h = jax.nn.gelu(h @ wi + p["mlp"]["bi"].astype(dt))
+        wo = _maybe_unshard(p["mlp"]["wo"], f_, 1).astype(dt)           # [Fl,E]
+        out = h @ wo
+        out = _maybe_reduce_from_tp(out, t) + p["mlp"]["bo"].astype(dt)
+        return x + out
+
+    def attention_sublayer(self, p, x: jax.Array,
+                           ctx: ShardCtx | None = None) -> jax.Array:
+        """ln1 -> attention (impl dispatch, ALiBi, TP/SP aware) -> residual.
+        Split out of apply_block so MoE variants swap only the MLP half."""
         c = self.config
         dt = c.dtype
         t = ctx.tensor if ctx else None
@@ -337,15 +356,6 @@ class GPTModel:
         wo = _maybe_unshard(p["attn"]["wo"], f_, 2).astype(dt)          # [Hl,D,E]
         out = jnp.einsum("bhsd,hde->bse", attn_out, wo)
         out = _maybe_reduce_from_tp(out, t) + p["attn"]["bo"].astype(dt)
-        x = x + out
-
-        # --- mlp ---
-        h = _layer_norm(x, p["ln2"]["scale"], p["ln2"]["bias"], c.layer_norm_epsilon)
-        wi = _maybe_unshard(p["mlp"]["wi"], f_, 0).astype(dt)           # [E,Fl]
-        h = jax.nn.gelu(h @ wi + p["mlp"]["bi"].astype(dt))
-        wo = _maybe_unshard(p["mlp"]["wo"], f_, 1).astype(dt)           # [Fl,E]
-        out = h @ wo
-        out = _maybe_reduce_from_tp(out, t) + p["mlp"]["bo"].astype(dt)
         return x + out
 
     def head(self, p, x: jax.Array, ctx: ShardCtx | None = None) -> jax.Array:

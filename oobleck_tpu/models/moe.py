@@ -1,0 +1,141 @@
+"""Mixture-of-experts GPT: a decoder whose MLPs are switch-MoE layers.
+
+BEYOND-reference model family (the reference has no MoE anywhere): same
+LayerListModel protocol as every other family, so the MPMD engine drives it
+unchanged — planning, heterogeneous pipelines, DP sync, reconfiguration,
+checkpointing. The carry is a `(hidden, aux_loss)` tuple (like T5's
+two-part carry): every block accumulates its Switch load-balancing loss and
+the head folds `aux_weight * aux` into the objective — the generic stage
+program only sees the last layer's loss, so the aux term must ride the
+carry across stages (and across hosts, where the pytree-generic
+cross-process edges carry it).
+
+Expert parallelism itself lives in ops/moe.py (experts sharded over a mesh
+axis, exactness-tested under shard_map); through the engine the experts are
+replicated within a stage for now — honest scope, stated in PARITY.md.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass, replace
+from typing import Any
+
+import jax
+import jax.numpy as jnp
+
+from oobleck_tpu.models.gpt import (
+    GPTConfig,
+    GPTModel,
+    _layer_norm,
+    cross_entropy_loss,
+)
+from oobleck_tpu.ops.moe import switch_moe
+
+
+@dataclass(frozen=True)
+class MoEGPTConfig(GPTConfig):
+    num_experts: int = 8
+    capacity_factor: float = 1.25
+    aux_weight: float = 0.01
+
+    def override(self, **kwargs) -> "MoEGPTConfig":
+        moe_keys = {k: kwargs.pop(k) for k in
+                    ("num_experts", "capacity_factor", "aux_weight")
+                    if k in kwargs}
+        # super().override -> dataclasses.replace(self, ...) preserves the
+        # subclass, so the MoE fields survive the base validation.
+        return replace(super().override(**kwargs), **moe_keys)
+
+
+class MoEGPTModel(GPTModel):
+    """GPT decoder with switch-MoE MLPs; generic-path only (no manual-TP
+    contract -> the fused SPMD step rejects it, the MPMD engine drives it)."""
+
+    fused_supported = False
+
+    def __init__(self, config: MoEGPTConfig):
+        super().__init__(config)
+
+    # The manual-collective contract (and its param_specs companion) must
+    # be ABSENT so PipelineInstance takes the generic stage path and
+    # synthesizes replicated specs from the MoE layer shapes
+    # (pipeline.py gates on hasattr for both).
+    @property
+    def head_loss_shifted(self):  # pragma: no cover - attribute probe
+        raise AttributeError("MoE runs the generic stage path")
+
+    @property
+    def param_specs(self):  # pragma: no cover - attribute probe
+        raise AttributeError("MoE uses synthesized generic specs")
+
+    # ---- layer list ----
+
+    def _init_block(self, rng: jax.Array):
+        c = self.config
+        ks = jax.random.split(rng, 4)
+        std = c.initializer_range
+        base = super()._init_block(ks[0])
+        ne, m, f = c.num_experts, c.hidden_size, c.ffn_dim
+        # Residual output projection scaled like the dense family's
+        # (std / sqrt(2L), GPT-2 discipline) so activation variance at
+        # depth matches the models this variant claims to mirror.
+        res_std = std / (2 * c.num_layers) ** 0.5
+        base["mlp"] = {
+            "router": jax.random.normal(ks[1], (m, ne), c.param_dtype) * std,
+            "w1": jax.random.normal(ks[2], (ne, m, f), c.param_dtype) * std,
+            "b1": jnp.zeros((ne, f), c.param_dtype),
+            "w2": jax.random.normal(ks[3], (ne, f, m), c.param_dtype)
+                  * res_std,
+            "b2": jnp.zeros((ne, m), c.param_dtype),
+        }
+        return base
+
+    def apply_layer(self, index: int, params, carry, batch,
+                    ctx=None) -> Any:
+        c = self.config
+        last = self.num_pipeline_layers - 1
+        if index == 0:
+            x = super().apply_layer(0, params, None, batch)
+            # Aux accumulator is [B]-shaped (a scalar carry leaf cannot
+            # take the stage batch sharding P("fsdp")); blocks spread their
+            # scalar aux uniformly over the batch dim and the head sums it
+            # back — GSPMD inserts the cross-shard reduction when the batch
+            # dim is fsdp-sharded.
+            return (x, jnp.zeros((x.shape[0],), jnp.float32))
+        x, aux = carry
+        if index == last:
+            logits = super().apply_layer(last, params, x, batch)
+            # loss_from_logits unpacks the (logits, aux) pair.
+            return (logits, aux)
+        dt = c.dtype
+        # Attention half shared with the dense family (impl dispatch,
+        # ALiBi, residual) — only the MLP half is MoE-specific.
+        x = self.attention_sublayer(params, x, ctx=None)
+        h2 = _layer_norm(x, params["ln2"]["scale"], params["ln2"]["bias"],
+                         c.layer_norm_epsilon)
+        mlp = params["mlp"]
+        y, block_aux = switch_moe(
+            h2.astype(dt), mlp["router"], mlp["w1"], mlp["b1"],
+            mlp["w2"], mlp["b2"],
+            num_experts=c.num_experts,
+            capacity_factor=c.capacity_factor,
+        )
+        return (x + y, aux + block_aux / aux.shape[0])
+
+    def loss_from_logits(self, logits_and_aux, batch) -> jax.Array:
+        logits, aux = logits_and_aux
+        ce = cross_entropy_loss(logits, batch["input_ids"],
+                                self.config.vocab_size)
+        return ce + self.config.aux_weight * jnp.sum(aux)
+
+    # Forward for single-device tests: chain apply_layer like the pipeline.
+    def forward(self, params_list, tokens):
+        batch = {"input_ids": tokens}
+        carry = None
+        for li in range(self.num_pipeline_layers):
+            carry = self.apply_layer(li, params_list[li], carry, batch)
+        return carry
+
+    def loss(self, params_list, batch):
+        out = self.forward(params_list, batch["input_ids"])
+        return self.loss_from_logits(out, batch)
