@@ -128,6 +128,12 @@ class ExecutionArguments:
             raise ValueError(
                 f"engine_path must be auto|mpmd|fused, got {self.engine_path!r}"
             )
+        if self.attention_impl not in ("auto", "xla", "pallas", "ring",
+                                       "ulysses"):
+            raise ValueError(
+                "attention_impl must be auto|xla|pallas|ring|ulysses, got "
+                f"{self.attention_impl!r}"
+            )
         if self.sequence_parallel > 1 and self.engine_path == "mpmd":
             raise ValueError(
                 "sequence_parallel > 1 requires the fused path "
