@@ -86,6 +86,23 @@ def canonical_order(S: int, M: int) -> list[Instruction]:
     return order
 
 
+def _fit_spec(spec: P, shape: tuple, mesh: Mesh) -> P:
+    """Clear spec entries whose mesh-axis product doesn't divide the dim."""
+    out = []
+    for d, entry in enumerate(spec):
+        names = entry if isinstance(entry, tuple) else (
+            (entry,) if entry is not None else ()
+        )
+        size = 1
+        for n in names:
+            size *= mesh.shape[n]
+        if not names or shape[d] % size == 0:
+            out.append(entry)
+        else:
+            out.append(None)
+    return P(*out)
+
+
 def _project_spec(spec: P, keep: frozenset) -> P:
     """Project a model PartitionSpec onto a stage mesh, keeping only the axis
     names in `keep` (subset of {"fsdp", "tensor"}); everything else becomes
@@ -208,6 +225,14 @@ class PipelineInstance:
                     else _specs["head"] if name == "head"
                     else _specs["blocks"]
                 )
+        elif hasattr(model, "generic_param_specs"):
+            # Generic-path models may still declare per-layer shardings
+            # (e.g. MoE expert dims over the fsdp axis — GSPMD then runs
+            # the expert einsums as true expert parallelism and inserts the
+            # combine psum itself). Axes that don't divide a leaf's dim are
+            # cleared per-stage below.
+            def spec_tree(li: int):
+                return model.generic_param_specs(li)
         else:
             _spec_rng = jax.random.PRNGKey(0)
 
@@ -255,18 +280,39 @@ class PipelineInstance:
             mesh = Mesh(
                 stage_devices.reshape(fsdp_deg, tp), ("fsdp", "tensor")
             )
+            generic_specs = hasattr(model, "generic_param_specs")
             keep = frozenset(
-                a for a, on in (("fsdp", use_fsdp), ("tensor", tp > 1)) if on
+                a for a, on in (
+                    # Generic-spec (plain-jit GSPMD) params may shard over
+                    # the fsdp axis even when the BATCH cannot (use_fsdp
+                    # False) — manual shard_map programs may not, their
+                    # in_specs are coupled to the batch layout.
+                    ("fsdp", fsdp_deg > 1 if generic_specs else use_fsdp),
+                    ("tensor", tp > 1),
+                ) if on
             )
             batch_spec = P("fsdp") if use_fsdp else P(None)
             param_shardings: dict[int, Any] = {}
             param_pspecs: dict[int, Any] = {}
             for li in stage.layer_indices:
-                param_pspecs[li] = jax.tree.map(
+                pspecs = jax.tree.map(
                     lambda s: _project_spec(s, keep),
                     spec_tree(li),
                     is_leaf=lambda x: isinstance(x, P),
                 )
+                if generic_specs:
+                    # Clear axis entries that don't divide the leaf dim
+                    # (e.g. 3 experts over a 2-way fsdp axis -> replicate).
+                    shapes = jax.eval_shape(
+                        lambda r, _li=li: model.init_layer(r, _li),
+                        jax.random.PRNGKey(0),
+                    )
+                    pspecs = jax.tree.map(
+                        lambda s, sh: _fit_spec(s, sh.shape, mesh),
+                        pspecs, shapes,
+                        is_leaf=lambda x: isinstance(x, P),
+                    )
+                param_pspecs[li] = pspecs
                 param_shardings[li] = jax.tree.map(
                     lambda s: NamedSharding(mesh, s),
                     param_pspecs[li],

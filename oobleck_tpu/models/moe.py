@@ -68,6 +68,26 @@ class MoEGPTModel(GPTModel):
     def param_specs(self):  # pragma: no cover - attribute probe
         raise AttributeError("MoE uses synthesized generic specs")
 
+    def generic_param_specs(self, li: int):
+        """Expert parallelism through the engine: expert-dim leaves shard
+        over the stage's fsdp axis (GSPMD runs the dispatch/combine einsums
+        as true EP and inserts the combine psum itself); everything else
+        replicates. The pipeline clears the axis per-stage when
+        num_experts doesn't divide it."""
+        from jax.sharding import PartitionSpec as P
+
+        shapes = jax.eval_shape(
+            lambda r: self.init_layer(r, li), jax.random.PRNGKey(0)
+        )
+        specs = jax.tree.map(lambda _: P(), shapes)
+        if 0 < li < self.num_pipeline_layers - 1:
+            specs["mlp"] = {
+                "router": P(),
+                "w1": P("fsdp"), "b1": P("fsdp"),
+                "w2": P("fsdp"), "b2": P("fsdp"),
+            }
+        return specs
+
     # ---- layer list ----
 
     def _init_block(self, rng: jax.Array):

@@ -205,3 +205,31 @@ def test_moe_alibi_positions_work():
     l2 = float(model.loss(params, {"input_ids": jnp.asarray(rev)}))
     assert np.isfinite(l1) and np.isfinite(l2)
     assert abs(l1 - l2) > 1e-6, "position signal absent (ALiBi dropped?)"
+
+
+def test_moe_expert_sharding_in_engine_path(devices8):
+    """generic_param_specs shards expert dims over the stage's fsdp axis:
+    a 2-chip stage holds 2 experts per chip (4 experts / fsdp=2) and the
+    step still runs (GSPMD inserts the EP combine)."""
+    from oobleck_tpu.execution.pipeline import PipelineInstance
+    from oobleck_tpu.planning.templates import PipelineTemplate, StageSpec
+
+    model = build_model("gpt2-moe-tiny")  # 4 experts
+    nl = model.num_pipeline_layers
+    tmpl = PipelineTemplate(
+        stages=(StageSpec(layer_indices=tuple(range(nl)), num_chips=2,
+                          forward=1.0, backward=3.0, mem_required=1 << 20),),
+        iteration_time=4.0, num_layers=nl, num_hosts=1, chips_per_host=2,
+    )
+    pipe = PipelineInstance(
+        pipeline_id=0, template=tmpl, ranks=[0, 1], model=model,
+        devices=devices8[:2], num_microbatches=2, total_num_microbatches=2,
+        microbatch_size=2, seq_len=32, exec_cache={},
+    )
+    block_specs = pipe.stages[0].param_pspecs[1]["mlp"]
+    assert block_specs["w1"] == P("fsdp"), block_specs
+    assert block_specs["router"] == P()
+    tokens = np.random.RandomState(0).randint(
+        0, model.config.vocab_size, size=(2, 2, 32)).astype(np.int32)
+    loss = pipe.train_step(tokens)
+    assert np.isfinite(float(loss))
