@@ -141,3 +141,50 @@ def test_optimizer_step_changes_params(model, batch, devices8):
     pipe.apply_updates(opt, state, pipe.grads)
     after = np.asarray(pipe.params[1]["attn"]["wqkv"])
     assert not np.allclose(before, after)
+
+
+@pytest.mark.parametrize("S,M", [(1, 1), (1, 4), (2, 2), (2, 6), (3, 4),
+                                 (4, 4), (4, 8), (5, 7)])
+def test_canonical_order_is_dependency_valid(S, M):
+    """Multi-host deadlock-freedom rests on canonical_order being a valid
+    total order of the 1F1B streams: every process executes it verbatim, so
+    it must (a) contain every instruction exactly once, (b) respect FIFO
+    order within each stage stream, and (c) place every SEND before the
+    dependent compute and every producer before its SEND."""
+    from oobleck_tpu.execution.pipeline import canonical_order
+    from oobleck_tpu.execution.schedule import Op, all_instructions
+
+    order = canonical_order(S, M)
+    streams = all_instructions(S, M)
+    assert len(order) == sum(len(s) for s in streams)
+
+    # (b) per-stream FIFO
+    pos = {id(ins): i for i, ins in enumerate(order)}
+    from collections import Counter
+
+    counts = Counter((ins.op, ins.stage, ins.microbatch) for ins in order)
+    assert all(c == 1 for c in counts.values())
+    for stream in streams:
+        idxs = [order.index(ins) for ins in stream]
+        assert idxs == sorted(idxs), "stream order violated"
+
+    # (c) dataflow: replay the order and assert each op's inputs exist.
+    acts, gacts, fwd_done, bwd_done = set(), set(), set(), set()
+    for ins in order:
+        key = (ins.stage, ins.microbatch)
+        if ins.op == Op.FORWARD:
+            if ins.stage > 0:
+                assert key in acts, f"FORWARD before activation: {ins}"
+            fwd_done.add(key)
+        elif ins.op == Op.SEND_ACTIVATION:
+            assert key in fwd_done, f"SEND before FORWARD: {ins}"
+            acts.add((ins.stage + 1, ins.microbatch))
+        elif ins.op == Op.BACKWARD:
+            assert key in fwd_done
+            if ins.stage < S - 1:
+                assert key in gacts, f"BACKWARD before grad arrived: {ins}"
+            bwd_done.add(key)
+        elif ins.op == Op.SEND_GRAD:
+            assert key in bwd_done, f"SEND_GRAD before BACKWARD: {ins}"
+            gacts.add((ins.stage - 1, ins.microbatch))
+    assert len(fwd_done) == S * M and len(bwd_done) == S * M
