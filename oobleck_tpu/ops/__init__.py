@@ -1,5 +1,27 @@
-"""TPU compute kernels: attention implementations (XLA, Pallas flash, ring)."""
+"""TPU compute kernels: attention (XLA, Pallas flash, ring, Ulysses) and
+switch-MoE with expert parallelism. Heavy submodules import lazily at their
+call sites; this surface re-exports the dispatching entry points."""
 
 from oobleck_tpu.ops.attention import causal_attention, select_attention_impl
 
-__all__ = ["causal_attention", "select_attention_impl"]
+
+def ring_attention(*args, **kwargs):
+    from oobleck_tpu.ops.ring_attention import ring_attention as fn
+
+    return fn(*args, **kwargs)
+
+
+def ulysses_attention(*args, **kwargs):
+    from oobleck_tpu.ops.ulysses import ulysses_attention as fn
+
+    return fn(*args, **kwargs)
+
+
+def switch_moe(*args, **kwargs):
+    from oobleck_tpu.ops.moe import switch_moe as fn
+
+    return fn(*args, **kwargs)
+
+
+__all__ = ["causal_attention", "select_attention_impl", "ring_attention",
+           "ulysses_attention", "switch_moe"]
