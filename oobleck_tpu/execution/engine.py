@@ -345,23 +345,13 @@ class MultiHostDataParallelEngine:
     def _unpack_layer_device(self, total, li: int):
         """Slice one layer's grad tree out of the reduced vector, on the
         local device (the subsequent device_put to the stage sharding is a
-        D2D placement)."""
+        D2D placement). FlatLayout.unpack is trace-pure, so jitting it IS
+        the device-side form."""
         key = ("unpack", li)
         if key not in self._jit_cache:
-            layout = self.layout
-            off0, _ = layout.slices[li]
-            lm = layout.leaf_metas[li]
-            struct = layout.structs[li]
-
-            def unpack(f):
-                out, off = [], off0
-                for shape, dtype in lm:
-                    n = int(np.prod(shape)) if shape else 1
-                    out.append(f[off:off + n].reshape(shape).astype(dtype))
-                    off += n
-                return jax.tree.unflatten(struct, out)
-
-            self._jit_cache[key] = jax.jit(unpack)
+            self._jit_cache[key] = jax.jit(
+                lambda f, _li=li: self.layout.unpack(f, _li)
+            )
         return self._jit_cache[key](total)
 
     def allreduce(self, local_losses: dict[int, tuple[float, int]]
@@ -1376,8 +1366,8 @@ class OobleckEngine:
             contrib[layout.length + 1] = float(local["num_iterations_done"])
             contrib[layout.length + 2] = float(local["epoch"])
         total = self.comm.group_sum(contrib, layout.length + 3, range(P))
-        covered = [li for i, li in enumerate(layout.layers)
-                   if np.isfinite(winners[i])]
+        covered = {li for i, li in enumerate(layout.layers)
+                   if np.isfinite(winners[i])}
         missing = [li for li in layout.layers if li not in covered]
         if missing:
             logger.warning(

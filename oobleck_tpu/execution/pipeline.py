@@ -230,7 +230,18 @@ class PipelineInstance:
             # (e.g. MoE expert dims over the fsdp axis — GSPMD then runs
             # the expert einsums as true expert parallelism and inserts the
             # combine psum itself). Axes that don't divide a leaf's dim are
-            # cleared per-stage below.
+            # cleared per-stage below (shapes cached: eval_shape per layer
+            # runs once, not once per stage per use).
+            _shape_cache: dict[int, Any] = {}
+
+            def layer_shapes(li: int):
+                if li not in _shape_cache:
+                    _shape_cache[li] = jax.eval_shape(
+                        lambda r, _li=li: model.init_layer(r, _li),
+                        jax.random.PRNGKey(0),
+                    )
+                return _shape_cache[li]
+
             def spec_tree(li: int):
                 return model.generic_param_specs(li)
         else:
@@ -303,13 +314,9 @@ class PipelineInstance:
                 if generic_specs:
                     # Clear axis entries that don't divide the leaf dim
                     # (e.g. 3 experts over a 2-way fsdp axis -> replicate).
-                    shapes = jax.eval_shape(
-                        lambda r, _li=li: model.init_layer(r, _li),
-                        jax.random.PRNGKey(0),
-                    )
                     pspecs = jax.tree.map(
                         lambda s, sh: _fit_spec(s, sh.shape, mesh),
-                        pspecs, shapes,
+                        pspecs, layer_shapes(li),
                         is_leaf=lambda x: isinstance(x, P),
                     )
                 param_pspecs[li] = pspecs

@@ -53,9 +53,6 @@ class MoEGPTModel(GPTModel):
 
     fused_supported = False
 
-    def __init__(self, config: MoEGPTConfig):
-        super().__init__(config)
-
     # The manual-collective contract (and its param_specs companion) must
     # be ABSENT so PipelineInstance takes the generic stage path and
     # synthesizes replicated specs from the MoE layer shapes

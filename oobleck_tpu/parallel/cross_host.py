@@ -199,7 +199,10 @@ class FlatLayout:
         assert flat.shape[0] == size, (li, flat.shape, size)
         buf[off:off + size] += flat
 
-    def unpack(self, buf: np.ndarray, li: int):
+    def unpack(self, buf, li: int):
+        """Slice layer li's tree out of a flat buffer. Works on host numpy
+        AND under jit tracing (pure slicing/reshape/cast) — the device-side
+        unpack paths jit this same function."""
         off, _ = self.slices[li]
         leaves = []
         for shape, dtype in self.leaf_metas[li]:

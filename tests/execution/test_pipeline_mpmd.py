@@ -159,7 +159,6 @@ def test_canonical_order_is_dependency_valid(S, M):
     assert len(order) == sum(len(s) for s in streams)
 
     # (b) per-stream FIFO
-    pos = {id(ins): i for i, ins in enumerate(order)}
     from collections import Counter
 
     counts = Counter((ins.op, ins.stage, ins.microbatch) for ins in order)
