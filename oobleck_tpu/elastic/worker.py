@@ -110,3 +110,12 @@ def worker_main(pipe, agent_ip: str, args_dict: dict) -> None:
     engine.initialize_distributed()
     engine.instantiate_pipelines(job.global_num_microbatch)
     engine.train()
+    # Held-out evaluation at the end of the run (the reference builds eval
+    # machinery it never drives, dataset.py:39-54 / dataloader.py:101).
+    # Collective in multi-host mode — every worker reaches here after its
+    # train loop completes the same step count.
+    final = engine.evaluate()
+    logger.info("final eval loss %.4f%s", final,
+                "" if engine.last_eval_metrics is None
+                or "accuracy" not in engine.last_eval_metrics
+                else f" accuracy {engine.last_eval_metrics['accuracy']:.4f}")

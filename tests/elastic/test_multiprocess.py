@@ -180,6 +180,8 @@ def test_multiprocess_elastic_train_and_recover(tmp_path):
 
         _wait_for(rf"step {STEPS}/{STEPS} loss [\d.]+", log, deadline,
                   after=offset)
+        # End-of-run held-out evaluation (fused multi-host: one SPMD eval).
+        _wait_for(r"final eval loss [\d.]+", log, deadline, after=offset)
         _wait_for(r"worker finished training; agent exiting", log, deadline,
                   after=offset)
     finally:

@@ -301,6 +301,8 @@ def test_multiprocess_mpmd_checkpoint_free_recovery(tmp_path, n_hosts):
 
         _wait_for(rf"step {STEPS}/{STEPS} loss [\d.]+", log, deadline,
                   after=offset)
+        # End-of-run held-out evaluation runs (collectively) post-recovery.
+        _wait_for(r"final eval loss [\d.]+", log, deadline, after=offset)
         _wait_for(r"worker finished training; agent exiting", log, deadline,
                   after=offset)
     finally:
