@@ -28,6 +28,10 @@ def main() -> None:
     ap.add_argument("--port", type=int, default=0)
     ap.add_argument("--out", required=True)
     ap.add_argument("--steps", type=int, default=2)
+    ap.add_argument("--tp", type=int, default=1,
+                    help="tensor-parallel degree inside each stage "
+                         "(exercises manual shard_map stage programs "
+                         "inside the multi-process world)")
     args = ap.parse_args()
 
     multihost = args.proc >= 0
@@ -93,6 +97,7 @@ def main() -> None:
         model=model, devices=devices, total_num_microbatches=4,
         microbatch_size=MB, seq_len=SEQ, exec_cache={},
         process_of_rank=process_of_rank, comm=comm,
+        tensor_parallel=args.tp,
     )
     pipe_a = PipelineInstance(pipeline_id=0, template=tmpl_a,
                               ranks=[0, 1, 2, 3], num_microbatches=2, **common)

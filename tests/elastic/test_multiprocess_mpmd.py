@@ -60,20 +60,25 @@ def _base_env(cache: Path, devices_per_host: int) -> dict:
     return env
 
 
-def test_mpmd_multihost_gradient_exact(tmp_path):
-    """3-process world vs single-controller: identical losses and params."""
+@pytest.mark.parametrize("tp", [1, 2])
+def test_mpmd_multihost_gradient_exact(tmp_path, tp):
+    """3-process world vs single-controller: identical losses and params.
+    tp=2 additionally runs each stage as a manual-collective shard_map
+    program (Megatron f/g) over its host-local (fsdp, tensor) mesh INSIDE
+    the multi-process world."""
     env = _base_env(tmp_path / "cache", 2)
     port = _free_port()
     procs = [
         subprocess.Popen(
             [sys.executable, str(DRIVER), "--proc", str(i), "--nproc", "3",
-             "--port", str(port), "--out", str(tmp_path / f"mh{i}.npz")],
+             "--port", str(port), "--tp", str(tp),
+             "--out", str(tmp_path / f"mh{i}.npz")],
             env=env, cwd=str(REPO),
         )
         for i in range(3)
     ]
     sc = subprocess.run(
-        [sys.executable, str(DRIVER), "--proc", "-1",
+        [sys.executable, str(DRIVER), "--proc", "-1", "--tp", str(tp),
          "--out", str(tmp_path / "sc.npz")],
         env=env, cwd=str(REPO), timeout=540,
     )
