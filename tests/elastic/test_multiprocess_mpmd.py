@@ -194,8 +194,19 @@ def _kill(pid: int) -> None:
         pass
 
 
-@pytest.mark.parametrize("n_hosts", [2, 3])
-def test_multiprocess_mpmd_checkpoint_free_recovery(tmp_path, n_hosts):
+@pytest.mark.parametrize("n_hosts,model_name,model_args,recovery_budget", [
+    (2, "gpt2", TINY_MODEL, 60),
+    (3, "gpt2", TINY_MODEL, 60),
+    # Elastic MoE across hosts: switch-MoE decoder (tuple carry with the
+    # aux accumulator) through the same recovery machinery. The recovery
+    # budget is compile-bound on the CPU test mesh (MoE stage programs
+    # trace slowly); the 60 s BASELINE bound applies to TPU-class hardware
+    # with warm executable caches.
+    (2, "gpt2-moe-tiny", {}, 240),
+])
+def test_multiprocess_mpmd_checkpoint_free_recovery(tmp_path, n_hosts,
+                                                    model_name, model_args,
+                                                    recovery_budget):
     """n_hosts=2 exercises the degenerate single-survivor world (1-process
     collectives + own-mirror restore); n_hosts=3 exercises the REAL
     multi-survivor respawn: two survivors re-form a 2-process
@@ -210,8 +221,8 @@ def test_multiprocess_mpmd_checkpoint_free_recovery(tmp_path, n_hosts):
                  "node_ips": hosts},
         "job": {"microbatch_size": 2, "global_microbatch_size": 8,
                 "steps": STEPS},
-        "model": {"model_name": "gpt2", "dataset_path": "synthetic",
-                  "model_args": TINY_MODEL},
+        "model": {"model_name": model_name, "dataset_path": "synthetic",
+                  "model_args": model_args},
         # NO checkpoint_dir: recovery must come from live mirrors alone.
         "execution": {"engine_path": "mpmd",
                       "mirror_dir": str(tmp_path / "mirror"),
@@ -224,7 +235,8 @@ def test_multiprocess_mpmd_checkpoint_free_recovery(tmp_path, n_hosts):
         [sys.executable, "-c",
          "from oobleck_tpu.planning.profiler import profile\n"
          "from oobleck_tpu.config import ExecutionArguments\n"
-         f"profile('gpt2', {TINY_MODEL!r}, microbatch_size=2, seq_len=128,\n"
+         f"profile({model_name!r}, {model_args!r}, microbatch_size=2,\n"
+         "        seq_len=128,\n"
          "        execution=ExecutionArguments(engine_path='mpmd'))\n"],
         env=env, check=True, timeout=240, cwd=str(REPO),
     )
@@ -241,7 +253,7 @@ def test_multiprocess_mpmd_checkpoint_free_recovery(tmp_path, n_hosts):
                 cwd=str(REPO),
             )
         procs.append(master)
-        deadline = time.monotonic() + 420
+        deadline = time.monotonic() + 420 + recovery_budget
         _wait_for(r"master listening", log, deadline)
 
         subprocess.run(
@@ -298,7 +310,9 @@ def test_multiprocess_mpmd_checkpoint_free_recovery(tmp_path, n_hosts):
         m = _wait_for(rf"step (\d+)/{STEPS} loss ([\d.]+)", log, deadline,
                       after=offset)
         recovery_s = time.monotonic() - t_kill
-        assert recovery_s < 60, f"recovery took {recovery_s:.1f}s"
+        assert recovery_s < recovery_budget, (
+            f"recovery took {recovery_s:.1f}s (budget {recovery_budget})"
+        )
         assert int(m.group(1)) >= 2, "restored step regressed to scratch"
         assert float(m.group(2)) > 0
         print(f"mpmd checkpoint-free recovery ({n_hosts} hosts) "
