@@ -45,9 +45,15 @@ class ProcessComm:
     def __init__(self):
         self._mesh_cache: dict[tuple[int, ...], Mesh] = {}
         self._jit_cache: dict[tuple, Any] = {}
+        self._layout_cache: dict[tuple, "TypedFlatLayout"] = {}
         self._local_device = jax.local_devices()[0]
         self.process_index = jax.process_index()
         self.process_count = jax.process_count()
+        # Observability: bytes THIS process contributed to cross-process
+        # collectives (its row of each reduce; single-participant calls are
+        # local and count zero). The DP engine snapshots deltas per step so
+        # tests can assert the wire carries only what DP actually requires.
+        self.wire_bytes = 0
 
     # -- process meshes ------------------------------------------------- #
 
@@ -63,29 +69,32 @@ class ProcessComm:
         return self._mesh_cache[participants]
 
     def _reduce_device(self, local_vec, length: int,
-                       participants: Sequence[int], op: str):
+                       participants: Sequence[int], op: str,
+                       dtype=jnp.float32):
         """Shared machinery: stack per-process rows, reduce over `proc`.
-        Accepts a host OR device f32 vector; returns the reduced vector as
-        a DEVICE array on this process's local device (no host round-trip
-        on the receive side)."""
+        Accepts a host OR device vector (cast to `dtype` — the WIRE dtype:
+        bf16 edges ride as bf16, f32 grads as f32); returns the reduced
+        vector as a DEVICE array on this process's local device (no host
+        round-trip on the receive side)."""
         participants = tuple(sorted(participants))
         assert self.process_index in participants, (
             f"process {self.process_index} is not in {participants}"
         )
         if len(participants) == 1:
             return jax.device_put(
-                jnp.asarray(local_vec, jnp.float32), self._local_device
+                jnp.asarray(local_vec, dtype), self._local_device
             )
+        self.wire_bytes += length * np.dtype(dtype).itemsize
         mesh = self._mesh(participants)
         n = len(participants)
         sharding = NamedSharding(mesh, P("proc"))
         row = jax.device_put(
-            jnp.asarray(local_vec, jnp.float32)[None, :], self._local_device
+            jnp.asarray(local_vec, dtype)[None, :], self._local_device
         )
         garr = jax.make_array_from_single_device_arrays(
             (n, length), sharding, [row]
         )
-        key = (participants, n, length, op)
+        key = (participants, n, length, op, np.dtype(dtype).name)
         if key not in self._jit_cache:
             fn = {"sum": lambda a: a.sum(0), "min": lambda a: a.min(0)}[op]
             self._jit_cache[key] = jax.jit(
@@ -97,10 +106,14 @@ class ProcessComm:
     # -- public primitives ---------------------------------------------- #
 
     def group_sum(self, local_vec, length: int,
-                  participants: Sequence[int]) -> np.ndarray:
-        """Element-wise sum of each participant's f32 vector (all get it)."""
+                  participants: Sequence[int],
+                  dtype=jnp.float32) -> np.ndarray:
+        """Element-wise sum of each participant's vector (all get it).
+        `dtype` is the wire dtype — int32 lanes keep integer meta (step
+        counts, byte counts) exact where f32 would round past 2**24."""
         return np.asarray(
-            self._reduce_device(local_vec, length, participants, "sum")
+            self._reduce_device(local_vec, length, participants, "sum",
+                                dtype)
         )
 
     def group_min(self, local_vec, length: int,
@@ -110,11 +123,13 @@ class ProcessComm:
         )
 
     def group_sum_device(self, local_vec, length: int,
-                         participants: Sequence[int]):
+                         participants: Sequence[int], dtype=jnp.float32):
         """group_sum whose input AND output stay device arrays on this
         process's local device — the hot-path form (per-step gradient
-        allreduce) with no host staging on either side."""
-        return self._reduce_device(local_vec, length, participants, "sum")
+        allreduce) with no host staging on either side. `dtype` is the
+        wire dtype (native grad/activation width, not forced f32)."""
+        return self._reduce_device(local_vec, length, participants, "sum",
+                                   dtype)
 
     @property
     def local_device_sharding(self):
@@ -127,11 +142,16 @@ class ProcessComm:
         reference's stage-to-stage NCCL p2p (pipeline.py:288-333). `aval`
         is the static pytree of ShapeDtypeStructs (tuple carries — T5
         bridge, CLIP towers — flatten like any pytree); pack/unpack run on
-        device, so the bytes never stage through host numpy."""
-        leaf_avals = jax.tree.leaves(aval)
+        device, so the bytes never stage through host numpy. The wire
+        carries NATIVE dtypes (one flat vector per distinct leaf dtype):
+        bf16 activations cost bf16 bytes, and the receiver's zero
+        contribution keeps the sum bit-exact."""
+        sig = tuple((tuple(l.shape), str(l.dtype))
+                    for l in jax.tree.leaves(aval))
+        if sig not in self._layout_cache:
+            self._layout_cache[sig] = TypedFlatLayout({0: aval})
+        layout = self._layout_cache[sig]
         struct = jax.tree.structure(aval)
-        sizes = [int(np.prod(l.shape)) if l.shape else 1 for l in leaf_avals]
-        size = sum(sizes)
         if self.process_index == src:
             # Consolidate onto the local proc-mesh device (D2D within the
             # host), then fuse ravel/cast/concat in one jitted program.
@@ -139,77 +159,109 @@ class ProcessComm:
                 jax.tree.leaves(value),
                 jax.sharding.SingleDeviceSharding(self._local_device),
             )
-            key = ("pack", tuple((l.shape, str(l.dtype)) for l in leaf_avals))
+            key = ("pack", sig)
             if key not in self._jit_cache:
-                self._jit_cache[key] = jax.jit(lambda ls: jnp.concatenate(
-                    [l.ravel().astype(jnp.float32) for l in ls]
-                ))
-            flat = self._jit_cache[key](leaves)
+                self._jit_cache[key] = jax.jit(
+                    lambda ls: layout.pack_leaves(0, ls)
+                )
+            vecs = self._jit_cache[key](leaves)
         else:
-            flat = jnp.zeros(size, jnp.float32)
-        total = self._reduce_device(flat, size, (src, dst), "sum")
+            vecs = tuple(jnp.zeros(layout.lengths[dt], dt)
+                         for dt in layout.dtypes)
+        totals = tuple(
+            self._reduce_device(v, layout.lengths[dt], (src, dst), "sum", dt)
+            for v, dt in zip(vecs, layout.dtypes)
+        )
         if self.process_index == src:
             return None
-        key = ("unpack", tuple((l.shape, str(l.dtype)) for l in leaf_avals))
+        key = ("unpack", sig)
         if key not in self._jit_cache:
-            def unpack(f):
-                out, off = [], 0
-                for l, n in zip(leaf_avals, sizes):
-                    out.append(f[off:off + n].reshape(l.shape)
-                               .astype(l.dtype))
-                    off += n
-                return out
-            self._jit_cache[key] = jax.jit(unpack)
-        return jax.tree.unflatten(struct, self._jit_cache[key](total))
+            self._jit_cache[key] = jax.jit(
+                lambda vs: jax.tree.leaves(layout.unpack(vs, 0))
+            )
+        return jax.tree.unflatten(struct, self._jit_cache[key](totals))
 
 
 # ---------------------------------------------------------------------- #
 # Flat layouts for layer-keyed pytrees.
 
 
-class FlatLayout:
-    """Deterministic f32 flat layout for a {layer_index: pytree} mapping,
-    derived from abstract shapes only — every process computes the identical
-    layout without communicating (static shapes, the TPU discipline)."""
+class TypedFlatLayout:
+    """Native-dtype flat layout for a {layer_index: pytree} mapping: ONE
+    flat vector per distinct leaf dtype (bf16 leaves ride a bf16 vector,
+    f32 an f32 one — no f32 widening on the wire).
+    Derived from abstract shapes only, so every process computes the
+    identical layout without communicating. Non-arithmetic leaves (bool)
+    map to an int32 wire lane and cast back on unpack.
 
-    def __init__(self, avals_by_layer: dict[int, Any], extra: int = 0):
+    The reference keeps native dtypes trivially — NCCL allreduces each
+    tensor in place (engine.py:404-412); this is the packed-wire
+    equivalent for the flat process-mesh collectives."""
+
+    _WIRE = {np.dtype(np.bool_): np.dtype(np.int32)}
+
+    def __init__(self, avals_by_layer: dict[int, Any]):
         self.layers = sorted(avals_by_layer)
-        self.slices: dict[int, tuple[int, int]] = {}
         self.structs: dict[int, Any] = {}
+        # li -> [(shape, dtype, wire_dtype, offset_in_wire_vec, size)]
         self.leaf_metas: dict[int, list] = {}
-        off = 0
+        lengths: dict[Any, int] = {}
         for li in self.layers:
             leaves, struct = jax.tree.flatten(avals_by_layer[li])
-            metas = [(tuple(l.shape), l.dtype) for l in leaves]
-            size = sum(int(np.prod(s)) if s else 1 for s, _ in metas)
-            self.slices[li] = (off, size)
+            metas = []
+            for l in leaves:
+                dt = np.dtype(l.dtype)
+                wdt = self._WIRE.get(dt, dt)
+                n = int(np.prod(l.shape)) if l.shape else 1
+                off = lengths.get(wdt, 0)
+                metas.append((tuple(l.shape), l.dtype, wdt, off, n))
+                lengths[wdt] = off + n
             self.structs[li] = struct
             self.leaf_metas[li] = metas
-            off += size
-        self.param_length = off
-        self.extra = extra
-        self.length = off + extra
+        self.dtypes = tuple(sorted(lengths, key=lambda d: d.name))
+        self.lengths = lengths
 
-    def pack_into(self, buf: np.ndarray, li: int, tree) -> None:
-        off, size = self.slices[li]
-        flat = np.concatenate([
-            np.asarray(jax.device_get(l), np.float32).reshape(-1)
-            for l in jax.tree.leaves(tree)
-        ]) if jax.tree.leaves(tree) else np.zeros(0, np.float32)
-        assert flat.shape[0] == size, (li, flat.shape, size)
-        buf[off:off + size] += flat
+    @property
+    def wire_bytes(self) -> int:
+        """Bytes one process's full contribution occupies on the wire."""
+        return sum(n * dt.itemsize for dt, n in self.lengths.items())
 
-    def unpack(self, buf, li: int):
-        """Slice layer li's tree out of a flat buffer. Works on host numpy
-        AND under jit tracing (pure slicing/reshape/cast) — the device-side
-        unpack paths jit this same function."""
-        off, _ = self.slices[li]
+    def pack_leaves(self, li: int, leaves: list):
+        """Trace-pure: layer li's leaves -> per-dtype flat vectors (tuple
+        aligned with self.dtypes). Leaves must be full layers in layout
+        order; partial packing is not supported (offsets are cumulative)."""
+        segs: dict[Any, list] = {dt: [] for dt in self.dtypes}
+        for leaf, (shape, dtype, wdt, off, n) in zip(
+            leaves, self.leaf_metas[li], strict=True
+        ):
+            segs[wdt].append(jnp.ravel(leaf).astype(wdt))
+        return tuple(
+            jnp.concatenate(segs[dt]) if segs[dt]
+            else jnp.zeros(0, dt)
+            for dt in self.dtypes
+        )
+
+    def unpack(self, vecs, li: int):
+        """Layer li's tree out of per-dtype flat vectors (tuple aligned
+        with self.dtypes). Trace-pure (works on numpy and under jit)."""
+        by_dt = dict(zip(self.dtypes, vecs, strict=True))
         leaves = []
-        for shape, dtype in self.leaf_metas[li]:
-            n = int(np.prod(shape)) if shape else 1
-            leaves.append(buf[off:off + n].reshape(shape).astype(dtype))
-            off += n
+        for shape, dtype, wdt, off, n in self.leaf_metas[li]:
+            leaves.append(
+                by_dt[wdt][off:off + n].reshape(shape).astype(dtype)
+            )
         return jax.tree.unflatten(self.structs[li], leaves)
+
+    def pack_into(self, bufs: dict, li: int, tree) -> None:
+        """Host-side: write layer li's leaves into per-dtype numpy buffers
+        (keyed by wire dtype, sized self.lengths). Winner-unique packing —
+        assignment, not accumulation."""
+        for leaf, (shape, dtype, wdt, off, n) in zip(
+            jax.tree.leaves(tree), self.leaf_metas[li], strict=True
+        ):
+            bufs[wdt][off:off + n] = np.asarray(
+                jax.device_get(leaf)
+            ).ravel().astype(wdt)
 
 
 def layer_avals(model) -> dict[int, Any]:

@@ -150,6 +150,29 @@ def main() -> None:
                           / sum(w for _, w in per))
 
     out = {"losses": np.asarray(losses, np.float64)}
+    if multihost:
+        # Wire-traffic accounting (round-5 verdict #1): each step's DP
+        # collectives must carry exactly this process's DP-shared layer
+        # bytes (owner-subset psums, native dtype) plus the tiny loss psum
+        # — never the whole model.
+        me = comm.process_index
+        shared_bytes = sum(
+            layout.wire_bytes
+            for (procs, _), layout in zip(dp.groups, dp.layouts)
+            if me in procs
+        )
+        loss_bytes = 2 * len(pipelines) * 4
+        assert dp.last_wire_bytes == shared_bytes + loss_bytes, (
+            dp.last_wire_bytes, shared_bytes, loss_bytes)
+        out["wire_bytes"] = np.asarray([dp.last_wire_bytes], np.int64)
+        # A 1-pipeline plan has no DP-shared layers: its per-step DP wire
+        # traffic is the loss psum alone (the "1-pipeline-2-host plan
+        # transfers ~zero for DP" bar).
+        solo = MultiHostDataParallelEngine([pipe_a], model, comm)
+        solo_losses = ({0: local_losses[0]} if 0 in local_losses else {})
+        solo.allreduce(solo_losses)
+        assert solo.groups == [] and solo.last_wire_bytes == 2 * 4, (
+            solo.groups, solo.last_wire_bytes)
     for p in pipelines:
         for li, tree in p.params.items():
             for i, leaf in enumerate(jax.tree.leaves(tree)):
