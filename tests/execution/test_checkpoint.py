@@ -76,9 +76,9 @@ def test_engine_checkpoint_resume(cache_env, devices8, tmp_path):
 def test_live_mirror_roundtrip_bitwise(tmp_path, devices8):
     """The live-state mirror (checkpoint-free recovery's wire format) must
     roundtrip params AND optimizer state bitwise through the npz file +
-    FlatLayout pack/unpack, including the meta (step / data position).
-    Unit-level complement to the multi-process chain tests, which only
-    observe logs."""
+    TypedFlatLayout pack/unpack (native-dtype lanes, off-thread write),
+    including the meta (step / data position). Unit-level complement to
+    the multi-process chain tests, which only observe logs."""
     import os
 
     from oobleck_tpu.config import (
@@ -112,7 +112,18 @@ def test_live_mirror_roundtrip_bitwise(tmp_path, devices8):
         # Degenerate 1-process comm: the collective machinery shortcuts.
         engine.comm = ProcessComm()
         engine.multihost = True
+        import threading
+        import time as _time
+
+        t0 = _time.monotonic()
         engine._write_mirror()
+        enqueue_s = _time.monotonic() - t0
+        # Off-thread discipline: the step thread only snapshots references;
+        # the device_get + pack + npz write run on a background thread.
+        assert engine._mirror_thread is not threading.main_thread()
+        assert enqueue_s < 0.2, f"mirror enqueue blocked {enqueue_s:.3f}s"
+        engine._mirror_flush()
+        assert engine.mirror_write_s, "mirror write worker never ran"
 
         before_p, before_o = engine._collect_layer_state()
         restored = engine._try_restore_mirror()
