@@ -245,8 +245,12 @@ def profile_allreduce_in_node(model, chips_per_host: int) -> list[dict]:
 
     Measured when the chips are actually visible, modeled otherwise
     (cf. reference profile_allreduce_in_node, profiler.py:187-234).
+    LOCAL devices only — in a live jax.distributed world, jax.devices()
+    includes other hosts' chips, and an "in-node" mesh spanning processes
+    is both semantically wrong and a deadlock (profiling is per-process,
+    not lockstep; the peer never joins the collective).
     """
-    devices = jax.devices()
+    devices = jax.local_devices()
     rng = jax.random.PRNGKey(0)
     rows = []
     for idx in range(model.num_pipeline_layers):
@@ -267,7 +271,9 @@ def profile_allreduce_in_node(model, chips_per_host: int) -> list[dict]:
 
 def profile_allreduce_across_nodes(model, max_hosts: int) -> list[dict]:
     """Per-layer allreduce time across 1..max_hosts hosts (DCN model;
-    cf. reference profiler.py:141-185)."""
+    cf. reference profiler.py:141-185). Offline fallback — in a live
+    multi-host world the engine replaces these rows with MEASURED psums
+    over real process meshes (measure_allreduce_across_processes)."""
     rng = jax.random.PRNGKey(0)
     rows = []
     for idx in range(model.num_pipeline_layers):
@@ -277,6 +283,48 @@ def profile_allreduce_across_nodes(model, max_hosts: int) -> list[dict]:
             row[str(n)] = allreduce_time_model(pbytes, n, cross_host=True)
         rows.append(row)
     return rows
+
+
+def measure_allreduce_across_processes(comm, sizes_bytes: list[int],
+                                       iters: int = ITERS
+                                       ) -> dict[tuple[int, int], float]:
+    """MEASURED cross-host allreduce profile over a live jax.distributed
+    world: for each distinct byte size and each process-subset prefix
+    {0..n-1} (n = 2..P), time a real psum over the process mesh the DP
+    engine itself uses. The reference measures torch.distributed allreduce
+    across 1..N node groups and feeds the planner
+    (/root/reference/oobleck/planning/profiler.py:141-234); these are the
+    TPU/DCN equivalents, riding the same ProcessComm process-mesh
+    collectives as training.
+
+    COLLECTIVE: every process of `comm` must call with identical
+    `sizes_bytes` (processes >= n skip group n in lockstep — the same
+    total-order discipline the DP engine uses). Returns {(nbytes, n): ms}
+    complete only on processes < 2 (process 0 broadcasts its table via
+    _broadcast-style psum at the call site)."""
+    import numpy as np
+
+    P = comm.process_count
+    me = comm.process_index
+    table: dict[tuple[int, int], float] = {}
+    for nbytes in sorted(set(sizes_bytes)):
+        length = max(int(nbytes) // 4, 1)
+        for n in range(2, P + 1):
+            participants = tuple(range(n))
+            if me >= n:
+                continue
+            vec = np.zeros(length, np.float32)
+            # Warmup compiles the mesh program; then time synced rounds.
+            np.asarray(comm.group_sum_device(vec, length, participants))
+            t0 = time.perf_counter()
+            for _ in range(iters):
+                np.asarray(
+                    comm.group_sum_device(vec, length, participants)
+                )
+            table[(int(nbytes), n)] = (
+                (time.perf_counter() - t0) / iters * 1e3
+            )
+    return table
 
 
 def effective_tag(model_tag: str, execution=None) -> str:
@@ -368,8 +416,12 @@ def load_profile(model_name: str, model_tag: str, microbatch_size: int
             layer_index=i,
             forward=row["forward"],
             backward=row["backward"],
-            allreduce_in_host={int(k): v for k, v in ar_in[i].items()},
-            allreduce_across_hosts={int(k): v for k, v in ar_across[i].items()},
+            # Non-numeric keys are annotations (e.g. "measured": true on
+            # live-world rows), not host counts.
+            allreduce_in_host={int(k): v for k, v in ar_in[i].items()
+                               if str(k).isdigit()},
+            allreduce_across_hosts={int(k): v for k, v in ar_across[i].items()
+                                    if str(k).isdigit()},
             mem_params=row["mem_required"][0],
             mem_activation=row["mem_required"][1],
         ))
