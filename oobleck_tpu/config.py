@@ -78,13 +78,16 @@ class ExecutionArguments:
     """TPU-specific execution knobs (no reference counterpart).
 
     Every knob here is consumed by the engine:
-      * MPMD path: `tensor_parallel`/`fsdp` factor each stage's chips into a
-        (fsdp, tensor) stage mesh; `num_stages` filters the feasible pipeline
-        templates; `precision`/`remat`/`attention_impl` override model config.
+      * MPMD path: `tensor_parallel`/`sequence_parallel`/`fsdp` factor each
+        stage's chips into a (fsdp, seq, tensor) stage mesh; `num_stages`
+        filters the feasible pipeline templates; `precision`/`remat`/
+        `attention_impl` override model config. Sequence parallelism in a
+        stage is Ulysses/ring over the stage-local `seq` axis, so
+        long-context and elastic heterogeneous pipelines compose.
       * Fused path (`engine_path: fused`, or `auto` with
         sequence_parallel > 1): one global mesh
         (data, stage, fsdp, seq, tensor) runs the compiled SPMD train step
-        (parallel/train.py) — required for sequence parallelism.
+        (parallel/train.py).
     """
 
     # Which execution path drives training: "mpmd" (per-stage jits +
@@ -134,13 +137,11 @@ class ExecutionArguments:
                 "attention_impl must be auto|xla|pallas|ring|ulysses, got "
                 f"{self.attention_impl!r}"
             )
-        if self.sequence_parallel > 1 and self.engine_path == "mpmd":
-            raise ValueError(
-                "sequence_parallel > 1 requires the fused path "
-                "(engine_path: auto or fused)"
-            )
 
     def resolved_path(self) -> str:
+        # auto: fused is still the default home for sequence parallelism
+        # (single compiled program); explicit `engine_path: mpmd` +
+        # sequence_parallel > 1 runs seq-parallel stage meshes instead.
         if self.engine_path != "auto":
             return self.engine_path
         return "fused" if self.sequence_parallel > 1 else "mpmd"

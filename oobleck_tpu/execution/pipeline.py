@@ -125,6 +125,7 @@ class StageRuntime:
     param_shardings: dict[int, Any]        # layer -> NamedSharding tree
     param_pspecs: dict[int, Any]           # layer -> PartitionSpec tree
     tp: int = 1                            # tensor-parallel degree in-stage
+    sp: int = 1                            # sequence-parallel degree in-stage
     use_fsdp: bool = False                 # params + batch sharded over fsdp
     manual: bool = True                    # model has the ShardCtx path
     needs_batch: bool = True               # any layer here reads the batch
@@ -142,14 +143,19 @@ class StageRuntime:
         embed/apply_block/head_loss_shifted contract the manual shard_map
         program calls; every other family runs the generic apply_layer
         program, where GSPMD handles any batch sharding (use_fsdp then means
-        within-stage data parallelism with replicated params)."""
-        if not self.manual or (self.tp == 1 and not self.use_fsdp):
+        within-stage data parallelism with replicated params). With sp > 1
+        the stage's activations are sharded over a `seq` axis and attention
+        runs Ulysses/ring inside the stage mesh — long-context composed
+        with elastic pipelines (round-4 weak #5)."""
+        if not self.manual or (self.tp == 1 and not self.use_fsdp
+                               and self.sp == 1):
             return None
         from oobleck_tpu.models.gpt import ShardCtx
 
         return ShardCtx(
             tensor="tensor" if self.tp > 1 else None,
             fsdp="fsdp" if self.use_fsdp else None,
+            seq="seq" if self.sp > 1 else None,
         )
 
 
@@ -170,6 +176,7 @@ class PipelineInstance:
         params: dict[int, Any] | None = None,
         exec_cache: dict | None = None,
         tensor_parallel: int = 1,
+        sequence_parallel: int = 1,
         fsdp: int = -1,
         process_of_rank: list[int] | None = None,
         comm=None,
@@ -195,18 +202,40 @@ class PipelineInstance:
         my_process = comm.process_index if comm is not None else None
 
         tp = max(1, tensor_parallel)
-        if tp > 1:
+        sp = max(1, sequence_parallel)
+        if tp > 1 or sp > 1:
             cfg = model.config
             if not hasattr(model, "head_loss_shifted"):
                 raise ValueError(
-                    f"{type(model).__name__} has no manual-TP support "
-                    "(head_loss_shifted); set tensor_parallel=1"
+                    f"{type(model).__name__} has no manual-collective "
+                    "support (head_loss_shifted); set tensor_parallel=1 "
+                    "and sequence_parallel=1"
                 )
-            if cfg.num_heads % tp != 0:
+            if tp > 1 and cfg.num_heads % tp != 0:
                 raise ValueError(
                     f"num_heads={cfg.num_heads} not divisible by "
                     f"tensor_parallel={tp}"
                 )
+        if sp > 1:
+            cfg = model.config
+            if seq_len % sp != 0:
+                raise ValueError(
+                    f"seq_len={seq_len} not divisible by "
+                    f"sequence_parallel={sp}"
+                )
+            # Ulysses runs on TP-LOCAL heads (H/tp), and ALiBi models
+            # auto-route to it (ring cannot carry position-dependent
+            # bias, models/gpt.py attention_sublayer).
+            uses_ulysses = (
+                getattr(cfg, "attention_impl", "auto") == "ulysses"
+                or getattr(cfg, "position_embedding", "learned") == "alibi"
+            )
+            if uses_ulysses and (cfg.num_heads // tp) % sp != 0:
+                raise ValueError(
+                    f"ulysses needs TP-local heads divisible by the seq "
+                    f"axis: ({cfg.num_heads} // tp={tp}) % sp={sp} != 0"
+                )
+        self.sp = sp
 
         # Per-layer PartitionSpec trees. Families with manual-TP sharding
         # rules (gpt/llama) declare them via param_specs; everything else
@@ -259,19 +288,20 @@ class PipelineInstance:
             stage_ranks = tuple(self.ranks[cursor:cursor + stage.num_chips])
             cursor += stage.num_chips
             stage_devices = np.array([devices[r] for r in stage_ranks])
-            if stage.num_chips % tp != 0:
+            if stage.num_chips % (tp * sp) != 0:
                 raise ValueError(
                     f"stage {si} has {stage.num_chips} chips, not divisible "
-                    f"by tensor_parallel={tp}"
+                    f"by tensor_parallel*sequence_parallel={tp}*{sp}"
                 )
-            # fsdp semantics: -1 auto (shard over the chips/tp remainder when
-            # the microbatch allows, else replicate), 1 = never shard params,
-            # N = must equal chips/tp and be honorable or it's an error.
-            fsdp_deg = stage.num_chips // tp
+            # fsdp semantics: -1 auto (shard over the chips/(tp*sp)
+            # remainder when the microbatch allows, else replicate), 1 =
+            # never shard params, N = must equal chips/(tp*sp) and be
+            # honorable or it's an error.
+            fsdp_deg = stage.num_chips // (tp * sp)
             if fsdp not in (-1, 1, fsdp_deg):
                 raise ValueError(
-                    f"stage {si}: fsdp={fsdp} requested but chips/tp = "
-                    f"{stage.num_chips}/{tp} = {fsdp_deg}"
+                    f"stage {si}: fsdp={fsdp} requested but chips/(tp*sp) = "
+                    f"{stage.num_chips}/{tp * sp} = {fsdp_deg}"
                 )
             use_fsdp = (
                 fsdp != 1 and fsdp_deg > 1
@@ -288,8 +318,11 @@ class PipelineInstance:
                     "not divisible by fsdp degree %d)",
                     si, stage.num_chips, microbatch_size, fsdp_deg,
                 )
+            # Axis order (fsdp, seq, tensor): tensor innermost (highest-
+            # bandwidth collectives on neighboring chips), seq between.
             mesh = Mesh(
-                stage_devices.reshape(fsdp_deg, tp), ("fsdp", "tensor")
+                stage_devices.reshape(fsdp_deg, sp, tp),
+                ("fsdp", "seq", "tensor"),
             )
             generic_specs = hasattr(model, "generic_param_specs")
             keep = frozenset(
@@ -302,7 +335,13 @@ class PipelineInstance:
                     ("tensor", tp > 1),
                 ) if on
             )
-            batch_spec = P("fsdp") if use_fsdp else P(None)
+            # sp > 1 (manual causal-LM only): tokens [B, S] shard S over
+            # `seq`. The 1-entry spec stays for generic families whose
+            # batch fields can be 1-d (labels [B]).
+            batch_spec = (
+                P("fsdp" if use_fsdp else None, "seq") if sp > 1
+                else P("fsdp") if use_fsdp else P(None)
+            )
             param_shardings: dict[int, Any] = {}
             param_pspecs: dict[int, Any] = {}
             for li in stage.layer_indices:
@@ -353,6 +392,7 @@ class PipelineInstance:
                 param_shardings=param_shardings,
                 param_pspecs=param_pspecs,
                 tp=tp,
+                sp=sp,
                 use_fsdp=use_fsdp,
                 manual=manual,
                 needs_batch=bool(batch_layers & set(stage.layer_indices)),
@@ -443,12 +483,16 @@ class PipelineInstance:
         # fall out of the shard_map in_spec transposes.
         is_first = st.layer_ids[0] == 0
         is_last = st.layer_ids[-1] == last_layer
-        batch_axes = ("fsdp",) if ctx.fsdp else ()
+        batch_axes = (
+            (("fsdp",) if ctx.fsdp else ())
+            + (("seq",) if ctx.seq else ())
+        )
         block_fn = lambda p, x: model.apply_block(p, x, ctx)
         block = jax.checkpoint(block_fn) if remat else block_fn
         denom = float(self.microbatch_size * (self.seq_len - 1))
-        x_spec = P("fsdp" if st.use_fsdp else None, None, None)
-        tok_spec = P("fsdp" if st.use_fsdp else None, None)
+        seq_ax = "seq" if st.sp > 1 else None
+        x_spec = P("fsdp" if st.use_fsdp else None, seq_ax, None)
+        tok_spec = P("fsdp" if st.use_fsdp else None, seq_ax)
 
         def core(*ops):
             it = iter(ops)
@@ -519,7 +563,7 @@ class PipelineInstance:
             key = (
                 st.layer_ids, len(st.ranks), tuple(st.ranks),
                 self.microbatch_size, self.seq_len, is_first, is_last,
-                self.total_num_microbatches, st.tp, st.use_fsdp,
+                self.total_num_microbatches, st.tp, st.sp, st.use_fsdp,
             )
             if key in self._exec_cache:
                 st.fwd, st.bwd, st.efwd = self._exec_cache[key]
@@ -649,11 +693,24 @@ class PipelineInstance:
         def params_of(st):
             return tuple(self.params[li] for li in st.layer_ids)
 
+        # Microbatch gradient accumulation as ONE jitted add per stage per
+        # microbatch (jit specializes per treedef/shape/sharding): eager
+        # per-leaf jnp.add over multi-chip-sharded stages is a dispatch
+        # storm — same disease the jitted optimizer update cures, observed
+        # as the round-5 elastic-MoE recovery "hang".
+        add_fn = self._exec_cache.get("grad_add")
+        if add_fn is None:
+            add_fn = jax.jit(lambda a, b: jax.tree.map(jnp.add, a, b))
+            self._exec_cache["grad_add"] = add_fn
+
         def accumulate(st, stage_grads):
-            for li, g in zip(st.layer_ids, stage_grads):
-                if li in grads:
-                    grads[li] = jax.tree.map(jnp.add, grads[li], g)
-                else:
+            if st.layer_ids[0] in grads:
+                prev = tuple(grads[li] for li in st.layer_ids)
+                summed = add_fn(prev, tuple(stage_grads))
+                for li, g in zip(st.layer_ids, summed):
+                    grads[li] = g
+            else:
+                for li, g in zip(st.layer_ids, stage_grads):
                     grads[li] = g
 
         def execute(ins: Instruction) -> None:
@@ -766,14 +823,30 @@ class PipelineInstance:
 
     def apply_updates(self, optimizer, opt_state: dict[int, Any],
                       synced_grads: dict[int, Any]) -> dict[int, Any]:
-        """Per-layer optimizer step with (possibly DP-synced) grads."""
+        """Per-layer optimizer step with (possibly DP-synced) grads.
+
+        The update runs as ONE jitted program per layer signature (jax.jit
+        specializes per input shapes/shardings internally). Eager optax is
+        catastrophic on multi-chip stages: global-norm clipping dispatches
+        one tiny program PER LEAF over sharded arrays — on a 2-chip
+        expert-sharded MoE stage under jax.distributed that turned a step
+        into minutes of collective-compile churn (the round-5 elastic-MoE
+        recovery hang). No donation: live-mirror snapshots hold references
+        to the pre-step arrays (engine._write_mirror), which donation
+        would invalidate."""
+        fn = self._exec_cache.get(("opt_update", id(optimizer)))
+        if fn is None:
+            def upd(g, state, p, _opt=optimizer):
+                updates, new_state = _opt.update(g, state, p)
+                return optax.apply_updates(p, updates), new_state
+
+            fn = jax.jit(upd)
+            self._exec_cache[("opt_update", id(optimizer))] = fn
         new_state = dict(opt_state)
         for li in self.params:
-            g = synced_grads[li]
-            updates, new_state[li] = optimizer.update(
-                g, opt_state[li], self.params[li]
+            self.params[li], new_state[li] = fn(
+                synced_grads[li], opt_state[li], self.params[li]
             )
-            self.params[li] = optax.apply_updates(self.params[li], updates)
         return new_state
 
     def init_opt_state(self, optimizer) -> dict[int, Any]:

@@ -310,25 +310,27 @@ class GPTModel:
                 # unchanged, which the ring layout cannot offer.
                 from oobleck_tpu.ops.ulysses import ulysses_attention
 
-                bias = None
+                slopes = None
                 if c.position_embedding == "alibi":
-                    from oobleck_tpu.ops.attention import alibi_bias
+                    from oobleck_tpu.ops.attention import alibi_slopes
 
-                    s_global = qkv.shape[3] * lax.psum(1, ctx.seq)
-                    full = alibi_bias(c.num_heads, s_global, s_global)
-                    # TP-local head slice first (qkv holds Hl = H/tp heads,
-                    # like the non-SP branch below); ulysses then slices
-                    # its seq-rank's block out of the Hl heads.
+                    # Slopes only ([Hl] after the TP-local slice) — never
+                    # the [H, S, S] bias: ulysses materializes its own
+                    # seq-shard's [Hl/P, S, S] block after the head
+                    # all_to_all, the only part this device attends with
+                    # (round-4 advisor: full-bias was O(H S^2) HBM/device).
+                    full = alibi_slopes(c.num_heads)
                     h_local = qkv.shape[2]
                     if ctx.tensor:
                         start = ctx.tp_rank() * h_local
-                        bias = lax.dynamic_slice_in_dim(
+                        slopes = lax.dynamic_slice_in_dim(
                             full, start, h_local, axis=0
                         )
                     else:
-                        bias = full
+                        slopes = full
                 attn_out = ulysses_attention(
-                    qkv[0], qkv[1], qkv[2], axis_name=ctx.seq, bias=bias,
+                    qkv[0], qkv[1], qkv[2], axis_name=ctx.seq,
+                    alibi_slopes=slopes,
                 )
             else:
                 from oobleck_tpu.ops.ring_attention import ring_attention

@@ -67,13 +67,20 @@ def alibi_slopes(num_heads: int) -> jax.Array:
     return jnp.asarray(s, jnp.float32)
 
 
-def alibi_bias(num_heads: int, seq_q: int, seq_k: int) -> jax.Array:
-    """[H, Sq, Sk] ALiBi bias: slope * -(q_pos - k_pos) for k <= q."""
-    slopes = alibi_slopes(num_heads)
+def alibi_bias_from_slopes(slopes: jax.Array, seq_q: int,
+                           seq_k: int) -> jax.Array:
+    """[h, Sq, Sk] ALiBi bias for the GIVEN slopes only — callers holding a
+    head slice (TP rank, Ulysses shard) materialize h=H_local rows instead
+    of all H (the O(H S^2) buffer is the long-context memory hazard)."""
     q_pos = jnp.arange(seq_q)[:, None] + (seq_k - seq_q)
     k_pos = jnp.arange(seq_k)[None, :]
     dist = (q_pos - k_pos).astype(jnp.float32)
     return -slopes[:, None, None] * dist[None]
+
+
+def alibi_bias(num_heads: int, seq_q: int, seq_k: int) -> jax.Array:
+    """[H, Sq, Sk] ALiBi bias: slope * -(q_pos - k_pos) for k <= q."""
+    return alibi_bias_from_slopes(alibi_slopes(num_heads), seq_q, seq_k)
 
 
 @functools.cache

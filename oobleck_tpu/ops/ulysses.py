@@ -25,16 +25,25 @@ from jax import lax
 
 def ulysses_attention(q: jax.Array, k: jax.Array, v: jax.Array, *,
                       axis_name: str, scale: float | None = None,
-                      bias: jax.Array | None = None, causal: bool = True,
+                      bias: jax.Array | None = None,
+                      alibi_slopes: jax.Array | None = None,
+                      causal: bool = True,
                       inner_impl: str = "auto") -> jax.Array:
     """All-to-all attention over a sequence-parallel mesh axis.
 
     `bias` is the FULL-sequence bias ([H, S, S] or broadcastable), sliced
-    per-device to the local heads here; `inner_impl` picks the
-    single-device kernel for the full-sequence attention (the Pallas flash
-    path on TPU).
+    per-device to the local heads here. Position-only ALiBi should come in
+    as `alibi_slopes` ([H] for the local input heads) instead: the bias is
+    then materialized ONLY for this device's H/P heads ([H/P, S, S]) after
+    the head slice — passing a pre-built [H, S, S] bias costs O(H S^2) HBM
+    per device, which defeats sequence parallelism at long S (round-4
+    advisor). The remaining [H/P, S, S] buffer bounds practical S for
+    alibi+ulysses until the flash kernel generates the bias in-kernel.
+    `inner_impl` picks the single-device kernel for the full-sequence
+    attention (the Pallas flash path on TPU).
     """
-    from oobleck_tpu.ops.attention import causal_attention
+    from oobleck_tpu.ops.attention import (
+        alibi_bias_from_slopes, causal_attention)
 
     P = lax.psum(1, axis_name)
     H = q.shape[1]
@@ -42,6 +51,8 @@ def ulysses_attention(q: jax.Array, k: jax.Array, v: jax.Array, *,
         raise ValueError(
             f"ulysses needs heads % axis size == 0, got {H} % {P}"
         )
+    if bias is not None and alibi_slopes is not None:
+        raise ValueError("pass bias OR alibi_slopes, not both")
 
     def seq_to_heads(x):
         # [B, H, S/P, D] -> [B, H/P, S, D]: each device keeps H/P heads of
@@ -50,13 +61,19 @@ def ulysses_attention(q: jax.Array, k: jax.Array, v: jax.Array, *,
                               tiled=True)
 
     qh, kh, vh = seq_to_heads(q), seq_to_heads(k), seq_to_heads(v)
+    per = H // P
+    idx = lax.axis_index(axis_name)
     local_bias = bias
-    if bias is not None and bias.ndim >= 3 and bias.shape[-3] == H:
+    if alibi_slopes is not None:
+        s_global = qh.shape[2]
+        local_slopes = lax.dynamic_slice_in_dim(
+            alibi_slopes, idx * per, per, axis=0
+        )
+        local_bias = alibi_bias_from_slopes(local_slopes, s_global, s_global)
+    elif bias is not None and bias.ndim >= 3 and bias.shape[-3] == H:
         # Per-head bias over global heads: tiled all_to_all hands device i
         # heads [i*H/P, (i+1)*H/P), so slice its block; head-broadcast
         # biases (dim 1 or ndim<3) pass through unchanged.
-        idx = lax.axis_index(axis_name)
-        per = H // P
         local_bias = lax.dynamic_slice_in_dim(bias, idx * per, per, axis=-3)
     out = causal_attention(qh, kh, vh, impl=inner_impl, scale=scale,
                            bias=local_bias, causal=causal,

@@ -130,6 +130,43 @@ def test_pipeline_fsdp_stage(model, batch, devices8):
     assert len(wqkv.sharding.device_set) == 4
 
 
+def test_pipeline_seq_parallel_stage(model, batch, devices8):
+    """Sequence parallelism INSIDE elastic MPMD stages (round-4 weak #5:
+    'elastic and long-context are mutually exclusive'): a 2-stage pipeline
+    whose stages are 2-chip (fsdp=1, seq=2, tensor=1) meshes runs ring/
+    Ulysses attention over the stage-local `seq` axis and must match both
+    the sp=1 pipeline and the fused single-device loss."""
+    template = make_template([(0, 3), (3, 6)], [2, 2], chips_per_host=2)
+    expected, _ = reference_loss_and_grads(model, batch)
+
+    sp_pipe = PipelineInstance(
+        pipeline_id=0, template=template,
+        ranks=list(range(template.num_chips)), model=model,
+        devices=devices8, num_microbatches=NUM_MB,
+        total_num_microbatches=NUM_MB, microbatch_size=MB, seq_len=SEQ,
+        sequence_parallel=2,
+    )
+    for st in sp_pipe.stages:
+        assert dict(st.mesh.shape)["seq"] == 2
+        assert st.ctx is not None and st.ctx.seq == "seq"
+    sp_loss = float(sp_pipe.train_step(batch))
+
+    base_pipe, base_loss = _run_pipeline(
+        model, batch, make_template([(0, 3), (3, 6)], [1, 1]), devices8
+    )
+    assert sp_loss == pytest.approx(base_loss, rel=1e-2)
+    assert sp_loss == pytest.approx(float(expected), rel=2e-2)
+    # Gradients agree layerwise with the sp=1 interpreter (params are
+    # replicated over `seq`; reductions fall out of the shard_map AD).
+    got = sp_pipe.grads[1]
+    want = base_pipe.grads[1]
+    for a, b in zip(jax.tree.leaves(got), jax.tree.leaves(want)):
+        np.testing.assert_allclose(
+            np.asarray(a, np.float32), np.asarray(b, np.float32),
+            rtol=5e-2, atol=5e-3,
+        )
+
+
 def test_optimizer_step_changes_params(model, batch, devices8):
     from oobleck_tpu.parallel.train import make_optimizer
 
