@@ -236,6 +236,46 @@ _IMAGENET_MEAN = np.array([0.485, 0.456, 0.406], np.float32)
 _IMAGENET_STD = np.array([0.229, 0.224, 0.225], np.float32)
 
 
+def transform_image(img, size: int, train: bool, rng) -> np.ndarray:
+    """The reference's image transform semantics (dataset.py:88-148):
+    RandomResizedCrop (area [0.08, 1], aspect [3/4, 4/3]) + horizontal flip
+    for train, Resize-shortest-edge + CenterCrop for eval, both
+    ImageNet-normalized — PIL + numpy instead of torchvision, deterministic
+    under the caller's rng. Shared by the classification and contrastive
+    HF loaders."""
+    from PIL import Image
+
+    if not isinstance(img, Image.Image):
+        img = Image.fromarray(np.asarray(img))
+    img = img.convert("RGB")
+    if train:
+        w, h = img.size
+        for _ in range(10):
+            area = w * h * rng.uniform(0.08, 1.0)
+            aspect = np.exp(rng.uniform(np.log(3 / 4), np.log(4 / 3)))
+            cw = int(round(np.sqrt(area * aspect)))
+            ch = int(round(np.sqrt(area / aspect)))
+            if cw <= w and ch <= h:
+                x0 = int(rng.integers(0, w - cw + 1))
+                y0 = int(rng.integers(0, h - ch + 1))
+                img = img.crop((x0, y0, x0 + cw, y0 + ch))
+                break
+        img = img.resize((size, size), Image.BILINEAR)
+        if rng.random() < 0.5:
+            img = img.transpose(Image.FLIP_LEFT_RIGHT)
+    else:
+        w, h = img.size
+        scale = size / min(w, h)
+        img = img.resize((max(size, int(round(w * scale))),
+                          max(size, int(round(h * scale)))),
+                         Image.BILINEAR)
+        w, h = img.size
+        x0, y0 = (w - size) // 2, (h - size) // 2
+        img = img.crop((x0, y0, x0 + size, y0 + size))
+    arr = np.asarray(img, np.float32) / 255.0
+    return (arr - _IMAGENET_MEAN) / _IMAGENET_STD
+
+
 class HFImageDataset:
     """HF image-classification datasets from the local cache with the
     reference's transform semantics (reference create_image_dataset,
@@ -277,45 +317,115 @@ class HFImageDataset:
         return len(self.ds)
 
     def __getitem__(self, idx: int) -> dict:
-        from PIL import Image
-
         row = self.ds[int(idx)]
-        img = row[self.image_col]
-        if not isinstance(img, Image.Image):
-            img = Image.fromarray(np.asarray(img))
-        img = img.convert("RGB")
-        size = self.image_size
         rng = np.random.default_rng((self.seed, self.epoch, idx))
-        if self.train:
-            # RandomResizedCrop: area in [0.08, 1.0], aspect in [3/4, 4/3].
-            w, h = img.size
-            for _ in range(10):
-                area = w * h * rng.uniform(0.08, 1.0)
-                aspect = np.exp(rng.uniform(np.log(3 / 4), np.log(4 / 3)))
-                cw = int(round(np.sqrt(area * aspect)))
-                ch = int(round(np.sqrt(area / aspect)))
-                if cw <= w and ch <= h:
-                    x0 = int(rng.integers(0, w - cw + 1))
-                    y0 = int(rng.integers(0, h - ch + 1))
-                    img = img.crop((x0, y0, x0 + cw, y0 + ch))
-                    break
-            img = img.resize((size, size), Image.BILINEAR)
-            if rng.random() < 0.5:
-                img = img.transpose(Image.FLIP_LEFT_RIGHT)
-        else:
-            # Resize shortest edge, center crop.
-            w, h = img.size
-            scale = size / min(w, h)
-            img = img.resize((max(size, int(round(w * scale))),
-                              max(size, int(round(h * scale)))),
-                             Image.BILINEAR)
-            w, h = img.size
-            x0, y0 = (w - size) // 2, (h - size) // 2
-            img = img.crop((x0, y0, x0 + size, y0 + size))
-        arr = np.asarray(img, np.float32) / 255.0
-        arr = (arr - _IMAGENET_MEAN) / _IMAGENET_STD
+        arr = transform_image(row[self.image_col], self.image_size,
+                              self.train, rng)
         return {"pixel_values": arr,
                 "labels": np.int32(row[self.label_col])}
+
+
+_TEXT_COLS = ("caption", "captions", "text", "sentence", "sentences")
+
+
+class HFImageTextDataset:
+    """Paired image/caption contrastive data (CLIP) from a locally-cached
+    HF dataset OR a local imagefolder directory (images + metadata.jsonl
+    with a caption column — the standard HF pairing layout). Matches the
+    reference's real image pipeline semantics for the vision side
+    (/root/reference/oobleck/execution/dataset.py:88-148: RandomResizedCrop
+    + flip, normalized) and tokenizes captions to fixed seq_length.
+
+    Tokenization: AutoTokenizer when one is cached locally; otherwise a
+    deterministic hash word tokenizer into [2, vocab_size) (documented
+    offline deviation from the reference's HF processor — zero-egress
+    environments may have no cached tokenizer at all). Multiple captions
+    per image pick one per (idx, epoch), like collate-time caption
+    sampling."""
+
+    def __init__(self, dataset_path: str, dataset_name: str | None,
+                 image_size: int, vocab_size: int, seq_length: int,
+                 tokenizer_name: str | None = None, split: str = "train",
+                 train: bool = True, seed: int = 42):
+        import os
+
+        os.environ.setdefault("HF_HUB_OFFLINE", "1")
+        os.environ.setdefault("HF_DATASETS_OFFLINE", "1")
+        try:
+            from datasets import load_dataset
+        except ImportError as e:
+            raise RuntimeError(f"HF datasets unavailable: {e}") from e
+        try:
+            if os.path.isdir(dataset_path):
+                self.ds = load_dataset("imagefolder",
+                                       data_dir=dataset_path, split=split)
+            else:
+                self.ds = load_dataset(dataset_path, dataset_name,
+                                       split=split)
+        except Exception as e:
+            raise RuntimeError(
+                f"could not load paired dataset {dataset_path}/"
+                f"{dataset_name} split={split} from local cache "
+                f"(offline env): {e}"
+            ) from e
+        cols = self.ds.column_names
+        self.image_col = "image" if "image" in cols else "img"
+        try:
+            self.text_col = next(c for c in _TEXT_COLS if c in cols)
+        except StopIteration:
+            raise RuntimeError(
+                f"no caption column in {cols}; contrastive pairs need one "
+                f"of {_TEXT_COLS}"
+            ) from None
+        self.tok = None
+        if tokenizer_name:
+            try:
+                from transformers import AutoTokenizer
+
+                self.tok = AutoTokenizer.from_pretrained(tokenizer_name)
+            except Exception:
+                self.tok = None  # hash fallback below
+        self.image_size = image_size
+        self.vocab_size = vocab_size
+        self.seq_length = seq_length
+        self.train = train
+        self.seed = seed
+        self.epoch = 0
+
+    def set_epoch(self, epoch: int) -> None:
+        self.epoch = epoch
+
+    def __len__(self) -> int:
+        return len(self.ds)
+
+    def _tokenize(self, text: str) -> np.ndarray:
+        L = self.seq_length
+        if self.tok is not None:
+            ids = self.tok(text, truncation=True, max_length=L)["input_ids"]
+            ids = [i % self.vocab_size for i in ids]
+        else:
+            # Deterministic hash word-piece fallback: stable across
+            # processes (heterogeneous pipelines need rank-independence),
+            # reserving 0/1 for pad/unk.
+            ids = [
+                2 + int(hashlib.blake2s(w.lower().encode(),
+                                        digest_size=4).hexdigest(), 16)
+                % max(self.vocab_size - 2, 1)
+                for w in text.split()[:L]
+            ]
+        out = np.zeros(L, np.int32)
+        out[: len(ids)] = np.asarray(ids[:L], np.int32)
+        return out
+
+    def __getitem__(self, idx: int) -> dict:
+        row = self.ds[int(idx)]
+        rng = np.random.default_rng((self.seed, self.epoch, idx))
+        arr = transform_image(row[self.image_col], self.image_size,
+                              self.train, rng)
+        text = row[self.text_col]
+        if isinstance(text, (list, tuple)):  # multi-caption: sample one
+            text = text[int(rng.integers(0, len(text)))]
+        return {"pixel_values": arr, "input_ids": self._tokenize(str(text))}
 
 
 def build_dataset(dataset_path: str, dataset_name: str | None, *,
@@ -338,14 +448,16 @@ def build_dataset(dataset_path: str, dataset_name: str | None, *,
         # (zero-egress: a cache miss raises inside HFImageDataset).
         return HFImageDataset(dataset_path, dataset_name, image_size)
     if data_kind == "contrastive":
-        if dataset_path not in ("synthetic", "", None):
-            raise RuntimeError(
-                "contrastive training needs paired image/text data; no "
-                "HF pair loader is wired in this offline environment — "
-                "use dataset_path: synthetic"
-            )
-        return SyntheticImageTextDataset(image_size, num_classes, vocab_size,
-                                         seq_length, num_channels, num_samples)
+        if dataset_path in ("synthetic", "", None):
+            return SyntheticImageTextDataset(
+                image_size, num_classes, vocab_size, seq_length,
+                num_channels, num_samples)
+        # Real paired image/caption data: a cached HF dataset or a local
+        # imagefolder (images + metadata.jsonl captions), with the
+        # reference's image transform semantics (dataset.py:88-148).
+        return HFImageTextDataset(dataset_path, dataset_name, image_size,
+                                  vocab_size, seq_length,
+                                  tokenizer_name=model_name)
     if dataset_path in ("synthetic", "", None):
         base = SyntheticTextDataset(vocab_size, seq_length, num_samples)
     else:

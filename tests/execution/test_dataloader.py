@@ -131,6 +131,66 @@ def test_loader_feeds_epoch_to_dataset():
     assert masks == [0, 0, 1, 1]
 
 
+def make_imagefolder(root, n=16, caption_list=False):
+    """Tiny local HF imagefolder with caption metadata — the standard
+    offline layout for paired image/text data (images + metadata.jsonl)."""
+    import json
+
+    from PIL import Image
+
+    d = root / "train"
+    d.mkdir(parents=True)
+    rng = np.random.default_rng(0)
+    with open(d / "metadata.jsonl", "w") as f:
+        for i in range(n):
+            name = f"img{i}.png"
+            arr = rng.integers(0, 255, (40, 48, 3)).astype(np.uint8)
+            Image.fromarray(arr).save(d / name)
+            cap = f"a photo of a class {i % 4} object"
+            meta = {"file_name": name,
+                    "caption": [cap, cap + " indoors"] if caption_list
+                    else cap}
+            f.write(json.dumps(meta) + "\n")
+    return root
+
+
+def test_hf_image_text_pairs(tmp_path):
+    """Real paired image/caption loading (round-4 missing #3): reference
+    transform semantics on the vision side, fixed-length tokenized
+    captions, per-(idx, epoch) determinism, multi-caption sampling."""
+    from oobleck_tpu.execution.dataset import HFImageTextDataset
+
+    root = make_imagefolder(tmp_path / "pairs", n=8, caption_list=True)
+    ds = HFImageTextDataset(str(root), None, image_size=32, vocab_size=64,
+                            seq_length=8)
+    assert len(ds) == 8
+    row = ds[0]
+    assert row["pixel_values"].shape == (32, 32, 3)
+    assert row["input_ids"].shape == (8,)
+    assert row["input_ids"].dtype == np.int32
+    assert (row["input_ids"] >= 0).all() and (row["input_ids"] < 64).all()
+    assert (row["input_ids"] > 0).any(), "caption tokenized to nothing"
+    # Deterministic per (idx, epoch) — rank-independence for heterogeneous
+    # pipelines; a new epoch re-crops (dynamic augmentation).
+    again = ds[0]
+    np.testing.assert_array_equal(row["input_ids"], again["input_ids"])
+    np.testing.assert_array_equal(row["pixel_values"], again["pixel_values"])
+    ds.set_epoch(1)
+    assert not np.array_equal(row["pixel_values"], ds[0]["pixel_values"])
+    # Same caption prefix -> same leading tokens (hash tokenizer is stable).
+    assert (ds[0]["input_ids"][:4] == ds[4]["input_ids"][:4]).all()
+
+
+def test_build_dataset_contrastive_hf_path(tmp_path):
+    from oobleck_tpu.execution.dataset import HFImageTextDataset
+
+    root = make_imagefolder(tmp_path / "pairs", n=4)
+    ds = build_dataset(str(root), None, model_name="clip-tiny",
+                       vocab_size=64, seq_length=8,
+                       data_kind="contrastive", image_size=16)
+    assert isinstance(ds, HFImageTextDataset) and len(ds) == 4
+
+
 def test_contrastive_dataset_pairs():
     from oobleck_tpu.execution.dataset import SyntheticImageTextDataset
 

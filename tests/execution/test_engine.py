@@ -1,7 +1,10 @@
 """Engine integration on the virtual 8-device CPU mesh, mirroring the
 reference's engine tests (/root/reference/tests/execution/test_engine.py:
 451-1065): planning + instantiation, heterogeneous training with DP sync,
-and the full failure -> reconfiguration -> resume path with fake hosts."""
+and evaluation, all against ONE shared trained_engine fixture. The
+engine-per-test paths live in test_engine_reconfig.py (failure/recovery)
+and test_engine_families.py (model-family breadth) so each module fits the
+per-call test budget."""
 
 import os
 
@@ -12,7 +15,6 @@ import jax
 
 from oobleck_tpu.config import (
     DistributedArguments,
-    ExecutionArguments,
     JobArguments,
     ModelArguments,
     OobleckArguments,
@@ -20,8 +22,12 @@ from oobleck_tpu.config import (
 from oobleck_tpu.execution.engine import OobleckEngine
 
 
-@pytest.fixture(scope="module")
+@pytest.fixture(scope="session")
 def cache_env(tmp_path_factory):
+    """Session-scoped profile cache: deterministic planner inputs shared by
+    every engine module, so gpt2-tiny is profiled once per run instead of
+    once per module (profiling times every layer's fwd+bwd — minutes of
+    redundant wall time across the split modules otherwise)."""
     tmp = tmp_path_factory.mktemp("profiles")
     old = os.environ.get("OOBLECK_TPU_CACHE")
     os.environ["OOBLECK_TPU_CACHE"] = str(tmp)
@@ -138,83 +144,6 @@ def test_dp_sync_consistency(trained_engine):
                 np.testing.assert_allclose(x, y, rtol=1e-5, atol=1e-6)
 
 
-def test_reconfiguration_resumes(cache_env, devices8):
-    """Kill a host mid-training: the engine re-plans on survivors, copies
-    weights, keeps the data position, and loss keeps improving
-    (reference test_engine.py:887-1065 without processes to kill)."""
-    engine = make_engine(num_hosts=4, steps=10, devices=devices8)
-    engine.initialize_distributed()
-    engine.instantiate_pipelines(engine.args.job.global_num_microbatch)
-
-    for _ in range(2):
-        loss_before = engine._train_step()
-    it_before = engine.dataloaders[0].num_iterations_done
-    params_before = {
-        li: np.asarray(jax.tree.leaves(p)[0], np.float32)
-        for pipe in engine.pipelines for li, p in pipe.params.items()
-    }
-
-    engine.reconfigure("10.0.0.2")
-
-    # survivors only
-    assert "10.0.0.2" not in engine.host_ips
-    used = sorted({r // engine.chips_per_host for p in engine.pipelines
-                   for r in p.ranks})
-    assert 2 not in used
-    # weights survived (layer 1 params identical pre/post)
-    for pipe in engine.pipelines:
-        for li, p in pipe.params.items():
-            got = np.asarray(jax.tree.leaves(p)[0], np.float32)
-            np.testing.assert_allclose(got, params_before[li], rtol=1e-6)
-    # data position carried over
-    assert engine.dataloaders[0].num_iterations_done == it_before
-
-    losses = [engine._train_step() for _ in range(3)]
-    assert all(np.isfinite(l) for l in losses)
-    assert losses[-1] < loss_before  # still converging after recovery
-
-
-@pytest.mark.parametrize("model_name", ["bert-tiny", "t5-tiny", "vit-tiny",
-                                        "resnet-tiny", "clip-tiny"])
-def test_engine_drives_every_family(cache_env, devices8, model_name):
-    """The MPMD engine is objective-agnostic (reference pipeline.py:169-216):
-    MLM encoders, encoder-decoders (incl. T5's mid-pipeline batch_layers
-    bridge), image classifiers (attention AND conv pipelines), and the CLIP
-    dual-encoder train through the same plan -> instantiate -> train path as
-    gpt2 — the round-2 gap where PipelineInstance required gpt-only
-    param_specs (VERDICT missing #1)."""
-    engine = make_engine(num_hosts=2, steps=5, devices=devices8[:4],
-                         microbatch=2, global_mb=8, model_name=model_name)
-    engine.initialize_distributed()
-    engine.instantiate_pipelines(engine.args.job.global_num_microbatch)
-    losses = [engine._train_step() for _ in range(5)]
-    assert all(np.isfinite(l) for l in losses), losses
-    assert min(losses[2:]) < losses[0], losses
-    # The generic path must also pass evaluation (forward-only program).
-    assert np.isfinite(engine.evaluate(num_batches=1))
-
-
-def test_reconfigure_non_gpt_family(cache_env, devices8):
-    """Failure recovery on a non-causal-LM family: weights survive, the
-    data position carries over, training keeps converging (VERDICT round-2
-    order #2: at least one reconfiguration test off the gpt path)."""
-    engine = make_engine(num_hosts=4, steps=10, devices=devices8,
-                         microbatch=2, global_mb=8, model_name="bert-tiny")
-    engine.initialize_distributed()
-    engine.instantiate_pipelines(engine.args.job.global_num_microbatch)
-    loss_before = [engine._train_step() for _ in range(2)][-1]
-
-    engine.reconfigure("10.0.0.1")
-
-    assert "10.0.0.1" not in engine.host_ips
-    used = sorted({r // engine.chips_per_host for p in engine.pipelines
-                   for r in p.ranks})
-    assert 1 not in used
-    losses = [engine._train_step() for _ in range(3)]
-    assert all(np.isfinite(l) for l in losses)
-    assert losses[-1] < loss_before
-
-
 def test_min_hosts_bound(cache_env, devices8):
     engine = make_engine(num_hosts=4, devices=devices8)
     engine.chips_per_host = 2
@@ -231,44 +160,6 @@ def test_evaluate(trained_engine):
         len(trained_engine.dataset) * 0.1
     )
     trained_engine.args.execution.eval_fraction = 0.02
-
-
-class _RecordingDataset:
-    def __init__(self, ds):
-        self.ds = ds
-        self.seen: list[int] = []
-
-    def __len__(self):
-        return len(self.ds)
-
-    def __getitem__(self, i):
-        self.seen.append(i)
-        return self.ds[i]
-
-
-def test_eval_disjoint_and_rotating_default_config(cache_env, devices8):
-    """Under the DEFAULT config, every index evaluate() reads is disjoint
-    from every index training ever read, and consecutive evaluate() calls
-    read different windows (rotation, not replay)."""
-    engine = make_engine(num_hosts=2, steps=5, devices=devices8)
-    engine.initialize_distributed()
-    rec = _RecordingDataset(engine.dataset)
-    engine.dataset = rec
-    engine.instantiate_pipelines(engine.args.job.global_num_microbatch)
-    for _ in range(3):
-        engine._train_step()
-    train_seen = set(rec.seen)
-
-    rec.seen = []
-    assert np.isfinite(engine.evaluate(num_batches=2))
-    eval_first = set(rec.seen)
-    rec.seen = []
-    assert np.isfinite(engine.evaluate(num_batches=2))
-    eval_second = set(rec.seen)
-
-    assert eval_first and eval_second
-    assert train_seen.isdisjoint(eval_first | eval_second)
-    assert eval_first != eval_second  # windows rotate across calls
 
 
 def test_empty_validation_split_counts_as_absent(trained_engine, monkeypatch):
@@ -291,28 +182,6 @@ def test_empty_validation_split_counts_as_absent(trained_engine, monkeypatch):
     finally:
         trained_engine._has_val_split = None
         trained_engine._eval_ds_cache = _UNSET
-
-
-def test_replica_sync_bitwise_equality(cache_env, devices8):
-    """After N steps + _sync_replicas, every DP-replicated layer is BITWISE
-    identical across owners; the train loop invokes the sync on
-    replica_sync_interval independently of checkpointing (round-2 weak #6)."""
-    engine = make_engine(num_hosts=4, steps=3, devices=devices8)
-    engine.args.execution.replica_sync_interval = 2
-    engine.initialize_distributed()
-    engine.instantiate_pipelines(engine.args.job.global_num_microbatch)
-    if len(engine.pipelines) < 2:
-        pytest.skip("plan chose a single pipeline")
-    engine.train()  # 3 steps; interval 2 -> sync fired at step 2
-    engine._sync_replicas()
-    for li, owners in engine.dp_engine.owners.items():
-        if len(owners) < 2:
-            continue
-        ref = [np.asarray(x) for x in jax.tree.leaves(owners[0].params[li])]
-        for other in owners[1:]:
-            got = [np.asarray(x) for x in jax.tree.leaves(other.params[li])]
-            for a, b in zip(ref, got):
-                assert np.array_equal(a, b), f"layer {li} drifted post-sync"
 
 
 def test_dp_allreduce_batched_transfers_and_exactness(trained_engine):
@@ -364,62 +233,3 @@ def test_dp_allreduce_batched_transfers_and_exactness(trained_engine):
             np.testing.assert_allclose(np.asarray(g, np.float32),
                                        np.asarray(w, np.float32),
                                        rtol=1e-5, atol=1e-7)
-
-
-def test_fused_recovery_replan_reclaims_stranded_chips(cache_env, devices8):
-    """Fused recovery re-plans the mesh instead of only shrinking `data`:
-    a survivor count that doesn't divide the microbatch gets its stage
-    split adjusted so NO chip is stranded (round-3 weak #7 / next #9), and
-    the stranded count stays a first-class accounting metric."""
-    from oobleck_tpu.config import ExecutionArguments
-
-    args = OobleckArguments(
-        dist=DistributedArguments(
-            node_ips=[f"10.0.0.{i}" for i in range(3)]
-        ),
-        job=JobArguments(
-            # 6 divides the startup fsdp degree (6 chips) but not the
-            # post-loss 4, forcing the shrink branch.
-            microbatch_size=6,
-            global_microbatch_size=12,
-            steps=4,
-        ),
-        model=ModelArguments(model_name="gpt2-tiny", dataset_path="synthetic"),
-        execution=ExecutionArguments(engine_path="fused"),
-    )
-    engine = OobleckEngine(args, devices=devices8[:6])
-    engine.initialize_distributed()
-    engine.instantiate_pipelines(args.job.global_num_microbatch)
-    assert np.isfinite(engine._train_step())
-
-    engine.reconfigure("10.0.0.1")
-
-    survivors = 4  # 6 chips, 3 hosts -> 2 per host, one host lost
-    mesh_chips = engine.fused.mesh.devices.size
-    assert len(engine.stranded_chips) == 1
-    assert mesh_chips + engine.stranded_chips[0] == survivors
-    # mb=6 over 4 survivors with stage=1 would shrink fsdp to 3 and strand
-    # a chip; the re-plan switches to stage=2 x fsdp=2 and reclaims all 4.
-    assert engine.stranded_chips[0] == 0
-    assert dict(engine.fused.mesh.shape)["stage"] == 2
-    assert np.isfinite(engine._train_step())
-
-
-def test_reconfigure_no_idle_survivors_two_failures(cache_env, devices8):
-    """Every surviving host keeps training after each of two consecutive
-    host losses (surplus re-fold + immutable host-index lookup), and the
-    recovery time is recorded as a first-class metric."""
-    engine = make_engine(num_hosts=4, steps=10, devices=devices8)
-    engine.initialize_distributed()
-    engine.instantiate_pipelines(engine.args.job.global_num_microbatch)
-    engine._train_step()
-
-    for n_lost, ip in enumerate(["10.0.0.1", "10.0.0.3"], start=1):
-        engine.reconfigure(ip)
-        survivors = {engine._host_index[h] for h in engine.host_ips}
-        training = {r // engine.chips_per_host
-                    for p in engine.pipelines for r in p.ranks}
-        assert training == survivors, (n_lost, training, survivors)
-        assert len(engine.recovery_times) == n_lost
-        assert engine.recovery_times[-1] < 60.0
-        assert np.isfinite(engine._train_step())
