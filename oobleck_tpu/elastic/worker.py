@@ -88,6 +88,14 @@ def worker_main(pipe, agent_ip: str, args_dict: dict) -> None:
     logging.basicConfig(
         level=logging.INFO,
         format=f"[worker {agent_ip}] %(name)s: %(message)s")
+    # Stack dump on demand (`kill -USR1 <worker>`): a wedged collective or
+    # a stuck compile is otherwise undebuggable in a spawned worker —
+    # operators (and this repo's own hang triage) get every thread's
+    # Python stack on stderr without killing training.
+    import faulthandler
+    import signal as _signal
+
+    faulthandler.register(_signal.SIGUSR1, all_threads=True)
     args = OobleckArguments.from_dict(args_dict)
     job = args.job
     # Sanity mirrored from the reference (worker.py:27-28); JobArguments also

@@ -110,6 +110,21 @@ def test_native_matches_python():
             assert [s.num_chips for s in a.stages] == [s.num_chips for s in b.stages]
 
 
+def test_native_builds_from_clean_tree():
+    """No binary blob ships in git (round-4 hygiene): deleting the built
+    libplanner.so must transparently rebuild it from planner.cpp on the
+    next use (build-on-import, planning/_native.py)."""
+    from oobleck_tpu.planning import _native
+
+    if _native._SO.exists():
+        _native._SO.unlink()
+    _native._lib = None
+    profiles = dummy_profiles(num_layers=6, chips_per_host=2, seed=0)
+    out = _native.create_pipeline_templates(profiles, (1, 2), 2)
+    assert _native._SO.exists(), "build-on-import did not produce the .so"
+    assert out, "rebuilt planner returned no templates"
+
+
 def test_json_roundtrip(profiles):
     gen = TemplateGenerator(engine="python")
     [t] = gen.create_pipeline_templates(profiles, (2, 2), 4)
