@@ -303,6 +303,25 @@ class GPTModel:
         wqkv = _maybe_unshard(p["attn"]["wqkv"], f_, 0).astype(dt)     # [E,3,Hl,D]
         bqkv = p["attn"]["bqkv"].astype(dt)                             # [3,Hl,D]
         qkv = jnp.einsum("bse,ethd->tbhsd", h, wqkv) + bqkv[:, None, :, None, :]
+
+        def local_alibi_slopes():
+            # Slopes only ([Hl] after the TP-local slice) — never a
+            # materialized [H, S, S] bias: the flash kernel generates the
+            # bias IN-KERNEL (zero HBM bias bytes at any S); non-flash
+            # fallbacks and the Ulysses seq-shard materialize only their
+            # own head block from these slopes (round-4 advisor: the
+            # full bias was O(H S^2) HBM per device).
+            if c.position_embedding != "alibi":
+                return None
+            from oobleck_tpu.ops.attention import alibi_slopes
+
+            full = alibi_slopes(c.num_heads)
+            if ctx and ctx.tensor:
+                h_local = qkv.shape[2]
+                return lax.dynamic_slice_in_dim(
+                    full, ctx.tp_rank() * h_local, h_local, axis=0)
+            return full
+
         if ctx and ctx.seq:
             if c.attention_impl == "ulysses" or c.position_embedding == "alibi":
                 # Ulysses all-to-all layout: full sequence per device on
@@ -310,27 +329,9 @@ class GPTModel:
                 # unchanged, which the ring layout cannot offer.
                 from oobleck_tpu.ops.ulysses import ulysses_attention
 
-                slopes = None
-                if c.position_embedding == "alibi":
-                    from oobleck_tpu.ops.attention import alibi_slopes
-
-                    # Slopes only ([Hl] after the TP-local slice) — never
-                    # the [H, S, S] bias: ulysses materializes its own
-                    # seq-shard's [Hl/P, S, S] block after the head
-                    # all_to_all, the only part this device attends with
-                    # (round-4 advisor: full-bias was O(H S^2) HBM/device).
-                    full = alibi_slopes(c.num_heads)
-                    h_local = qkv.shape[2]
-                    if ctx.tensor:
-                        start = ctx.tp_rank() * h_local
-                        slopes = lax.dynamic_slice_in_dim(
-                            full, start, h_local, axis=0
-                        )
-                    else:
-                        slopes = full
                 attn_out = ulysses_attention(
                     qkv[0], qkv[1], qkv[2], axis_name=ctx.seq,
-                    alibi_slopes=slopes,
+                    alibi_slopes=local_alibi_slopes(),
                 )
             else:
                 from oobleck_tpu.ops.ring_attention import ring_attention
@@ -338,21 +339,9 @@ class GPTModel:
                 attn_out = ring_attention(qkv[0], qkv[1], qkv[2],
                                           axis_name=ctx.seq)
         else:
-            bias = None
-            if c.position_embedding == "alibi":
-                from oobleck_tpu.ops.attention import alibi_bias
-
-                s_len = qkv.shape[3]
-                # Local heads under TP: slice this rank's slopes.
-                h_local = qkv.shape[2]
-                full = alibi_bias(c.num_heads, s_len, s_len)
-                if ctx and ctx.tensor:
-                    start = ctx.tp_rank() * h_local
-                    bias = lax.dynamic_slice_in_dim(full, start, h_local, axis=0)
-                else:
-                    bias = full
             attn_out = causal_attention(
-                qkv[0], qkv[1], qkv[2], impl=c.attention_impl, bias=bias,
+                qkv[0], qkv[1], qkv[2], impl=c.attention_impl,
+                alibi_slopes=local_alibi_slopes(),
                 constant_bias=True,  # ALiBi is position-only
             )
         wo = _maybe_unshard(p["attn"]["wo"], f_, 2).astype(dt)          # [Hl,D,E]

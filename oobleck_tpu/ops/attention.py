@@ -129,6 +129,7 @@ def causal_attention(
     impl: str = "auto",
     scale: float | None = None,
     bias: jax.Array | None = None,
+    alibi_slopes: jax.Array | None = None,
     causal: bool = True,
     constant_bias: bool = False,
 ) -> jax.Array:
@@ -138,16 +139,29 @@ def causal_attention(
     other position-only biases) — required for the flash kernel, whose VJP
     treats the bias as a constant. Learned/batch-dependent biases and
     cross-attention (seq_q != seq_k) always take the XLA path.
+
+    Prefer `alibi_slopes` ([H] f32) over a materialized ALiBi `bias`: the
+    flash kernel generates the bias block in-kernel from the slopes, so no
+    O(H S^2) buffer exists in HBM at any S; non-flash fallbacks
+    materialize it from the slopes only where unavoidable.
     """
+    if bias is not None and alibi_slopes is not None:
+        raise ValueError("pass bias OR alibi_slopes, not both")
     fn = select_attention_impl(impl)
     from oobleck_tpu.ops.ring_attention import ring_attention
+
+    def slope_bias():
+        # Non-flash fallback: materialize from slopes (constant, exact).
+        return alibi_bias_from_slopes(alibi_slopes, q.shape[-2], k.shape[-2])
 
     if fn is ring_attention:
         # Ring handles unbiased causal self-attention only; anything else
         # falls back to XLA (single-device call — the sequence-parallel path
         # reaches ring_attention directly with its own checks).
-        if bias is None and causal:
+        if bias is None and alibi_slopes is None and causal:
             return fn(q, k, v, scale=scale)
+        if alibi_slopes is not None:
+            bias = slope_bias()
         return _xla_causal_attention(q, k, v, scale=scale, bias=bias,
                                      causal=causal)
     flash_ok = (
@@ -156,6 +170,9 @@ def causal_attention(
              or (constant_bias and (bias.ndim < 4 or bias.shape[0] == 1)))
     )
     if fn is _xla_causal_attention or not flash_ok:
+        if alibi_slopes is not None:
+            bias = slope_bias()
         return _xla_causal_attention(q, k, v, scale=scale, bias=bias,
                                      causal=causal)
-    return fn(q, k, v, scale=scale, bias=bias, causal=causal)
+    return fn(q, k, v, scale=scale, bias=bias, alibi_slopes=alibi_slopes,
+              causal=causal)

@@ -52,11 +52,15 @@ def _block_relevant(qi, ki, causal: bool):
     return ki * BLOCK_K <= qi * BLOCK_Q + (BLOCK_Q - 1)
 
 
-def _scores(q, k, qi, ki, scale, bias_ref, *, causal: bool, kv_len: int):
+def _scores(q, k, qi, ki, scale, bias_ref, slope_ref, *, causal: bool,
+            kv_len: int):
     """[Bq, Bk] masked, scaled, biased f32 logits for one (q, kv) block pair.
 
     Operands stay in their native dtype (bf16 in production) so the MXU runs
-    at full rate; only the accumulator is f32.
+    at full rate; only the accumulator is f32. ALiBi arrives as a per-head
+    SLOPE scalar (slope_ref) and the bias block is generated in-kernel from
+    the position iotas — no [H, S, S] bias buffer ever exists in HBM, the
+    long-context memory hazard a materialized bias reintroduces.
     """
     s = jax.lax.dot_general(
         q, k, (((1,), (1,)), ((), ())), preferred_element_type=jnp.float32
@@ -65,6 +69,9 @@ def _scores(q, k, qi, ki, scale, bias_ref, *, causal: bool, kv_len: int):
         s = s + bias_ref[0].astype(jnp.float32)
     q_pos = qi * BLOCK_Q + jax.lax.broadcasted_iota(jnp.int32, s.shape, 0)
     k_pos = ki * BLOCK_K + jax.lax.broadcasted_iota(jnp.int32, s.shape, 1)
+    if slope_ref is not None:
+        # -slope * (q_pos - k_pos): identical to alibi_bias_from_slopes.
+        s = s - slope_ref[0, 0, 0] * (q_pos - k_pos).astype(jnp.float32)
     if causal:
         s = jnp.where(q_pos >= k_pos, s, NEG_INF)
     else:
@@ -75,13 +82,16 @@ def _scores(q, k, qi, ki, scale, bias_ref, *, causal: bool, kv_len: int):
 
 
 def _fwd_kernel(*refs, scale: float, blocks_k: int, causal: bool,
-                has_bias: bool, kv_len: int, emit_lse: bool):
+                has_bias: bool, has_slopes: bool, kv_len: int,
+                emit_lse: bool):
     refs = list(refs)
-    bias_ref = lse_ref = None
+    bias_ref = slope_ref = lse_ref = None
     q_ref, k_ref, v_ref = refs[:3]
     del refs[:3]
     if has_bias:
         bias_ref = refs.pop(0)
+    if has_slopes:
+        slope_ref = refs.pop(0)
     o_ref = refs.pop(0)
     if emit_lse:
         lse_ref = refs.pop(0)
@@ -102,7 +112,8 @@ def _fwd_kernel(*refs, scale: float, blocks_k: int, causal: bool,
         q = q_ref[0]                               # [Bq, D] native dtype
         k = k_ref[0]                               # [Bk, D]
         v = v_ref[0]                               # [Bk, D]
-        s = _scores(q, k, qi, ki, scale, bias_ref, causal=causal, kv_len=kv_len)
+        s = _scores(q, k, qi, ki, scale, bias_ref, slope_ref,
+                    causal=causal, kv_len=kv_len)
 
         m_prev = m_ref[:, :1]                      # [Bq, 1]
         m_cur = jnp.max(s, axis=-1, keepdims=True)
@@ -129,13 +140,13 @@ def _fwd_kernel(*refs, scale: float, blocks_k: int, causal: bool,
 
 
 def _dq_kernel(*refs, scale: float, blocks_k: int, causal: bool,
-               has_bias: bool, kv_len: int):
-    if has_bias:
-        (q_ref, k_ref, v_ref, o_ref, do_ref, lse_ref, bias_ref,
-         dq_ref, dq_acc) = refs
-    else:
-        q_ref, k_ref, v_ref, o_ref, do_ref, lse_ref, dq_ref, dq_acc = refs
-        bias_ref = None
+               has_bias: bool, has_slopes: bool, kv_len: int):
+    refs = list(refs)
+    q_ref, k_ref, v_ref, o_ref, do_ref, lse_ref = refs[:6]
+    del refs[:6]
+    bias_ref = refs.pop(0) if has_bias else None
+    slope_ref = refs.pop(0) if has_slopes else None
+    dq_ref, dq_acc = refs
     qi = pl.program_id(1)
     ki = pl.program_id(2)
 
@@ -147,7 +158,8 @@ def _dq_kernel(*refs, scale: float, blocks_k: int, causal: bool,
     def _():
         q, k, v = q_ref[0], k_ref[0], v_ref[0]     # native dtype (MXU-rate dots)
         do, o = do_ref[0], o_ref[0]
-        s = _scores(q, k, qi, ki, scale, bias_ref, causal=causal, kv_len=kv_len)
+        s = _scores(q, k, qi, ki, scale, bias_ref, slope_ref,
+                    causal=causal, kv_len=kv_len)
         p = jnp.exp(s - lse_ref[0][:, :1])         # [Bq, Bk] f32
         dp = jax.lax.dot_general(                  # dO @ V^T  [Bq, Bk]
             do, v, (((1,), (1,)), ((), ())), preferred_element_type=jnp.float32
@@ -166,14 +178,13 @@ def _dq_kernel(*refs, scale: float, blocks_k: int, causal: bool,
 
 
 def _dkv_kernel(*refs, scale: float, blocks_q: int, causal: bool,
-                has_bias: bool, kv_len: int):
-    if has_bias:
-        (q_ref, k_ref, v_ref, o_ref, do_ref, lse_ref, bias_ref,
-         dk_ref, dv_ref, dk_acc, dv_acc) = refs
-    else:
-        (q_ref, k_ref, v_ref, o_ref, do_ref, lse_ref,
-         dk_ref, dv_ref, dk_acc, dv_acc) = refs
-        bias_ref = None
+                has_bias: bool, has_slopes: bool, kv_len: int):
+    refs = list(refs)
+    q_ref, k_ref, v_ref, o_ref, do_ref, lse_ref = refs[:6]
+    del refs[:6]
+    bias_ref = refs.pop(0) if has_bias else None
+    slope_ref = refs.pop(0) if has_slopes else None
+    dk_ref, dv_ref, dk_acc, dv_acc = refs
     ki = pl.program_id(1)   # kv block is the OUTER sequential axis here
     qi = pl.program_id(2)
 
@@ -186,7 +197,8 @@ def _dkv_kernel(*refs, scale: float, blocks_q: int, causal: bool,
     def _():
         q, k, v = q_ref[0], k_ref[0], v_ref[0]     # native dtype (MXU-rate dots)
         do, o = do_ref[0], o_ref[0]
-        s = _scores(q, k, qi, ki, scale, bias_ref, causal=causal, kv_len=kv_len)
+        s = _scores(q, k, qi, ki, scale, bias_ref, slope_ref,
+                    causal=causal, kv_len=kv_len)
         p = jnp.exp(s - lse_ref[0][:, :1])         # [Bq, Bk] f32
         dv_acc[:] = dv_acc[:] + jax.lax.dot_general(   # P^T @ dO  [Bk, D]
             p.astype(do.dtype), do, (((0,), (0,)), ((), ())),
@@ -254,17 +266,30 @@ def _bias_specs(has_bias: bool, h: int, outer_is_q: bool):
     return [pl.BlockSpec((1, BLOCK_Q, BLOCK_K), index)]
 
 
-def _flash_forward(q, k, v, bias, scale: float, causal: bool,
+def _slope_specs(has_slopes: bool, h: int):
+    # One f32 scalar per head, shaped [H, 1, 1]; the grid's batch*head axis
+    # indexes its head row (same map under both backward grids — the block
+    # index ignores qi/ki).
+    if not has_slopes:
+        return []
+    return [pl.BlockSpec((1, 1, 1), lambda b_, i, j: (b_ % h, 0, 0))]
+
+
+def _flash_forward(q, k, v, bias, slopes, scale: float, causal: bool,
                    emit_lse: bool = True):
     bias = _canon_bias(bias, q.shape[1], q.shape[2])
     q, k, v, bias, (b, h, s_len, d, bh, sp, dp) = _pad_inputs(q, k, v, bias)
     blocks_q = sp // BLOCK_Q
     blocks_k = sp // BLOCK_K
     has_bias = bias is not None
+    has_slopes = slopes is not None
+    if has_slopes:
+        slopes = jnp.asarray(slopes, jnp.float32).reshape(h, 1, 1)
 
     kernel = functools.partial(
         _fwd_kernel, scale=scale, blocks_k=blocks_k, causal=causal,
-        has_bias=has_bias, kv_len=s_len, emit_lse=emit_lse)
+        has_bias=has_bias, has_slopes=has_slopes, kv_len=s_len,
+        emit_lse=emit_lse)
     qkv_specs = [
         pl.BlockSpec((1, BLOCK_Q, dp), lambda b_, qi, ki: (b_, qi, 0)),
         pl.BlockSpec((1, BLOCK_K, dp), lambda b_, qi, ki: (b_, ki, 0)),
@@ -284,7 +309,8 @@ def _flash_forward(q, k, v, bias, scale: float, causal: bool,
         kernel,
         out_shape=out_shape,
         grid=(bh, blocks_q, blocks_k),
-        in_specs=qkv_specs + _bias_specs(has_bias, h, outer_is_q=True),
+        in_specs=(qkv_specs + _bias_specs(has_bias, h, outer_is_q=True)
+                  + _slope_specs(has_slopes, h)),
         out_specs=out_specs,
         scratch_shapes=[
             pltpu.VMEM((BLOCK_Q, dp), jnp.float32),
@@ -292,14 +318,16 @@ def _flash_forward(q, k, v, bias, scale: float, causal: bool,
             pltpu.VMEM((BLOCK_Q, LANE), jnp.float32),
         ],
         interpret=_interpret(),
-    )(*([q, k, v] + ([bias] if has_bias else [])))
+    )(*([q, k, v] + ([bias] if has_bias else [])
+        + ([slopes] if has_slopes else [])))
 
     out, lse = result if emit_lse else (result, None)
     out = out.reshape(b, h, sp, dp)[:, :, :s_len, :d]
     return out, lse
 
 
-def _flash_backward(q, k, v, bias, out, lse, g, scale: float, causal: bool):
+def _flash_backward(q, k, v, bias, slopes, out, lse, g, scale: float,
+                    causal: bool):
     bias = _canon_bias(bias, q.shape[1], q.shape[2])
     dtype_in = (q.dtype, k.dtype, v.dtype)
     qp, kp, vp, bias, (b, h, s_len, d, bh, sp, dp) = _pad_inputs(q, k, v, bias)
@@ -309,9 +337,13 @@ def _flash_backward(q, k, v, bias, out, lse, g, scale: float, causal: bool):
     blocks_q = sp // BLOCK_Q
     blocks_k = sp // BLOCK_K
     has_bias = bias is not None
+    has_slopes = slopes is not None
+    if has_slopes:
+        slopes = jnp.asarray(slopes, jnp.float32).reshape(h, 1, 1)
     interpret = _interpret()
 
-    common = [qp, kp, vp, op, gp, lse] + ([bias] if has_bias else [])
+    common = ([qp, kp, vp, op, gp, lse] + ([bias] if has_bias else [])
+              + ([slopes] if has_slopes else []))
 
     def qspec(inner_kv: bool):
         # index maps for (q-like, kv-like, lse) inputs under the two grids
@@ -332,10 +364,13 @@ def _flash_backward(q, k, v, bias, out, lse, g, scale: float, causal: bool):
 
     dq = pl.pallas_call(
         functools.partial(_dq_kernel, scale=scale, blocks_k=blocks_k,
-                          causal=causal, has_bias=has_bias, kv_len=s_len),
+                          causal=causal, has_bias=has_bias,
+                          has_slopes=has_slopes, kv_len=s_len),
         out_shape=jax.ShapeDtypeStruct((bh, sp, dp), jnp.float32),
         grid=(bh, blocks_q, blocks_k),
-        in_specs=qspec(inner_kv=True) + _bias_specs(has_bias, h, outer_is_q=True),
+        in_specs=(qspec(inner_kv=True)
+                  + _bias_specs(has_bias, h, outer_is_q=True)
+                  + _slope_specs(has_slopes, h)),
         out_specs=pl.BlockSpec((1, BLOCK_Q, dp), lambda b_, qi, ki: (b_, qi, 0)),
         scratch_shapes=[pltpu.VMEM((BLOCK_Q, dp), jnp.float32)],
         interpret=interpret,
@@ -343,13 +378,16 @@ def _flash_backward(q, k, v, bias, out, lse, g, scale: float, causal: bool):
 
     dk, dv = pl.pallas_call(
         functools.partial(_dkv_kernel, scale=scale, blocks_q=blocks_q,
-                          causal=causal, has_bias=has_bias, kv_len=s_len),
+                          causal=causal, has_bias=has_bias,
+                          has_slopes=has_slopes, kv_len=s_len),
         out_shape=(
             jax.ShapeDtypeStruct((bh, sp, dp), jnp.float32),
             jax.ShapeDtypeStruct((bh, sp, dp), jnp.float32),
         ),
         grid=(bh, blocks_k, blocks_q),
-        in_specs=qspec(inner_kv=False) + _bias_specs(has_bias, h, outer_is_q=False),
+        in_specs=(qspec(inner_kv=False)
+                  + _bias_specs(has_bias, h, outer_is_q=False)
+                  + _slope_specs(has_slopes, h)),
         out_specs=(
             pl.BlockSpec((1, BLOCK_K, dp), lambda b_, ki, qi: (b_, ki, 0)),
             pl.BlockSpec((1, BLOCK_K, dp), lambda b_, ki, qi: (b_, ki, 0)),
@@ -367,24 +405,28 @@ def _flash_backward(q, k, v, bias, out, lse, g, scale: float, causal: bool):
     return unpad(dq, dtype_in[0]), unpad(dk, dtype_in[1]), unpad(dv, dtype_in[2])
 
 
-@functools.partial(jax.custom_vjp, nondiff_argnums=(4, 5))
-def _flash(q, k, v, bias, scale, causal):
-    out, _ = _flash_forward(q, k, v, bias, scale, causal, emit_lse=False)
+@functools.partial(jax.custom_vjp, nondiff_argnums=(5, 6))
+def _flash(q, k, v, bias, slopes, scale, causal):
+    out, _ = _flash_forward(q, k, v, bias, slopes, scale, causal,
+                            emit_lse=False)
     return out
 
 
-def _flash_fwd(q, k, v, bias, scale, causal):
-    out, lse = _flash_forward(q, k, v, bias, scale, causal)
-    return out, (q, k, v, bias, out, lse)
+def _flash_fwd(q, k, v, bias, slopes, scale, causal):
+    out, lse = _flash_forward(q, k, v, bias, slopes, scale, causal)
+    return out, (q, k, v, bias, slopes, out, lse)
 
 
 def _flash_bwd(scale, causal, res, g):
-    q, k, v, bias, out, lse = res
-    dq, dk, dv = _flash_backward(q, k, v, bias, out, lse, g, scale, causal)
-    # Bias is a constant (ALiBi): position-only, so the zero cotangent is
-    # exact. Learned biases must use the XLA path (attention.py routes them).
+    q, k, v, bias, slopes, out, lse = res
+    dq, dk, dv = _flash_backward(q, k, v, bias, slopes, out, lse, g, scale,
+                                 causal)
+    # Bias/slopes are constants (ALiBi): position-only, so the zero
+    # cotangent is exact. Learned biases must use the XLA path
+    # (attention.py routes them).
     dbias = None if bias is None else jnp.zeros_like(bias)
-    return dq, dk, dv, dbias
+    dslopes = None if slopes is None else jnp.zeros_like(slopes)
+    return dq, dk, dv, dbias, dslopes
 
 
 _flash.defvjp(_flash_fwd, _flash_bwd)
@@ -393,12 +435,16 @@ _flash.defvjp(_flash_fwd, _flash_bwd)
 def flash_attention(q: jax.Array, k: jax.Array, v: jax.Array, *,
                     scale: float | None = None,
                     bias: jax.Array | None = None,
+                    alibi_slopes: jax.Array | None = None,
                     causal: bool = True) -> jax.Array:
     """Flash attention. [B, H, S, D] -> [B, H, S, D].
 
     `bias` is an additive [H, S, S] (or broadcastable) logit bias, treated as
-    a constant under differentiation (exact for ALiBi). `causal=False` gives
-    the bidirectional encoder form.
+    a constant under differentiation (exact for ALiBi). Prefer
+    `alibi_slopes` ([H] f32) for ALiBi: the bias block is generated
+    IN-KERNEL from the slopes and position iotas, so no O(H S^2) bias
+    buffer exists in HBM at any sequence length. `causal=False` gives the
+    bidirectional encoder form.
     """
     if scale is None:
         scale = q.shape[-1] ** -0.5
@@ -406,6 +452,14 @@ def flash_attention(q: jax.Array, k: jax.Array, v: jax.Array, *,
         raise ValueError(
             "flash kernel is self-attention only (seq_q == seq_k); "
             "use the XLA path for cross-attention")
+    if bias is not None and alibi_slopes is not None:
+        raise ValueError("pass bias OR alibi_slopes, not both")
     if bias is not None:
         bias = jax.lax.stop_gradient(bias)
-    return _flash(q, k, v, bias, scale, causal)
+    if alibi_slopes is not None:
+        if alibi_slopes.shape != (q.shape[1],):
+            raise ValueError(
+                f"alibi_slopes must be [H]={q.shape[1]}, got "
+                f"{alibi_slopes.shape}")
+        alibi_slopes = jax.lax.stop_gradient(alibi_slopes)
+    return _flash(q, k, v, bias, alibi_slopes, scale, causal)

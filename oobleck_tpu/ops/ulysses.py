@@ -34,16 +34,15 @@ def ulysses_attention(q: jax.Array, k: jax.Array, v: jax.Array, *,
     `bias` is the FULL-sequence bias ([H, S, S] or broadcastable), sliced
     per-device to the local heads here. Position-only ALiBi should come in
     as `alibi_slopes` ([H] for the local input heads) instead: the bias is
-    then materialized ONLY for this device's H/P heads ([H/P, S, S]) after
-    the head slice — passing a pre-built [H, S, S] bias costs O(H S^2) HBM
-    per device, which defeats sequence parallelism at long S (round-4
-    advisor). The remaining [H/P, S, S] buffer bounds practical S for
-    alibi+ulysses until the flash kernel generates the bias in-kernel.
+    then handed to the inner kernel, which generates the bias from them —
+    IN-KERNEL for the Pallas flash path, so zero bias bytes touch HBM at
+    any S; non-flash fallbacks materialize only this device's [H/P, S, S]
+    block. A pre-built [H, S, S] bias would cost O(H S^2) HBM per device,
+    defeating sequence parallelism at long S (round-4 advisor).
     `inner_impl` picks the single-device kernel for the full-sequence
     attention (the Pallas flash path on TPU).
     """
-    from oobleck_tpu.ops.attention import (
-        alibi_bias_from_slopes, causal_attention)
+    from oobleck_tpu.ops.attention import causal_attention
 
     P = lax.psum(1, axis_name)
     H = q.shape[1]
@@ -64,20 +63,21 @@ def ulysses_attention(q: jax.Array, k: jax.Array, v: jax.Array, *,
     per = H // P
     idx = lax.axis_index(axis_name)
     local_bias = bias
+    local_slopes = None
     if alibi_slopes is not None:
-        s_global = qh.shape[2]
+        # Slice this device's heads' slopes; the inner kernel generates
+        # the bias from them (in-kernel for flash — zero HBM bias bytes).
         local_slopes = lax.dynamic_slice_in_dim(
             alibi_slopes, idx * per, per, axis=0
         )
-        local_bias = alibi_bias_from_slopes(local_slopes, s_global, s_global)
     elif bias is not None and bias.ndim >= 3 and bias.shape[-3] == H:
         # Per-head bias over global heads: tiled all_to_all hands device i
         # heads [i*H/P, (i+1)*H/P), so slice its block; head-broadcast
         # biases (dim 1 or ndim<3) pass through unchanged.
         local_bias = lax.dynamic_slice_in_dim(bias, idx * per, per, axis=-3)
     out = causal_attention(qh, kh, vh, impl=inner_impl, scale=scale,
-                           bias=local_bias, causal=causal,
-                           constant_bias=True)
+                           bias=local_bias, alibi_slopes=local_slopes,
+                           causal=causal, constant_bias=True)
     # [B, H/P, S, D] -> [B, H, S/P, D]
     return lax.all_to_all(out, axis_name, split_axis=2, concat_axis=1,
                           tiled=True)

@@ -1,0 +1,31 @@
+"""Shared policy for JAX's persistent compilation cache.
+
+The CPU test/gate environments are compile-bound, so the cache is ON by
+default; every consumer (tests/conftest.py, the multi-process test worlds,
+the __graft_entry__ driver gate) resolves the SAME directory through this
+helper so subprocess worlds share entries with the in-process suite.
+
+Knobs:
+  * OOBLECK_JAX_CC=0 disables the cache everywhere;
+  * JAX_COMPILATION_CACHE_DIR overrides the location.
+
+The default dir is jaxlib-versioned to bound cross-version aliasing. A
+poisoned entry CAN wedge execution (observed once: a hang inside a
+float(loss) readback on a cached fused program) — the remedy is
+`rm -rf /tmp/oobleck_jax_cc*`.
+"""
+
+from __future__ import annotations
+
+import os
+
+
+def persistent_cache_dir() -> str | None:
+    """Resolved cache dir, or None when disabled (OOBLECK_JAX_CC=0)."""
+    if os.environ.get("OOBLECK_JAX_CC", "1") == "0":
+        return None
+    if os.environ.get("JAX_COMPILATION_CACHE_DIR"):
+        return os.environ["JAX_COMPILATION_CACHE_DIR"]
+    import jaxlib
+
+    return f"/tmp/oobleck_jax_cc_{jaxlib.__version__}"

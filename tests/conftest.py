@@ -14,9 +14,13 @@ are exempt: their env exists at exec time, before the pre-import.
 
 The suite is compile-bound (hundreds of XLA CPU programs over 8 virtual
 devices), so the persistent compilation cache is enabled by default: warm
-reruns cut per-module wall time by 3-10x. Disable with OOBLECK_JAX_CC=0.
-The cpu_aot_loader "machine feature +prefer-no-scatter" error spew on cache
-loads is benign (compile-time preference flags, not host ISA features).
+reruns cut per-module wall time by 2-5x. Disable with OOBLECK_JAX_CC=0.
+The cpu_aot_loader "machine feature +prefer-no-scatter" error spew on
+cache loads is normally harmless (compile-time preference flags, not host
+ISA features) — BUT a poisoned entry CAN wedge execution: if a test hangs
+inexplicably inside float(loss)/device_get, `rm -rf /tmp/oobleck_jax_cc*`
+and rerun (observed once, round 5; dir is jaxlib-versioned to bound
+cross-version aliasing).
 """
 
 import os
@@ -28,11 +32,12 @@ os.environ["XLA_FLAGS"] = (
 import jax
 
 jax.config.update("jax_platforms", "cpu")
-if os.environ.get("OOBLECK_JAX_CC", "1") != "0":
-    jax.config.update(
-        "jax_compilation_cache_dir",
-        os.environ.get("JAX_COMPILATION_CACHE_DIR", "/tmp/oobleck_jax_cc"),
-    )
+
+
+from oobleck_tpu.utils.compile_cache import persistent_cache_dir
+
+if persistent_cache_dir() is not None:
+    jax.config.update("jax_compilation_cache_dir", persistent_cache_dir())
 
 import numpy as np
 import pytest

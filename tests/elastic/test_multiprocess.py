@@ -33,6 +33,8 @@ from pathlib import Path
 import pytest
 import yaml
 
+from oobleck_tpu.utils.compile_cache import persistent_cache_dir
+
 pytestmark = pytest.mark.slow
 
 TINY_MODEL = {
@@ -83,11 +85,10 @@ def test_multiprocess_elastic_train_and_recover(tmp_path):
         "XLA_FLAGS": "--xla_force_host_platform_device_count=2",
         "OOBLECK_MULTIHOST": "1",
         "OOBLECK_TPU_CACHE": str(tmp_path / "cache"),
-        "JAX_COMPILATION_CACHE_DIR":
-            os.environ.get("JAX_COMPILATION_CACHE_DIR", "/tmp/oobleck_jax_cc"),
+        "JAX_COMPILATION_CACHE_DIR": persistent_cache_dir() or "",
     })
-    if os.environ.get("OOBLECK_JAX_CC", "1") == "0":
-        env.pop("JAX_COMPILATION_CACHE_DIR", None)
+    if not env["JAX_COMPILATION_CACHE_DIR"]:
+        env.pop("JAX_COMPILATION_CACHE_DIR")
     port = _free_port()
     cfg = {
         "dist": {"master_ip": "127.0.0.1", "master_port": port,

@@ -33,6 +33,8 @@ import numpy as np
 import pytest
 import yaml
 
+from oobleck_tpu.utils.compile_cache import persistent_cache_dir
+
 pytestmark = pytest.mark.slow
 
 REPO = Path(__file__).parents[2]
@@ -58,14 +60,13 @@ def _base_env(cache: Path, devices_per_host: int) -> dict:
         # Compile-bound subprocess worlds share the persistent compilation
         # cache (jax is pre-imported at interpreter startup on this image,
         # but subprocess env exists at exec time, so the env var works).
-        "JAX_COMPILATION_CACHE_DIR":
-            os.environ.get("JAX_COMPILATION_CACHE_DIR", "/tmp/oobleck_jax_cc"),
+        "JAX_COMPILATION_CACHE_DIR": persistent_cache_dir() or "",
         # Drivers run by absolute path put their own dir on sys.path, not
         # the repo root.
         "PYTHONPATH": str(REPO) + os.pathsep + env.get("PYTHONPATH", ""),
     })
-    if os.environ.get("OOBLECK_JAX_CC", "1") == "0":
-        env.pop("JAX_COMPILATION_CACHE_DIR", None)
+    if not env["JAX_COMPILATION_CACHE_DIR"]:
+        env.pop("JAX_COMPILATION_CACHE_DIR")
     return env
 
 

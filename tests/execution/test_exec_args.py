@@ -276,8 +276,11 @@ def test_build_model_execution_overrides():
 def test_execution_args_validation():
     with pytest.raises(ValueError, match="engine_path"):
         ExecutionArguments(engine_path="bogus")
-    with pytest.raises(ValueError, match="fused"):
-        ExecutionArguments(engine_path="mpmd", sequence_parallel=2)
+    # sequence_parallel composes with BOTH paths since round 5 (seq-parallel
+    # MPMD stage meshes); auto still resolves sp>1 to fused.
+    ex = ExecutionArguments(engine_path="mpmd", sequence_parallel=2)
+    assert ex.resolved_path() == "mpmd"
+    assert ExecutionArguments(sequence_parallel=2).resolved_path() == "fused"
     with pytest.raises(ValueError, match="precision"):
         build_model("gpt2-tiny",
                     execution=ExecutionArguments(precision="fp8"))

@@ -88,6 +88,38 @@ def test_flash_bwd_kernel_matches_xla(shape, causal, with_bias):
                                    rtol=2e-2, atol=2e-3)
 
 
+@pytest.mark.parametrize("shape,causal", [
+    ((2, 4, 256, 64), True),
+    ((2, 4, 200, 48), True),      # unaligned seq + head
+    ((2, 4, 200, 48), False),     # bidirectional, unaligned
+])
+def test_flash_inkernel_alibi_slopes_match_bias(shape, causal):
+    """ALiBi via in-kernel slopes must equal the materialized-bias paths
+    (flash-with-bias AND XLA), forward and grads — the [H, S, S] bias
+    buffer is gone from HBM, the math must not move."""
+    from oobleck_tpu.ops.attention import alibi_bias, alibi_slopes
+
+    ks = jax.random.split(jax.random.PRNGKey(11), 3)
+    q, k, v = (jax.random.normal(kk, shape, jnp.float32) * 0.3 for kk in ks)
+    slopes = alibi_slopes(shape[1])
+    bias = alibi_bias(shape[1], shape[2], shape[2])
+
+    got = flash_attention(q, k, v, alibi_slopes=slopes, causal=causal)
+    via_bias = flash_attention(q, k, v, bias=bias, causal=causal)
+    via_xla = _xla_causal_attention(q, k, v, bias=bias, causal=causal)
+    np.testing.assert_allclose(np.asarray(got), np.asarray(via_bias),
+                               rtol=1e-5, atol=1e-5)
+    np.testing.assert_allclose(np.asarray(got), np.asarray(via_xla),
+                               rtol=2e-3, atol=2e-3)
+    g1 = _grads(lambda q, k, v: flash_attention(
+        q, k, v, alibi_slopes=slopes, causal=causal), q, k, v)
+    g2 = _grads(lambda q, k, v: _xla_causal_attention(
+        q, k, v, bias=bias, causal=causal), q, k, v)
+    for a, b in zip(g1, g2):
+        np.testing.assert_allclose(np.asarray(a), np.asarray(b),
+                                   rtol=2e-2, atol=2e-3)
+
+
 def test_flash_bwd_is_pallas_not_xla_recompute():
     """The VJP must not rebuild the [S, S] logits through XLA: no dot with an
     S x S operand may appear in the backward jaxpr outside pallas calls."""
