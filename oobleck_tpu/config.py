@@ -125,6 +125,14 @@ class ExecutionArguments:
     # honest out of the box; 0 opts out explicitly (train on everything,
     # the reference behavior — its eval data is never actually driven).
     eval_fraction: float = 0.02
+    # Bounded-time recovery: how many host losses ahead to AOT-precompile
+    # re-planned stage executables for (execution/precompile.py). Depth d
+    # walks the plans the instantiator would match after losing 1..d hosts
+    # (plus the current plan) and compiles their stage programs into the
+    # persistent compilation cache on a background thread, so
+    # reconfigure()/respawn deserializes instead of cold-compiling.
+    # 0 disables. OOBLECK_PRECOMPILE overrides at runtime.
+    precompile_recovery_depth: int = 2
 
     def __post_init__(self) -> None:
         if self.engine_path not in ("auto", "mpmd", "fused"):

@@ -31,11 +31,14 @@ from dataclasses import dataclass
 
 from oobleck_tpu.config import OobleckArguments
 from oobleck_tpu.elastic.message import (
+    PROTOCOL_VERSION,
     RequestType,
     ResponseType,
     recv_msg,
     send_request,
 )
+from oobleck_tpu.utils import recovery
+from oobleck_tpu.utils.chaos import chaos
 
 logger = logging.getLogger("oobleck.agent")
 
@@ -44,6 +47,22 @@ PING_INTERVAL = 10.0
 # RECONFIGURATION that explains it (a peer died mid-collective) before the
 # agent gives up and terminates.
 WORKER_DEATH_GRACE = 30.0
+# Bounded connect/register retries with exponential backoff: a master that
+# is still binding its port (agents race the launcher) or briefly
+# partitioned gets retried; a genuinely absent master fails loudly in
+# bounded time instead of hanging the host forever.
+CONNECT_ATTEMPTS = 6
+REGISTER_ATTEMPTS = 4
+BACKOFF_INITIAL = 0.5
+BACKOFF_CAP = 10.0
+
+
+def _env_float(name: str, default: float) -> float:
+    try:
+        return float(os.environ.get(name, "") or default)
+    except ValueError:
+        logger.warning("ignoring malformed %s", name)
+        return default
 
 
 @dataclass
@@ -63,16 +82,42 @@ class OobleckAgent:
         self._reader: asyncio.StreamReader | None = None
         self._writer: asyncio.StreamWriter | None = None
         self._send_lock = asyncio.Lock()
+        # Serializes worker creation between bring-up and a concurrent
+        # RECONFIGURATION-driven respawn (both may run once the control
+        # loops start ahead of the worker launch).
+        self._worker_lock = asyncio.Lock()
+        self.ping_interval = _env_float("OOBLECK_PING_INTERVAL",
+                                        PING_INTERVAL)
+        # Stamp of the last RECONFIGURATION we acted on, for the
+        # RECOVERY_DEADLINE respawn accounting.
+        self._notified_at: float | None = None
+        # Latest coordinator announcement, replayed to a freshly launched
+        # worker: the response loop runs during bring-up (it must — the
+        # heartbeat deadline is ticking), so a broadcast can land before
+        # the worker exists. The `world` tag makes replaying a stale one
+        # safe (the worker rejects mismatched generations).
+        self._last_coordinator: dict | None = None
 
     # ------------------------------------------------------------------ #
 
     async def run(self) -> None:
         await self.connect_to_master()
         await self.register()
-        self.ensure_profile()
-        self.launch_worker()
-        await asyncio.gather(self.response_loop(), self.ping_loop(),
-                             self.worker_port_loop(), self.worker_watch_loop())
+        # Heartbeats must start the moment we are registered: the master's
+        # read deadline (3x ping cadence) is already ticking, and the
+        # profile-on-miss bring-up below is compile-bound — minutes, not
+        # seconds. Pinging only after profiling would get a healthy agent
+        # evicted as hung before its worker ever launched, so the bring-up
+        # runs off-thread while the event loop keeps the control plane live.
+        await asyncio.gather(self._bringup(), self.response_loop(),
+                             self.ping_loop(), self.worker_port_loop(),
+                             self.worker_watch_loop())
+
+    async def _bringup(self) -> None:
+        await asyncio.to_thread(self.ensure_profile)
+        async with self._worker_lock:
+            if self.worker is None:  # a mid-bringup respawn already launched
+                await asyncio.to_thread(self.launch_worker)
 
     async def worker_watch_loop(self) -> None:
         """Worker death must surface as a host failure: drop the master
@@ -101,14 +146,16 @@ class OobleckAgent:
                     pass
                 raise SystemExit(0)
             if self._multihost():
+                grace = _env_float("OOBLECK_WORKER_DEATH_GRACE",
+                                   WORKER_DEATH_GRACE)
                 if pending is None or pending[0] is not w:
                     pending = (w, time.monotonic())
                     logger.warning(
                         "worker died (exit=%s); waiting %.0fs for a "
                         "reconfiguration that explains it",
-                        w.process.exitcode, WORKER_DEATH_GRACE)
+                        w.process.exitcode, grace)
                     continue
-                if time.monotonic() - pending[1] < WORKER_DEATH_GRACE:
+                if time.monotonic() - pending[1] < grace:
                     continue
             logger.error("worker process died (exit=%s); terminating agent",
                          w.process.exitcode)
@@ -118,22 +165,77 @@ class OobleckAgent:
     def _multihost() -> bool:
         return os.environ.get("OOBLECK_MULTIHOST") == "1"
 
-    async def connect_to_master(self) -> None:
-        self._reader, self._writer = await asyncio.open_connection(
-            self.master_ip, self.master_port
-        )
+    async def connect_to_master(self, attempts: int = CONNECT_ATTEMPTS) -> None:
+        """Exponential-backoff reconnect: agents race the master's listener
+        at cluster bring-up (the launcher fires them before the accept loop
+        necessarily exists on a remote host), and a refused connect must be
+        a retry, not a dead host."""
+        delay = BACKOFF_INITIAL
+        for attempt in range(1, attempts + 1):
+            try:
+                self._reader, self._writer = await asyncio.open_connection(
+                    self.master_ip, self.master_port
+                )
+                return
+            except OSError as e:
+                if attempt == attempts:
+                    raise
+                logger.warning(
+                    "master %s:%d not reachable (%s); retry %d/%d in %.1fs",
+                    self.master_ip, self.master_port, e, attempt,
+                    attempts - 1, delay,
+                )
+                await asyncio.sleep(delay)
+                delay = min(delay * 2, BACKOFF_CAP)
 
-    async def register(self) -> None:
-        """Reference _register_agent (agent.py:70-82)."""
-        async with self._send_lock:
-            await send_request(self._writer, RequestType.REGISTER_AGENT,
-                               {"ip": self.agent_ip})
-        msg = await recv_msg(self._reader)
-        if msg.get("kind") != ResponseType.SUCCESS.value:
-            raise RuntimeError(f"registration failed: {msg}")
-        self.args = OobleckArguments.from_dict(msg["args"])
-        self.node_ips = list(self.args.dist.node_ips)
-        logger.info("registered; job model=%s", self.args.model.model_name)
+    async def register(self, attempts: int = REGISTER_ATTEMPTS) -> None:
+        """Reference _register_agent (agent.py:70-82), with bounded retry:
+        an agent that reaches the master before LAUNCH_JOB configured it
+        gets FAILURE + a closed socket — reconnect and try again instead of
+        dying at bring-up. Registration advertises the heartbeat cadence
+        (protocol v2) so the master can derive this agent's read deadline."""
+        delay = BACKOFF_INITIAL
+        last: Exception | None = None
+        for attempt in range(1, attempts + 1):
+            try:
+                async with self._send_lock:
+                    await send_request(
+                        self._writer, RequestType.REGISTER_AGENT,
+                        {"ip": self.agent_ip,
+                         "protocol": PROTOCOL_VERSION,
+                         "ping_interval": self.ping_interval},
+                    )
+                msg = await recv_msg(self._reader)
+                if msg.get("kind") == ResponseType.SUCCESS.value:
+                    self.args = OobleckArguments.from_dict(msg["args"])
+                    self.node_ips = list(self.args.dist.node_ips)
+                    logger.info("registered; job model=%s",
+                                self.args.model.model_name)
+                    return
+                last = RuntimeError(f"registration failed: {msg}")
+            except (ConnectionError, OSError, asyncio.IncompleteReadError,
+                    asyncio.TimeoutError, TimeoutError) as e:
+                last = e
+            if attempt == attempts:
+                break
+            logger.warning("registration attempt %d/%d failed (%s); "
+                           "retrying in %.1fs", attempt, attempts, last, delay)
+            await asyncio.sleep(delay)
+            delay = min(delay * 2, BACKOFF_CAP)
+            # The master closes the connection on FAILURE; re-dial. Close
+            # our side first — a leaked half-dead socket lingers in a
+            # master _agent_loop until its read deadline, where it would be
+            # mistaken for THIS agent hanging and evicted.
+            if self._writer is not None:
+                self._writer.close()
+                try:
+                    await self._writer.wait_closed()
+                except (ConnectionError, OSError):
+                    pass
+            await self.connect_to_master()
+        raise RuntimeError(
+            f"registration failed after {attempts} attempts: {last}"
+        )
 
     # ------------------------------------------------------------------ #
 
@@ -169,6 +271,10 @@ class OobleckAgent:
         proc.start()
         self.worker = Worker(pipe=parent_pipe, process=proc)
         logger.info("agent %s launched worker pid=%d", self.agent_ip, proc.pid)
+        if self._last_coordinator is not None:
+            # Deliver an announcement that arrived before the worker did;
+            # worker-side generation tagging drops it if it is stale.
+            parent_pipe.send(self._last_coordinator)
 
     def _stop_worker(self, timeout: float = 15.0) -> None:
         """Terminate the worker, escalating to SIGKILL — a worker wedged in
@@ -194,8 +300,18 @@ class OobleckAgent:
         self._stop_worker()
         self.args.dist.node_ips = list(self.node_ips)
         self.launch_worker()
+        elapsed = time.monotonic() - t0
         logger.info("worker respawned for %d survivors in %.1fs",
-                    len(self.node_ips), time.monotonic() - t0)
+                    len(self.node_ips), elapsed)
+        since_notice = (
+            time.monotonic() - self._notified_at
+            if self._notified_at is not None else None
+        )
+        recovery.mark(recovery.RESPAWN, ip=self.agent_ip,
+                      survivors=len(self.node_ips),
+                      elapsed=round(elapsed, 3),
+                      since_notified=(round(since_notice, 3)
+                                      if since_notice is not None else None))
 
     # ------------------------------------------------------------------ #
 
@@ -215,10 +331,11 @@ class OobleckAgent:
             if kind == ResponseType.RECONFIGURATION.value:
                 await self.on_reconfiguration(msg["lost_ip"])
             elif kind == ResponseType.FORWARD_COORDINATOR.value:
+                payload = {"kind": "coordinator", "address": msg["address"]}
+                if msg.get("world") is not None:
+                    payload["world"] = msg["world"]
+                self._last_coordinator = payload
                 if self.worker is not None:
-                    payload = {"kind": "coordinator", "address": msg["address"]}
-                    if msg.get("world") is not None:
-                        payload["world"] = msg["world"]
                     self.worker.pipe.send(payload)
             elif kind == ResponseType.SUCCESS.value and "dist_info" in msg:
                 if self.worker is not None:
@@ -229,6 +346,8 @@ class OobleckAgent:
     async def on_reconfiguration(self, lost_ip: str) -> None:
         """Reference on_receive_reconfiguration (agent.py:217-232)."""
         logger.warning("host %s lost", lost_ip)
+        self._notified_at = time.monotonic()
+        recovery.mark(recovery.NOTIFIED, lost_ip=lost_ip, ip=self.agent_ip)
         if lost_ip == self.agent_ip:
             # We are declared dead: the built-in failure-injection kill switch.
             logger.warning("this host is the victim; terminating")
@@ -250,7 +369,8 @@ class OobleckAgent:
             # the latest checkpoint. to_thread: _stop_worker joins for up
             # to 20s and must not stall the response/ping/relay loops
             # mid-recovery.
-            await asyncio.to_thread(self.respawn_worker)
+            async with self._worker_lock:
+                await asyncio.to_thread(self.respawn_worker)
         elif self.worker is not None:
             # Single-host: the engine reconfigures in place — the
             # reference's NCCL-rebuild model (engine.py:91-180).
@@ -258,7 +378,13 @@ class OobleckAgent:
 
     async def ping_loop(self) -> None:
         while True:
-            await asyncio.sleep(PING_INTERVAL)
+            await asyncio.sleep(self.ping_interval)
+            if chaos().heartbeat_stalled(self.agent_ip):
+                # Fault injection: go silent WITHOUT closing the socket —
+                # the hung-peer case only the master's heartbeat deadline
+                # (never TCP disconnect) can detect.
+                logger.warning("chaos: heartbeat stalled (socket held open)")
+                continue
             try:
                 async with self._send_lock:
                     await send_request(self._writer, RequestType.PING)

@@ -8,8 +8,14 @@ Capability match for the reference master
     harness injects a mock launcher, like the reference's mocked asyncssh,
     tests/elastic/test_master.py:46-49);
   * registers agents and serves DistributionInfo;
-  * detects host failure by TCP disconnect (master.py:214-231) and broadcasts
-    (RECONFIGURATION, lost_ip) to survivors (close_agent, master.py:192-203);
+  * detects host failure by TCP disconnect (master.py:214-231) AND by
+    heartbeat deadline — every agent read carries a deadline derived from
+    the agent's advertised ping cadence (protocol v2, message.py), so a
+    hung-but-connected peer (socket open, no traffic) is evicted in
+    bounded time instead of stalling detection forever; either way the
+    master broadcasts (RECONFIGURATION, lost_ip) to survivors
+    (close_agent, master.py:192-203) and stamps the RECOVERY_DEADLINE
+    detect/broadcast marks (utils/recovery.py);
   * relays the JAX coordinator address from the first agent to all agents
     (the reference's rank0-port chain, master.py:137-154);
   * answers PING (the reference defines ping but never schedules it,
@@ -31,12 +37,15 @@ from dataclasses import dataclass, field
 
 from oobleck_tpu.config import OobleckArguments
 from oobleck_tpu.elastic.message import (
+    DEFAULT_PING_INTERVAL,
     DistributionInfo,
     RequestType,
     ResponseType,
+    read_deadline,
     recv_msg,
     send_response,
 )
+from oobleck_tpu.utils import recovery
 
 MAX_NUM_HOSTS = 32
 
@@ -49,6 +58,9 @@ class AgentInfo:
     reader: asyncio.StreamReader
     writer: asyncio.StreamWriter
     clean_exit: bool = False  # JOB_DONE received: departure is not a failure
+    protocol: int = 1
+    ping_interval: float = DEFAULT_PING_INTERVAL
+    read_deadline: float = read_deadline(DEFAULT_PING_INTERVAL)
 
 
 class LocalLauncher:
@@ -169,8 +181,13 @@ class OobleckMasterDaemon:
     async def _on_connected(self, reader: asyncio.StreamReader,
                             writer: asyncio.StreamWriter) -> None:
         try:
-            msg = await recv_msg(reader, timeout=None)
-        except (asyncio.IncompleteReadError, ConnectionError):
+            # Bounded first read: a connection that registers nothing within
+            # a default heartbeat deadline is dead weight (or a socket-
+            # holding DoS), not a future agent.
+            msg = await recv_msg(reader,
+                                 timeout=read_deadline(DEFAULT_PING_INTERVAL))
+        except (asyncio.TimeoutError, TimeoutError,
+                asyncio.IncompleteReadError, ConnectionError):
             writer.close()
             return
         kind = msg.get("kind")
@@ -224,7 +241,19 @@ class OobleckMasterDaemon:
                                 {"error": "no job configured"})
             writer.close()
             return
-        self.agents[ip] = AgentInfo(ip, reader, writer)
+        interval = float(msg.get("ping_interval") or DEFAULT_PING_INTERVAL)
+        info = AgentInfo(
+            ip, reader, writer,
+            protocol=int(msg.get("protocol") or 1),
+            ping_interval=interval,
+            read_deadline=read_deadline(interval),
+        )
+        self.agents[ip] = info
+        logger.info(
+            "agent %s registered (protocol v%d, ping %.1fs, read deadline "
+            "%.1fs)", ip, info.protocol, info.ping_interval,
+            info.read_deadline,
+        )
         await send_response(writer, ResponseType.SUCCESS,
                             {"args": self.job.to_dict()})
         if self.coordinator is not None:
@@ -233,10 +262,15 @@ class OobleckMasterDaemon:
                                 self._coordinator_payload())
         # Keep the channel open: this connection is the liveness signal.
         try:
-            await self._agent_loop(self.agents[ip])
+            await self._agent_loop(info)
         finally:
-            if ip in self.agents:
+            # Identity guard: an agent that re-dialed (register retry)
+            # replaces its registry entry; when the OLD connection's loop
+            # unwinds it must not evict the NEW live registration.
+            if self.agents.get(ip) is info:
                 await self._close_agent(ip)
+            else:
+                info.writer.close()
 
     def _coordinator_payload(self) -> dict:
         """Coordinator relay payload; the generation tag is included only
@@ -247,13 +281,30 @@ class OobleckMasterDaemon:
         return payload
 
     async def _agent_loop(self, agent: AgentInfo) -> None:
-        """Serve requests from one agent until it disconnects
-        (reference agent_handler, master.py:214-231)."""
+        """Serve requests from one agent until it disconnects OR misses its
+        heartbeat deadline (reference agent_handler, master.py:214-231 —
+        which reads with timeout=None and therefore never detects a hung
+        peer; here every read is bounded by the agent's own cadence)."""
         while True:
             try:
-                msg = await recv_msg(agent.reader, timeout=None)
+                msg = await recv_msg(agent.reader,
+                                     timeout=agent.read_deadline)
+            except (asyncio.TimeoutError, TimeoutError):
+                if self._is_failure(agent):
+                    logger.warning(
+                        "agent %s sent nothing for %.1fs (ping interval "
+                        "%.1fs); evicting hung peer", agent.ip,
+                        agent.read_deadline, agent.ping_interval,
+                    )
+                    recovery.mark(recovery.DETECT, lost_ip=agent.ip,
+                                  cause="heartbeat_deadline",
+                                  deadline=agent.read_deadline)
+                return
             except (asyncio.IncompleteReadError, ConnectionError):
-                logger.warning("agent %s disconnected", agent.ip)
+                if self._is_failure(agent):
+                    logger.warning("agent %s disconnected", agent.ip)
+                    recovery.mark(recovery.DETECT, lost_ip=agent.ip,
+                                  cause="disconnect")
                 return
             kind = msg.get("kind")
             if kind == RequestType.PING.value:
@@ -287,6 +338,13 @@ class OobleckMasterDaemon:
                 await send_response(agent.writer, ResponseType.FAILURE,
                                     {"error": f"unknown request {kind}"})
 
+    def _is_failure(self, agent: AgentInfo) -> bool:
+        """A read-loop exit counts as a host failure (DETECT mark +
+        eviction warning) only when the connection still represents a live
+        registration: not after JOB_DONE (completion is not a failure) and
+        not when a re-registration already superseded this connection."""
+        return not agent.clean_exit and self.agents.get(agent.ip) is agent
+
     async def _close_agent(self, ip: str) -> None:
         """Reference close_agent (master.py:192-203): drop the agent and
         broadcast the loss to survivors — unless the agent announced a clean
@@ -302,6 +360,8 @@ class OobleckMasterDaemon:
                                     {"lost_ip": ip})
             except ConnectionError:
                 pass
+        recovery.mark(recovery.BROADCAST, lost_ip=ip,
+                      survivors=len(self.agents))
 
 
 async def _amain(port: int, launcher: str, username: str | None,

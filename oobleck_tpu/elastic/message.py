@@ -10,6 +10,14 @@ deserialization is code execution. Layout per message:
 
 Body always carries "kind" (request/response tag). Timeouts mirror the
 reference's 5 s default (message_util.py:7).
+
+Protocol v2 adds heartbeat-deadline fields to REGISTER_AGENT
+("protocol", "ping_interval"): the master derives a per-agent read
+deadline from the agent's own advertised ping cadence, so a
+hung-but-connected peer (socket open, no traffic) is evicted instead of
+stalling failure detection forever behind a `timeout=None` read. v1
+agents (no fields) get the default cadence — the bump is
+backward-compatible in both directions.
 """
 
 from __future__ import annotations
@@ -19,8 +27,25 @@ import json
 from dataclasses import asdict, dataclass, field
 from enum import Enum
 
+from oobleck_tpu.utils.chaos import chaos
+
+PROTOCOL_VERSION = 2
 TIMEOUT = 5.0
 MAX_MSG_BYTES = 64 * 1024 * 1024
+
+# Heartbeat-derived liveness: an agent that misses this many consecutive
+# ping intervals is declared hung. 3x tolerates one lost ping plus
+# scheduler jitter without ever leaving detection unbounded.
+DEFAULT_PING_INTERVAL = 10.0
+HEARTBEAT_MISS_FACTOR = 3.0
+
+
+def read_deadline(ping_interval: float) -> float:
+    """Master-side read deadline for an agent pinging at `ping_interval`.
+
+    Floored at TIMEOUT so a pathologically small advertised interval
+    can't make the master evict agents on scheduler noise."""
+    return max(TIMEOUT, float(ping_interval) * HEARTBEAT_MISS_FACTOR)
 
 
 class RequestType(str, Enum):
@@ -59,6 +84,16 @@ class DistributionInfo:
 
 
 async def send_msg(writer: asyncio.StreamWriter, body: dict) -> None:
+    c = chaos()
+    if c.active:
+        kind = str(body.get("kind", ""))
+        delay = c.send_delay(kind)
+        if delay > 0:
+            await asyncio.sleep(delay)
+        if c.drop_send(kind):
+            # Length-prefixed framing: dropping a whole message leaves the
+            # stream well-formed (unlike truncating one mid-frame).
+            return
     data = json.dumps(body).encode()
     if len(data) > MAX_MSG_BYTES:
         raise ValueError(f"message too large: {len(data)}")

@@ -96,6 +96,9 @@ def worker_main(pipe, agent_ip: str, args_dict: dict) -> None:
     import signal as _signal
 
     faulthandler.register(_signal.SIGUSR1, all_threads=True)
+    from oobleck_tpu.utils.chaos import chaos
+
+    chaos().barrier("worker_start", ip=agent_ip)
     args = OobleckArguments.from_dict(args_dict)
     job = args.job
     # Sanity mirrored from the reference (worker.py:27-28); JobArguments also
@@ -117,6 +120,15 @@ def worker_main(pipe, agent_ip: str, args_dict: dict) -> None:
     engine = OobleckEngine(args, agent_ip=agent_ip, agent_pipe=pipe)
     engine.initialize_distributed()
     engine.instantiate_pipelines(job.global_num_microbatch)
+    # Warm recovery: AOT-compile the stage executables of the likely
+    # post-failure plans into the persistent compilation cache on a
+    # background thread (execution/precompile.py) — at failure time the
+    # re-planned world deserializes instead of cold-compiling.
+    # OOBLECK_PRECOMPILE_WAIT=1 blocks until warm before step 1 (tests
+    # that inject a failure at a fixed step need the warmth guaranteed).
+    engine.start_recovery_precompile(
+        wait=os.environ.get("OOBLECK_PRECOMPILE_WAIT") == "1"
+    )
     engine.train()
     # Held-out evaluation at the end of the run (the reference builds eval
     # machinery it never drives, dataset.py:39-54 / dataloader.py:101).

@@ -13,8 +13,11 @@ All arrays are numpy int32 [seq_length]; batching is the dataloader's job.
 from __future__ import annotations
 
 import hashlib
+import logging
 
 import numpy as np
+
+logger = logging.getLogger("oobleck.dataset")
 
 
 class SyntheticTextDataset:
@@ -391,6 +394,7 @@ class HFImageTextDataset:
         self.train = train
         self.seed = seed
         self.epoch = 0
+        self._warned_vocab_overflow = False
 
     def set_epoch(self, epoch: int) -> None:
         self.epoch = epoch
@@ -402,7 +406,23 @@ class HFImageTextDataset:
         L = self.seq_length
         if self.tok is not None:
             ids = self.tok(text, truncation=True, max_length=L)["input_ids"]
-            ids = [i % self.vocab_size for i in ids]
+            if ids and max(ids) >= self.vocab_size:
+                # Tokenizer vocab exceeds the model's: ids MUST be folded
+                # into range to index the embedding table, but doing so
+                # aliases distinct tokens onto shared rows — a silent
+                # quality tax the operator should know about once, loudly.
+                if not self._warned_vocab_overflow:
+                    self._warned_vocab_overflow = True
+                    logger.warning(
+                        "tokenizer %s emits ids up to %d but the model's "
+                        "vocab_size is %d; out-of-range ids are folded "
+                        "mod vocab_size, ALIASING distinct tokens. Use a "
+                        "model with vocab_size >= the tokenizer's, or a "
+                        "matching tokenizer.",
+                        getattr(self.tok, "name_or_path", "?"), max(ids),
+                        self.vocab_size,
+                    )
+                ids = [i % self.vocab_size for i in ids]
         else:
             # Deterministic hash word-piece fallback: stable across
             # processes (heterogeneous pipelines need rank-independence),

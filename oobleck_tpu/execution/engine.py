@@ -60,6 +60,8 @@ from oobleck_tpu.parallel.train import make_optimizer
 from oobleck_tpu.planning.instantiator import HeterogeneousPlan, PipelineInstantiator
 from oobleck_tpu.planning.profiler import load_profile, profile
 from oobleck_tpu.planning.templates import PipelineTemplate, TemplateGenerator
+from oobleck_tpu.utils import recovery
+from oobleck_tpu.utils.chaos import chaos
 from oobleck_tpu.utils.timer import measure_time, sync_timers
 
 logger = logging.getLogger("oobleck.engine")
@@ -569,6 +571,15 @@ class OobleckEngine:
         self.dp_engine: DataParallelEngine | None = None
         self.step = 0
         self._exec_cache: dict = {}
+        # Warm-recovery precompiler (execution/precompile.py); armed by
+        # start_recovery_precompile and re-armed after each reconfigure.
+        self._precompiler = None
+        # RECOVERY_DEADLINE accounting: set when this engine's state came
+        # out of a recovery (in-place reconfigure, or a respawned world
+        # restoring live mirrors); cleared by the first completed step,
+        # which emits the FIRST_STEP mark.
+        self._recovering = False
+        self._recovered_at: float | None = None
         # Live-mirror background writer: snapshots are immutable jax arrays,
         # so the step thread only hands over references; the device_get +
         # pack + npz write happen off-thread (round-4 weak #3).
@@ -966,6 +977,10 @@ class OobleckEngine:
                     "checkpoint-free)", mirrored["meta"]["step"],
                 )
                 restored = mirrored
+                # This world exists because a peer died: the first step it
+                # completes closes the RECOVERY_DEADLINE chain.
+                self._recovering = True
+                self._recovered_at = time.monotonic()
         if restored is not None:
             old_params = restored["params"]
             # Optimizer leaves were stored flat; rebuild the optax structure.
@@ -1313,7 +1328,19 @@ class OobleckEngine:
             while self.step < max_steps:
                 tracer.on_step(self.step)
                 self._maybe_reconfigure()
+                # Fault-injection points (utils/chaos.py): the barrier ip/
+                # ordinal selectors let a test SIGKILL exactly one worker at
+                # exactly one step boundary.
+                chaos().barrier("step_start", ip=self.agent_ip)
                 loss = self._train_step()
+                chaos().barrier("step_end", ip=self.agent_ip)
+                if self._recovering:
+                    self._recovering = False
+                    recovery.mark(
+                        recovery.FIRST_STEP, step=self.step, ip=self.agent_ip,
+                        elapsed=None if self._recovered_at is None else round(
+                            time.monotonic() - self._recovered_at, 3),
+                    )
                 logger.info("step %d/%d loss %.4f", self.step, max_steps, loss)
                 if self.step % 10 == 0:
                     timers = sync_timers()
@@ -1885,6 +1912,96 @@ class OobleckEngine:
             logger.info("eval loss %.4f", mean_loss)
         return mean_loss
 
+    def predict_replan(self, lost_hosts: set[int],
+                       current: list[list[int]] | None = None):
+        """Host algebra + template re-match for losing `lost_hosts`, WITHOUT
+        mutating engine state: returns (plan, host_assignment, idle_hosts).
+
+        reconfigure() applies the prediction at failure time; the recovery
+        precompiler (execution/precompile.py) walks the same function AHEAD
+        of failure — sharing one code path is what guarantees the
+        precompiled executables carry byte-identical cache keys (stage
+        ranks included) to the ones recovery will ask for."""
+        if current is None:
+            current = [
+                sorted({r // self.chips_per_host for r in p.ranks})
+                for p in self.pipelines
+            ]
+        min_hosts = min(t.num_hosts for t in self.templates)
+        new_hosts = reconfigure_hosts(current, lost_hosts, min_hosts)
+
+        # Match each host group to the largest template it can fill,
+        # re-folding surplus hosts instead of silently idling them
+        # (fit_host_groups; round-1 advisor finding).
+        by_hosts = {t.num_hosts: t for t in self.templates}
+        sizes = sorted(by_hosts)
+        new_hosts, idle = fit_host_groups(new_hosts, sizes)
+        new_instances: dict[PipelineTemplate, int] = {}
+        for hosts in new_hosts:
+            t = by_hosts[len(hosts)]
+            new_instances[t] = new_instances.get(t, 0) + 1
+
+        ar_across = [p.allreduce_across_hosts for p in self.profiles]
+        plan = PipelineInstantiator().get_new_execution_plan(
+            new_instances, ar_across, self.plan.total_num_microbatches
+        )
+        # Pair each plan instance with a host group of exactly its size —
+        # explicit matching rather than relying on two separate sorts
+        # (plan.instances' canonical order vs a host-list sort) agreeing.
+        groups_by_size: dict[int, list[list[int]]] = {}
+        for g in new_hosts:
+            groups_by_size.setdefault(len(g), []).append(g)
+        host_assignment = [
+            groups_by_size[t.num_hosts].pop(0) for t in plan.instances
+        ]
+        return plan, host_assignment, idle
+
+    def start_recovery_precompile(self, wait: bool = False):
+        """Arm the warm-recovery precompiler: AOT-compile the stage
+        executables of the plans `predict_replan` would produce after
+        likely failures into the persistent compilation cache, on a
+        background thread (execution/precompile.py).
+
+        No-op (returns None) when disabled (`precompile_recovery_depth` 0 /
+        OOBLECK_PRECOMPILE=0), when there is no MPMD plan to predict from
+        (fused path recovers by mesh shrink — same program geometry class,
+        not a template re-match), or when the persistent compilation cache
+        is off (AOT warmth cannot outlive the in-process caches without
+        it). `wait=True` blocks until warm — tests that inject a failure at
+        a fixed early step need the warmth guaranteed, production wants the
+        background default."""
+        import os
+
+        from oobleck_tpu.utils.compile_cache import ensure_persistent_cache
+
+        depth = self.args.execution.precompile_recovery_depth
+        env = os.environ.get("OOBLECK_PRECOMPILE")
+        if env is not None:
+            try:
+                depth = int(env)
+            except ValueError:
+                logger.warning("ignoring malformed OOBLECK_PRECOMPILE=%r", env)
+        if depth <= 0 or self.fused is not None or self.plan is None:
+            return None
+        if ensure_persistent_cache() is None:
+            logger.info(
+                "recovery precompile skipped: persistent compilation cache "
+                "disabled (OOBLECK_JAX_CC=0)"
+            )
+            return None
+        from oobleck_tpu.execution.precompile import RecoveryPrecompiler
+
+        if self._precompiler is not None:
+            # Re-arm: stop the previous walk before starting a new one —
+            # two threads predicting from different topologies would race
+            # each other (and the training thread) on the shared caches.
+            self._precompiler.cancel()
+        self._precompiler = RecoveryPrecompiler(self, depth=depth)
+        self._precompiler.start()
+        if wait:
+            self._precompiler.wait()
+        return self._precompiler
+
     def request_reconfiguration(self, lost_ip: str) -> None:
         with self._lock:
             self._pending_lost.append(lost_ip)
@@ -1920,34 +2037,15 @@ class OobleckEngine:
             self._reconfigure_fused(lost_ip, lost_host, t0)
             return
 
-        # Current per-pipeline host lists (ranks -> ORIGINAL host indices).
-        current = [
-            sorted({r // self.chips_per_host for r in p.ranks})
-            for p in self.pipelines
-        ]
-        min_hosts = min(t.num_hosts for t in self.templates)
-        new_hosts = reconfigure_hosts(current, {lost_host}, min_hosts)
-
-        # Match each host group to the largest template it can fill,
-        # re-folding surplus hosts instead of silently idling them
-        # (fit_host_groups; round-1 advisor finding).
-        by_hosts = {t.num_hosts: t for t in self.templates}
-        sizes = sorted(by_hosts)
-        new_hosts, idle = fit_host_groups(new_hosts, sizes)
+        # Host algebra + template re-match, shared verbatim with the
+        # recovery precompiler so its AOT executables hit here.
+        plan, host_assignment, idle = self.predict_replan({lost_host})
         if idle:
             logger.warning(
                 "hosts %s idle after reconfiguration: no template extension "
-                "fits them (feasible sizes %s)", idle, sizes,
+                "fits them (feasible sizes %s)", idle,
+                sorted({t.num_hosts for t in self.templates}),
             )
-        new_instances: dict[PipelineTemplate, int] = {}
-        for hosts in new_hosts:
-            t = by_hosts[len(hosts)]
-            new_instances[t] = new_instances.get(t, 0) + 1
-
-        ar_across = [p.allreduce_across_hosts for p in self.profiles]
-        plan = PipelineInstantiator().get_new_execution_plan(
-            new_instances, ar_across, self.plan.total_num_microbatches
-        )
 
         # Surviving weights + optimizer state by layer (reference
         # _copy_model_states, engine.py:238-309: broadcast from an owner —
@@ -1960,24 +2058,20 @@ class OobleckEngine:
 
         self.host_ips.remove(lost_ip)
         self.plan = plan
-        # Pair each plan instance with a host group of exactly its size —
-        # explicit matching rather than relying on two separate sorts
-        # (plan.instances' canonical order vs a host-list sort) agreeing.
-        groups_by_size: dict[int, list[list[int]]] = {}
-        for g in new_hosts:
-            groups_by_size.setdefault(len(g), []).append(g)
-        host_assignment = [
-            groups_by_size[t.num_hosts].pop(0) for t in plan.instances
-        ]
         self._materialize_plan(
             plan, it_done, epoch, old_params, old_opt,
             host_assignment=host_assignment,
         )
         elapsed = time.perf_counter() - t0
         self.recovery_times.append(elapsed)
+        self._recovering = True
+        self._recovered_at = time.monotonic()
         logger.warning(
             "reconfigured after losing %s in %.2fs: %s", lost_ip, elapsed, plan,
         )
+        if self._precompiler is not None:
+            # Re-arm for the NEXT failure from the new (smaller) topology.
+            self.start_recovery_precompile()
 
     def _reconfigure_fused(self, lost_ip: str, lost_host: int, t0: float) -> None:
         """Fused-path recovery: shrink the global mesh to the surviving

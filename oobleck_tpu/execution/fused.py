@@ -24,7 +24,11 @@ import numpy as np
 import optax
 
 from oobleck_tpu.models.base import stack_layer_params
-from oobleck_tpu.parallel.train import TrainState, build_train_step
+from oobleck_tpu.parallel.train import (
+    TrainState,
+    build_train_step,
+    shift_targets,
+)
 
 logger = logging.getLogger("oobleck.fused")
 
@@ -267,12 +271,10 @@ class FusedPipeline:
 
     def eval_step(self, batch):
         tokens_mb = self._tokens_of(batch)
-        if jax.process_count() > 1:
-            tokens_mb = jax.make_array_from_callback(
-                tokens_mb.shape, self._step_fn.token_sharding,
-                lambda idx: tokens_mb[idx],
-            )
-        return self._eval_fn(self.state.params, tokens_mb)
+        tokens_mb, targets_mb = self._step_fn.globalize(
+            tokens_mb, shift_targets(np.asarray(tokens_mb))
+        )
+        return self._eval_fn(self.state.params, tokens_mb, targets_mb)
 
     def layer_state(self):
         """(params_layers, opt_layers) in the engine's checkpoint form.

@@ -180,13 +180,19 @@ class PipelineInstance:
         fsdp: int = -1,
         process_of_rank: list[int] | None = None,
         comm=None,
+        materialize_params: bool = True,
     ):
         """`process_of_rank` + `comm` switch on multi-host MPMD execution:
         stages owned by other jax.distributed processes are skipped locally
         and stage-to-stage edges that cross processes ride `comm` (a
         parallel.cross_host.ProcessComm) — the TPU-native analog of the
         reference's node-spanning pipelines over NCCL p2p
-        (/root/reference/oobleck/execution/pipeline.py:582-617)."""
+        (/root/reference/oobleck/execution/pipeline.py:582-617).
+
+        `materialize_params=False` builds the full stage layout (meshes,
+        shardings, stage fns) without allocating parameter arrays — the
+        recovery precompiler instantiates predicted post-failure layouts
+        this way purely to AOT-compile their executables."""
         assert len(ranks) == template.num_chips, (len(ranks), template.num_chips)
         self.pipeline_id = pipeline_id
         self.template = template
@@ -405,16 +411,17 @@ class PipelineInstance:
         # placement is neither possible nor needed — the owning process
         # materializes its own, from the same seed-42 stream).
         self.params: dict[int, Any] = {}
-        rng = jax.random.PRNGKey(42)  # reference fixes seed 42 (model.py:18)
-        for st in self.stages:
-            if not st.is_local:
-                continue
-            for li in st.layer_ids:
-                if params is not None and li in params:
-                    src = params[li]
-                else:
-                    src = self.model.init_layer(rng, li)
-                self.params[li] = jax.device_put(src, st.param_shardings[li])
+        if materialize_params:
+            rng = jax.random.PRNGKey(42)  # reference fixes seed 42 (model.py:18)
+            for st in self.stages:
+                if not st.is_local:
+                    continue
+                for li in st.layer_ids:
+                    if params is not None and li in params:
+                        src = params[li]
+                    else:
+                        src = self.model.init_layer(rng, li)
+                    self.params[li] = jax.device_put(src, st.param_shardings[li])
 
         self.grads: dict[int, Any] = {}
         self.last_eval_metrics: tuple[float, float] | None = None

@@ -1,0 +1,355 @@
+"""AOT precompilation of predicted post-failure execution plans.
+
+Oobleck's templates ARE the recovery plans: the planner precomputes, at
+startup, the pipeline template for every feasible node count, so losing a
+host never re-plans from scratch. What that leaves unbounded on the XLA
+side is COMPILATION — the re-matched template's stage programs (new layer
+grouping, new chip count per stage) have never been built, so the first
+post-recovery step pays a cold XLA compile exactly when the job is trying
+to prove it recovered. Observed worst case on the CPU gate: 480 s for the
+MoE fused-stage re-plan.
+
+RecoveryPrecompiler closes that gap. On a background thread it
+
+  1. walks `engine.predict_replan` — the SAME host-algebra + template
+     re-match that `reconfigure()` runs at failure time — for every
+     single-host loss from the current topology, chained `depth` failures
+     deep (depth 2 covers n-1 and n-2 worlds);
+  2. instantiates each predicted plan WITHOUT materializing parameters
+     (`materialize_params=False`: meshes, shardings and jitted stage fns
+     only — no arrays, no optimizer state);
+  3. AOT-lowers and compiles every process-local stage executable
+     (fwd/bwd/efwd, plus best-effort grad-accumulate and optimizer-update
+     programs) against abstract inputs carrying the exact shardings the
+     live path will dispatch with.
+
+Warmth propagates through two layers:
+
+  * the engine's shared `_exec_cache` holds the predicted plans' jit
+    objects under the same stage-signature keys `_build_stage_fns`
+    computes, so an in-place `reconfigure()` (single-controller) reuses
+    them directly;
+  * every AOT compile writes the serialized executable into JAX's
+    persistent compilation cache (utils/compile_cache.py), which is what
+    survives the respawn-based multi-host recovery — the fresh process
+    retraces and DESERIALIZES (~10x-100x faster than compiling) instead
+    of cold-compiling. This is the only warm path across a process
+    boundary: AOT does not prime the in-process jit dispatch cache even
+    within one process.
+
+Multi-host notes: only stages addressable from this process are compiled
+(executables cannot load onto non-addressable devices), and persistent
+cache keys on CPU embed the device assignment — predicted entries are
+exact for survivor worlds whose device ids are unchanged (victim = last
+host, the common drain/preemption shape) and a best-effort prefix
+otherwise. Every per-stage failure is swallowed and counted: the
+precompiler must never take down the training loop it exists to protect.
+"""
+
+from __future__ import annotations
+
+import logging
+import threading
+import time
+from typing import Any
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+logger = logging.getLogger("oobleck.precompile")
+
+
+def _sds(aval, sharding) -> jax.ShapeDtypeStruct:
+    return jax.ShapeDtypeStruct(np.shape(aval), aval.dtype, sharding=sharding)
+
+
+class RecoveryPrecompiler:
+    """Background AOT compiler for the engine's predicted recovery plans.
+
+    Lifecycle: construct -> start() -> (training runs) -> failure ->
+    reconfigure() finds warm executables. `wait()` blocks until the walk
+    finishes — tests that kill a worker at a fixed early step use it
+    (via OOBLECK_PRECOMPILE_WAIT=1) to make warmth deterministic.
+    """
+
+    def __init__(self, engine, depth: int = 2):
+        self.engine = engine
+        self.depth = depth
+        self.stats: dict[str, Any] = {
+            "plans": 0, "stages_compiled": 0, "stages_cached": 0,
+            "aux_compiled": 0, "errors": 0, "elapsed_s": None,
+        }
+        self._done_keys: set = set()
+        self._thread: threading.Thread | None = None
+        self._cancel = threading.Event()
+
+    # -- lifecycle ------------------------------------------------------ #
+
+    def start(self) -> None:
+        self._thread = threading.Thread(
+            target=self._run, name="oobleck-precompile", daemon=True
+        )
+        self._thread.start()
+
+    def cancel(self) -> None:
+        """Ask the walk to stop at the next plan/stage boundary. Used when
+        re-arming after a reconfigure: the old thread would otherwise keep
+        compiling stale-topology plans (and touching engine.pipelines/plan)
+        exactly while recovery is spending its time budget."""
+        self._cancel.set()
+
+    def wait(self, timeout: float | None = None) -> None:
+        if self._thread is not None:
+            self._thread.join(timeout)
+
+    @property
+    def running(self) -> bool:
+        return self._thread is not None and self._thread.is_alive()
+
+    # -- plan walk ------------------------------------------------------ #
+
+    def _run(self) -> None:
+        t0 = time.perf_counter()
+        try:
+            # Snapshot the topology under the engine lock: reconfigure()
+            # mutates pipelines/plan on the training thread, and a walk
+            # over a half-updated view would predict from garbage.
+            with self.engine._lock:
+                live_pipelines = list(self.engine.pipelines)
+            for pipes in self._predicted_pipelines(live_pipelines):
+                if self._cancel.is_set():
+                    break
+                self.stats["plans"] += 1
+                for pipe in pipes:
+                    if self._cancel.is_set():
+                        break
+                    self._aot_pipeline(pipe)
+        except Exception:
+            # The walk itself failing (planner infeasibility at the root,
+            # model without sample_batch, ...) degrades to cold recovery.
+            self.stats["errors"] += 1
+            logger.exception("recovery precompile walk failed")
+        self.stats["elapsed_s"] = round(time.perf_counter() - t0, 2)
+        logger.info(
+            "recovery precompile %s: %d plans, %d stage programs compiled "
+            "(%d already warm, %d aux, %d errors) in %.1fs",
+            "cancelled" if self._cancel.is_set() else "done",
+            self.stats["plans"], self.stats["stages_compiled"],
+            self.stats["stages_cached"], self.stats["aux_compiled"],
+            self.stats["errors"], self.stats["elapsed_s"],
+        )
+
+    def _predicted_pipelines(self, live_pipelines):
+        """Yield lists of (non-materialized) PipelineInstances: first the
+        LIVE pipelines (the matched-at-n template — warms the respawn path
+        for a restart at unchanged size), then every predicted plan for
+        1..depth chained single-host losses."""
+        engine = self.engine
+        yield list(live_pipelines)
+
+        cph = engine.chips_per_host
+        frontier = [[sorted({r // cph for r in p.ranks})
+                     for p in live_pipelines]]
+        seen_groups: set = set()
+        for _ in range(self.depth):
+            next_frontier = []
+            for groups in frontier:
+                for lost in sorted({h for g in groups for h in g}):
+                    if self._cancel.is_set():
+                        return
+                    try:
+                        plan, assignment, _idle = engine.predict_replan(
+                            {lost}, current=groups
+                        )
+                    except Exception:
+                        continue  # infeasible below min_hosts: nothing to warm
+                    sig = tuple(sorted(tuple(g) for g in assignment))
+                    if sig in seen_groups:
+                        continue
+                    seen_groups.add(sig)
+                    next_frontier.append(assignment)
+                    yield self._instantiate(plan, assignment)
+            frontier = next_frontier
+
+    def _instantiate(self, plan, host_assignment):
+        """Build the predicted plan's PipelineInstances: full stage layout
+        (meshes, shardings, jitted stage fns registered in the SHARED exec
+        cache) but no parameter arrays."""
+        from oobleck_tpu.execution.pipeline import PipelineInstance
+        from oobleck_tpu.execution.reconfigure import hosts_to_ranks
+
+        engine = self.engine
+        assignments = plan.assignments(ranks=[
+            hosts_to_ranks(hosts, engine.chips_per_host)
+            for hosts in host_assignment
+        ])
+        process_of_rank = (
+            [r // engine.chips_per_host for r in range(len(engine.devices))]
+            if engine.multihost else None
+        )
+        pipes = []
+        for a in assignments:
+            try:
+                pipes.append(PipelineInstance(
+                    pipeline_id=a.pipeline_index,
+                    template=a.template,
+                    ranks=list(a.ranks),
+                    model=engine.model,
+                    devices=engine.devices,
+                    num_microbatches=a.num_microbatches,
+                    total_num_microbatches=plan.total_num_microbatches,
+                    microbatch_size=engine.args.job.microbatch_size,
+                    seq_len=engine.seq_len,
+                    params=None,
+                    exec_cache=engine._exec_cache,
+                    tensor_parallel=engine.args.execution.tensor_parallel,
+                    sequence_parallel=engine.args.execution.sequence_parallel,
+                    fsdp=engine.args.execution.fsdp,
+                    process_of_rank=process_of_rank,
+                    comm=engine.comm,
+                    materialize_params=False,
+                ))
+            except Exception:
+                self.stats["errors"] += 1
+                logger.exception(
+                    "predicted pipeline %d (ranks %s) failed to instantiate",
+                    a.pipeline_index, list(a.ranks),
+                )
+        return pipes
+
+    # -- per-stage AOT -------------------------------------------------- #
+
+    def _aot_pipeline(self, pipe) -> None:
+        S = pipe.num_stages
+        for st in pipe.stages:
+            if self._cancel.is_set():
+                return
+            if not st.is_local or st.fwd is None:
+                continue
+            is_first = st.stage_index == 0
+            is_last = st.stage_index == S - 1
+            key = (
+                st.layer_ids, len(st.ranks), tuple(st.ranks),
+                pipe.microbatch_size, pipe.seq_len, is_first, is_last,
+                pipe.total_num_microbatches, st.tp, st.sp, st.use_fsdp,
+            )
+            if key in self._done_keys:
+                self.stats["stages_cached"] += 1
+                continue
+            try:
+                self._aot_stage(pipe, st, is_last)
+                self._done_keys.add(key)
+            except Exception:
+                self.stats["errors"] += 1
+                logger.exception(
+                    "AOT compile failed for stage %d (layers %s, ranks %s)",
+                    st.stage_index, list(st.layer_ids), list(st.ranks),
+                )
+
+    def _aot_stage(self, pipe, st, is_last: bool) -> None:
+        rng = jax.random.PRNGKey(0)
+        params_avals = tuple(
+            jax.tree.map(
+                _sds,
+                # Close over the layer index: init_layer branches on it in
+                # Python, so it must stay concrete under eval_shape.
+                jax.eval_shape(lambda r, _li=li: pipe.model.init_layer(r, _li),
+                               rng),
+                st.param_shardings[li],
+            )
+            for li in st.layer_ids
+        )
+        x_aval = None
+        if st.stage_index > 0:
+            x_aval = jax.tree.map(
+                lambda a: _sds(a, st.batch_sharding),
+                pipe._edge_aval(st.stage_index - 1),
+            )
+        mb_aval = None
+        if st.needs_batch:
+            sample = pipe.model.sample_batch(pipe.microbatch_size, pipe.seq_len)
+            mb_aval = {k: _sds(v, st.batch_sharding) for k, v in sample.items()}
+
+        st.fwd.lower(params_avals, x_aval, mb_aval).compile()
+        self.stats["stages_compiled"] += 1
+        if is_last:
+            st.bwd.lower(params_avals, x_aval, mb_aval).compile()
+        else:
+            dy_aval = jax.tree.map(
+                lambda a: _sds(a, st.batch_sharding),
+                pipe._edge_aval(st.stage_index),
+            )
+            st.bwd.lower(params_avals, x_aval, mb_aval, dy_aval).compile()
+        self.stats["stages_compiled"] += 1
+        if st.efwd is not None:
+            st.efwd.lower(params_avals, x_aval, mb_aval).compile()
+            self.stats["stages_compiled"] += 1
+
+        # Aux programs, best-effort (small next to a stage fwd+bwd, but the
+        # MoE recovery hang showed eager fallbacks here are not free):
+        # microbatch grad accumulation and the per-layer optimizer update.
+        try:
+            self._aot_grad_add(params_avals)
+            self._aot_opt_update(st, params_avals)
+        except Exception:
+            self.stats["errors"] += 1
+            logger.debug("aux AOT warm failed for stage %d", st.stage_index,
+                         exc_info=True)
+
+    def _aot_grad_add(self, params_avals) -> None:
+        cache = self.engine._exec_cache
+        add_fn = cache.get("grad_add")
+        if add_fn is None:
+            # Same program train_step builds on first use; registering it
+            # here means the live path cache-hits this jit object too.
+            add_fn = jax.jit(lambda a, b: jax.tree.map(jnp.add, a, b))
+            cache["grad_add"] = add_fn
+        key = ("grad_add", tuple(str(a) for a in jax.tree.leaves(params_avals)))
+        if key in self._done_keys:
+            return
+        add_fn.lower(params_avals, params_avals).compile()
+        self._done_keys.add(key)
+        self.stats["aux_compiled"] += 1
+
+    def _aot_opt_update(self, st, params_avals) -> None:
+        import optax
+
+        from jax.sharding import NamedSharding, PartitionSpec
+
+        optimizer = self.engine.optimizer
+        cache = self.engine._exec_cache
+        fn = cache.get(("opt_update", id(optimizer)))
+        if fn is None:
+            def upd(g, state, p, _opt=optimizer):
+                updates, new_state = _opt.update(g, state, p)
+                return optax.apply_updates(p, updates), new_state
+
+            fn = jax.jit(upd)
+            cache[("opt_update", id(optimizer))] = fn
+        replicated_of = {}
+        for li, p_aval in zip(st.layer_ids, params_avals):
+            key = ("opt_update",
+                   tuple(str(a) for a in jax.tree.leaves(p_aval)))
+            if key in self._done_keys:
+                continue
+            sharding_tree = st.param_shardings[li]
+            mesh = jax.tree.leaves(
+                sharding_tree, is_leaf=lambda x: hasattr(x, "mesh")
+            )[0].mesh
+            if id(mesh) not in replicated_of:
+                replicated_of[id(mesh)] = NamedSharding(mesh, PartitionSpec())
+            replicated = replicated_of[id(mesh)]
+            # Mirrors engine._place_opt_state: Adam mu/nu avals take the
+            # param shardings, scalar bookkeeping leaves go replicated.
+            state_aval = optax.tree_map_params(
+                optimizer,
+                lambda leaf, sh: _sds(leaf, sh),
+                jax.eval_shape(optimizer.init, p_aval),
+                sharding_tree,
+                transform_non_params=lambda leaf: _sds(leaf, replicated),
+                is_leaf=lambda x: hasattr(x, "mesh"),
+            )
+            fn.lower(p_aval, state_aval, p_aval).compile()
+            self._done_keys.add(key)
+            self.stats["aux_compiled"] += 1

@@ -67,20 +67,32 @@ def alibi_slopes(num_heads: int) -> jax.Array:
     return jnp.asarray(s, jnp.float32)
 
 
-def alibi_bias_from_slopes(slopes: jax.Array, seq_q: int,
-                           seq_k: int) -> jax.Array:
+def alibi_bias_from_slopes(slopes: jax.Array, seq_q: int, seq_k: int,
+                           causal: bool = True) -> jax.Array:
     """[h, Sq, Sk] ALiBi bias for the GIVEN slopes only — callers holding a
     head slice (TP rank, Ulysses shard) materialize h=H_local rows instead
-    of all H (the O(H S^2) buffer is the long-context memory hazard)."""
+    of all H (the O(H S^2) buffer is the long-context memory hazard).
+
+    Causal form: -slope * (q - k), the original ALiBi decoder penalty
+    (future keys are masked anyway, so the sign of the k > q half never
+    matters). Bidirectional (`causal=False`): -slope * |q - k| — the
+    symmetric "nonsym" variant of the ALiBi encoder ablations. The signed
+    form would REWARD attending to future keys (positive bias growing with
+    k - q), which is never the intent."""
     q_pos = jnp.arange(seq_q)[:, None] + (seq_k - seq_q)
     k_pos = jnp.arange(seq_k)[None, :]
     dist = (q_pos - k_pos).astype(jnp.float32)
+    if not causal:
+        dist = jnp.abs(dist)
     return -slopes[:, None, None] * dist[None]
 
 
-def alibi_bias(num_heads: int, seq_q: int, seq_k: int) -> jax.Array:
-    """[H, Sq, Sk] ALiBi bias: slope * -(q_pos - k_pos) for k <= q."""
-    return alibi_bias_from_slopes(alibi_slopes(num_heads), seq_q, seq_k)
+def alibi_bias(num_heads: int, seq_q: int, seq_k: int,
+               causal: bool = True) -> jax.Array:
+    """[H, Sq, Sk] ALiBi bias: -slope * (q - k) causal, -slope * |q - k|
+    bidirectional."""
+    return alibi_bias_from_slopes(alibi_slopes(num_heads), seq_q, seq_k,
+                                  causal=causal)
 
 
 @functools.cache
@@ -152,7 +164,8 @@ def causal_attention(
 
     def slope_bias():
         # Non-flash fallback: materialize from slopes (constant, exact).
-        return alibi_bias_from_slopes(alibi_slopes, q.shape[-2], k.shape[-2])
+        return alibi_bias_from_slopes(alibi_slopes, q.shape[-2], k.shape[-2],
+                                      causal=causal)
 
     if fn is ring_attention:
         # Ring handles unbiased causal self-attention only; anything else

@@ -70,8 +70,13 @@ def _scores(q, k, qi, ki, scale, bias_ref, slope_ref, *, causal: bool,
     q_pos = qi * BLOCK_Q + jax.lax.broadcasted_iota(jnp.int32, s.shape, 0)
     k_pos = ki * BLOCK_K + jax.lax.broadcasted_iota(jnp.int32, s.shape, 1)
     if slope_ref is not None:
-        # -slope * (q_pos - k_pos): identical to alibi_bias_from_slopes.
-        s = s - slope_ref[0, 0, 0] * (q_pos - k_pos).astype(jnp.float32)
+        # Identical to alibi_bias_from_slopes: -slope * (q - k) causal,
+        # -slope * |q - k| bidirectional (the signed form would reward
+        # future keys in the encoder case).
+        dist = (q_pos - k_pos).astype(jnp.float32)
+        if not causal:
+            dist = jnp.abs(dist)
+        s = s - slope_ref[0, 0, 0] * dist
     if causal:
         s = jnp.where(q_pos >= k_pos, s, NEG_INF)
     else:

@@ -102,6 +102,11 @@ def pvary_to(x, axes: tuple[str, ...]):
     lax.cond requires both branches to have identical varying-manual-axes
     types; this normalizes a branch output (or pytree) to a superset target.
     """
+    if not hasattr(lax, "pcast"):
+        # Pre-vma jax (no lax.pcast): shard_map carries no varying-manual-
+        # axes types, so branch types already agree — nothing to normalize.
+        return x
+
     def one(v):
         have = set(getattr(v.aval, "vma", ()) or ())
         missing = tuple(a for a in axes if a not in have)

@@ -107,6 +107,17 @@ def _to_shardings(mesh, spec_tree):
     )
 
 
+def shift_targets(tokens_mb: np.ndarray) -> np.ndarray:
+    """Next-token targets for [num_mb, mb, seq] tokens, shifted on the host.
+
+    The shift must see the GLOBAL sequence (targets[t] = token[t+1] crosses
+    seq-shard boundaries), so it happens here on the unsharded numpy batch
+    rather than inside the jitted step — see the note in loss_fn."""
+    return np.concatenate(
+        [tokens_mb[:, :, 1:], np.zeros_like(tokens_mb[:, :, :1])], axis=-1
+    )
+
+
 def build_train_step(model, mesh, *, num_microbatches: int, optimizer=None,
                      remat: bool | None = None):
     """Build (init_fn, step_fn) for the fused SPMD path.
@@ -215,13 +226,15 @@ def build_train_step(model, mesh, *, num_microbatches: int, optimizer=None,
         out_specs=P(), axis_names=set(ALL_AXES),
     )
 
-    def loss_fn(params, tokens_mb):
-        # Global next-token shift happens HERE, inside jit, where tokens are
-        # still a global (logically unsharded) array — so the shift is
-        # seq-shard-safe and no extra host->device inputs are needed.
-        targets_mb = jnp.concatenate(
-            [tokens_mb[:, :, 1:], jnp.zeros_like(tokens_mb[:, :, :1])], axis=-1
-        )
+    def loss_fn(params, tokens_mb, targets_mb):
+        # targets_mb is the globally next-token-shifted copy of tokens_mb,
+        # computed on the HOST (see _shift_targets).  Computing the shift
+        # inside jit looks equivalent — tokens are still logically global —
+        # but when the shifted array then feeds a shard_map in_spec that
+        # shards the sequence dim, the GSPMD partitioner on older jax
+        # (0.4.x) shifts each seq shard locally without the cross-shard
+        # halo exchange, silently corrupting the target at every shard
+        # boundary.  The host shift is equally global and version-proof.
         seq = tokens_mb.shape[2]
         mask_mb = jnp.broadcast_to(
             (jnp.arange(seq) < seq - 1).astype(jnp.float32), tokens_mb.shape
@@ -232,8 +245,9 @@ def build_train_step(model, mesh, *, num_microbatches: int, optimizer=None,
         valid = num_mb * tokens_mb.shape[1] * (seq - 1)
         return loss_sum / valid
 
-    def step_fn(state: TrainState, tokens_mb):
-        loss, grads = jax.value_and_grad(loss_fn)(state.params, tokens_mb)
+    def step_fn(state: TrainState, tokens_mb, targets_mb):
+        loss, grads = jax.value_and_grad(loss_fn)(state.params, tokens_mb,
+                                                  targets_mb)
         updates, new_opt = optimizer.update(grads, state.opt_state, state.params)
         new_params = optax.apply_updates(state.params, updates)
         metrics = StepMetrics(loss=loss, grad_norm=optax.global_norm(grads))
@@ -250,29 +264,37 @@ def build_train_step(model, mesh, *, num_microbatches: int, optimizer=None,
     jit_init = jax.jit(init_fn, out_shardings=state_shardings)
     jit_step = jax.jit(
         step_fn,
-        in_shardings=(state_shardings, token_sharding),
+        in_shardings=(state_shardings, token_sharding, token_sharding),
         out_shardings=(state_shardings, None),
         donate_argnums=(0,),
     )
+
+    def _global_arrays(*host_arrays):
+        if jax.process_count() > 1:
+            # Multi-process SPMD: every host computes the same global batch
+            # (same dataset + sampler seed); build the global array from the
+            # host-local copy — numpy inputs cannot carry non-trivial
+            # shardings across processes.
+            return tuple(
+                jax.make_array_from_callback(
+                    a.shape, token_sharding, lambda idx, a=a: a[idx]
+                )
+                for a in host_arrays
+            )
+        return host_arrays
 
     def wrapped_step(state, tokens):
         b, seq = tokens.shape
         assert b % num_mb == 0, f"batch {b} not divisible by {num_mb} microbatches"
         assert seq % sp == 0, f"seq {seq} not divisible by seq-parallel {sp}"
         tokens_mb = np.asarray(tokens).reshape(num_mb, b // num_mb, seq)
-        if jax.process_count() > 1:
-            # Multi-process SPMD: every host computes the same global batch
-            # (same dataset + sampler seed); build the global array from the
-            # host-local copy — numpy inputs cannot carry non-trivial
-            # shardings across processes.
-            tokens_mb = jax.make_array_from_callback(
-                tokens_mb.shape, token_sharding,
-                lambda idx: tokens_mb[idx],
-            )
-        return jit_step(state, tokens_mb)
+        tokens_mb, targets_mb = _global_arrays(tokens_mb,
+                                               shift_targets(tokens_mb))
+        return jit_step(state, tokens_mb, targets_mb)
 
     wrapped_step.jitted = jit_step
     wrapped_step.loss_fn = loss_fn
+    wrapped_step.globalize = _global_arrays
     wrapped_step.state_shardings = state_shardings
     wrapped_step.token_sharding = token_sharding
     return jit_init, wrapped_step

@@ -1,0 +1,169 @@
+"""Fault injection for the elastic control plane (env/config driven).
+
+Recovery claims are only as good as the faults they survived, so the
+failure modes the control plane defends against — lost peers, hung
+sockets, slow links — are injectable on demand and exercised by tests
+(the reference repo injects failures only by SIGKILLing whole agents
+from the outside; a hung-but-connected peer is not reproducible that
+way).
+
+One env var, ``OOBLECK_CHAOS``, holds a comma-separated list of
+directives; each directive is ``action=arg[:qual][@ip]``:
+
+    delay_send=0.25             sleep 0.25 s before every control message
+    delay_send=0.25:ping        ... only before PING messages
+    drop_send=ping              drop every PING before it hits the wire
+    drop_send=ping:3            drop only the 3rd PING
+    stall_heartbeat=2@10.0.0.1  agent 10.0.0.1 stops pinging after its
+                                2nd ping, socket left OPEN (the hung-peer
+                                case TCP disconnect detection cannot see)
+    kill_at=step_end:3@10.0.0.1 SIGKILL the process at the 3rd hit of the
+                                named barrier, on that host only
+
+Barriers are explicit calls (``chaos().barrier("step_end", ip=...)``)
+placed at recovery-relevant points: worker start, step start/end. The
+``@ip`` filter selects a victim in a cluster whose processes share one
+environment; directives without ``@ip`` match every process.
+
+Inactive chaos (no env var) costs one attribute read per hook — the
+layer is safe to leave compiled into production paths.
+"""
+
+from __future__ import annotations
+
+import logging
+import os
+import signal
+import time
+from dataclasses import dataclass
+
+logger = logging.getLogger("oobleck.chaos")
+
+ENV_VAR = "OOBLECK_CHAOS"
+
+_KNOWN_ACTIONS = ("delay_send", "drop_send", "stall_heartbeat", "kill_at")
+
+
+@dataclass
+class Rule:
+    action: str           # one of _KNOWN_ACTIONS
+    arg: str              # seconds / message kind / barrier name / count
+    qual: str | None      # ordinal (drop/kill) or kind filter (delay)
+    ip: str | None        # restrict to processes reporting this host ip
+
+    def matches_ip(self, ip: str | None) -> bool:
+        return self.ip is None or self.ip == ip
+
+    @property
+    def nth(self) -> int | None:
+        return int(self.qual) if self.qual else None
+
+
+def parse_spec(spec: str) -> list[Rule]:
+    rules: list[Rule] = []
+    for directive in spec.split(","):
+        directive = directive.strip()
+        if not directive:
+            continue
+        action, sep, payload = directive.partition("=")
+        if not sep or action not in _KNOWN_ACTIONS:
+            raise ValueError(
+                f"bad chaos directive {directive!r}: want "
+                f"action=arg[:qual][@ip] with action in {_KNOWN_ACTIONS}"
+            )
+        payload, _, ip = payload.partition("@")
+        arg, _, qual = payload.partition(":")
+        rule = Rule(action=action, arg=arg, qual=qual or None, ip=ip or None)
+        # Validate eagerly: a typo'd injection spec must fail the test run
+        # at parse time, not silently inject nothing.
+        if action == "delay_send":
+            float(rule.arg)
+        elif action == "stall_heartbeat":
+            int(rule.arg or 0)
+        elif rule.qual is not None:
+            int(rule.qual)
+        rules.append(rule)
+    return rules
+
+
+class Chaos:
+    """Parsed chaos directives + per-rule event counters for one process."""
+
+    def __init__(self, spec: str | None = None):
+        if spec is None:
+            spec = os.environ.get(ENV_VAR, "")
+        self.rules = parse_spec(spec)
+        self.active = bool(self.rules)
+        self._counts: dict[int, int] = {}
+
+    def _count(self, rule: Rule) -> int:
+        i = self.rules.index(rule)
+        self._counts[i] = self._counts.get(i, 0) + 1
+        return self._counts[i]
+
+    # -- control-plane message hooks (wired into message.send_msg) ------- #
+
+    def send_delay(self, kind: str) -> float:
+        """Seconds to sleep before sending a message of `kind`."""
+        return sum(
+            float(r.arg) for r in self.rules
+            if r.action == "delay_send" and r.qual in (None, kind)
+        )
+
+    def drop_send(self, kind: str) -> bool:
+        """Whether to silently drop a message of `kind` (counts events)."""
+        for r in self.rules:
+            if r.action == "drop_send" and r.arg == kind:
+                n = self._count(r)
+                if r.nth is None or n == r.nth:
+                    logger.warning("chaos: dropping %s message", kind)
+                    return True
+        return False
+
+    # -- heartbeat stall -------------------------------------------------- #
+
+    def heartbeat_stalled(self, ip: str | None) -> bool:
+        """True once this process's heartbeat should go silent. The socket
+        stays open — only the periodic traffic stops, which is exactly the
+        failure mode a `timeout=None` read never detects."""
+        for r in self.rules:
+            if r.action == "stall_heartbeat" and r.matches_ip(ip):
+                if self._count(r) > int(r.arg or 0):
+                    return True
+        return False
+
+    # -- named barriers ---------------------------------------------------- #
+
+    def barrier(self, name: str, ip: str | None = None) -> None:
+        """Hit a named barrier; a matching kill_at rule SIGKILLs the process
+        (no cleanup, no atexit — the honest worker-crash fault)."""
+        for r in self.rules:
+            if r.action != "kill_at" or r.arg != name or not r.matches_ip(ip):
+                continue
+            n = self._count(r)
+            if r.nth is None or n == r.nth:
+                logger.warning(
+                    "chaos: killing worker at barrier %s (hit %d, pid %d)",
+                    name, n, os.getpid(),
+                )
+                logging.shutdown()
+                os.kill(os.getpid(), signal.SIGKILL)
+                time.sleep(60)  # SIGKILL delivery is async; never proceed
+
+
+_instance: Chaos | None = None
+
+
+def chaos() -> Chaos:
+    """Process-global chaos config, parsed from OOBLECK_CHAOS on first use."""
+    global _instance
+    if _instance is None:
+        _instance = Chaos()
+    return _instance
+
+
+def reset(spec: str | None = None) -> Chaos:
+    """Re-parse (tests monkeypatch the env then call this)."""
+    global _instance
+    _instance = Chaos(spec)
+    return _instance

@@ -2,30 +2,105 @@
 
 The CPU test/gate environments are compile-bound, so the cache is ON by
 default; every consumer (tests/conftest.py, the multi-process test worlds,
-the __graft_entry__ driver gate) resolves the SAME directory through this
-helper so subprocess worlds share entries with the in-process suite.
+the __graft_entry__ driver gate, the recovery precompiler) resolves the
+SAME directory through this helper so subprocess worlds share entries with
+the in-process suite.
 
 Knobs:
   * OOBLECK_JAX_CC=0 disables the cache everywhere;
-  * JAX_COMPILATION_CACHE_DIR overrides the location.
+  * JAX_COMPILATION_CACHE_DIR overrides the location (taken verbatim —
+    permissions and sharing are then the operator's call).
 
-The default dir is jaxlib-versioned to bound cross-version aliasing. A
-poisoned entry CAN wedge execution (observed once: a hang inside a
-float(loss) readback on a cached fused program) — the remedy is
-`rm -rf /tmp/oobleck_jax_cc*`.
+The default dir is per-user (created 0700: cached executables are code,
+and a world-writable shared dir would let any local user plant entries
+another user's training job deserializes and runs), and keyed by jaxlib
+version PLUS a digest of the host CPU's feature flags: XLA:CPU specializes
+codegen to the detected ISA (AVX-512 vs AVX2 ...), so entries written on
+one machine can be subtly wrong on another when /tmp is shared or images
+are snapshotted across heterogeneous fleets. A poisoned entry CAN wedge
+execution (observed once: a hang inside a float(loss) readback on a cached
+fused program) — the remedy is removing the cache dir.
 """
 
 from __future__ import annotations
 
+import getpass
+import hashlib
 import os
+import platform
+import tempfile
+
+_cpu_sig_cache: str | None = None
+
+
+def host_cpu_signature() -> str:
+    """Short stable digest of the CPU features XLA:CPU specializes against.
+
+    Linux: the `flags`/`Features` lines of /proc/cpuinfo (one physical CPU's
+    worth — cores are homogeneous for codegen purposes). Elsewhere: the
+    machine/processor identifiers. Cached per process."""
+    global _cpu_sig_cache
+    if _cpu_sig_cache is not None:
+        return _cpu_sig_cache
+    feature_text = ""
+    try:
+        with open("/proc/cpuinfo") as f:
+            for line in f:
+                key = line.split(":", 1)[0].strip().lower()
+                if key in ("flags", "features"):
+                    feature_text = line.split(":", 1)[1].strip()
+                    break
+    except OSError:
+        pass
+    if not feature_text:
+        feature_text = f"{platform.machine()}/{platform.processor()}"
+    raw = f"{platform.machine()}|{feature_text}"
+    _cpu_sig_cache = hashlib.sha256(raw.encode()).hexdigest()[:12]
+    return _cpu_sig_cache
 
 
 def persistent_cache_dir() -> str | None:
-    """Resolved cache dir, or None when disabled (OOBLECK_JAX_CC=0)."""
+    """Resolved cache dir, or None when disabled (OOBLECK_JAX_CC=0).
+
+    The default location is created here with mode 0700 so every consumer
+    (including `_base_env` in the multi-process tests, which exports it to
+    subprocess worlds) gets a directory that already exists with the right
+    permissions."""
     if os.environ.get("OOBLECK_JAX_CC", "1") == "0":
         return None
     if os.environ.get("JAX_COMPILATION_CACHE_DIR"):
         return os.environ["JAX_COMPILATION_CACHE_DIR"]
     import jaxlib
 
-    return f"/tmp/oobleck_jax_cc_{jaxlib.__version__}"
+    try:
+        user = getpass.getuser()
+    except (KeyError, OSError):
+        user = f"uid{os.getuid()}"
+    d = os.path.join(
+        tempfile.gettempdir(),
+        f"oobleck_jax_cc_{user}",
+        f"{jaxlib.__version__}_{host_cpu_signature()}",
+    )
+    os.makedirs(d, mode=0o700, exist_ok=True)
+    # makedirs mode is masked by umask and ignored for pre-existing dirs;
+    # chmod makes 0700 unconditional on the user-level parent.
+    os.chmod(os.path.dirname(d), 0o700)
+    os.chmod(d, 0o700)
+    return d
+
+
+def ensure_persistent_cache() -> str | None:
+    """Point JAX's persistent compilation cache at `persistent_cache_dir()`.
+
+    Idempotent; returns the effective dir (None when disabled). The warm
+    recovery path depends on this: AOT-compiling a predicted plan only
+    helps a later (re)compile if the serialized executable lands in a
+    persistent cache both sides share (execution/precompile.py)."""
+    d = persistent_cache_dir()
+    if d is None:
+        return None
+    import jax
+
+    if jax.config.jax_compilation_cache_dir != d:
+        jax.config.update("jax_compilation_cache_dir", d)
+    return d

@@ -1,0 +1,64 @@
+"""RECOVERY_DEADLINE accounting: structured wall-time marks for the
+failure-detect -> broadcast -> respawn -> first-post-recovery-step chain.
+
+Each stage of a recovery emits one machine-parseable log line:
+
+    RECOVERY_DEADLINE {"event": "detect", "lost_ip": "...", "t": ...}
+
+The chain crosses three processes (master detects, agent respawns, worker
+steps), so the marks carry wall-clock epoch seconds and the lost host's ip
+as the correlation key — a log scrape joins them into the end-to-end
+recovery latency (processes on one machine share a clock; multi-machine
+deployments need NTP-class sync, which TPU pods have).
+
+``OOBLECK_RECOVERY_DEADLINE`` (seconds) arms an explicit budget: any mark
+carrying an ``elapsed`` beyond it logs a LOUD deadline-exceeded line. The
+deadline is accounting, not enforcement — recovery keeps going; the
+operator (and the chaos tests) get a greppable breach signal.
+"""
+
+from __future__ import annotations
+
+import json
+import logging
+import os
+import time
+
+logger = logging.getLogger("oobleck.recovery")
+
+MARK = "RECOVERY_DEADLINE"
+ENV_DEADLINE = "OOBLECK_RECOVERY_DEADLINE"
+
+# Canonical event names, in chain order.
+DETECT = "detect"          # master: failure observed (disconnect / deadline)
+BROADCAST = "broadcast"    # master: RECONFIGURATION sent to survivors
+NOTIFIED = "notified"      # agent: RECONFIGURATION received
+RESPAWN = "respawn"        # agent: replacement worker launched
+FIRST_STEP = "first_step"  # engine: first training step after recovery
+
+
+def deadline_s() -> float | None:
+    raw = os.environ.get(ENV_DEADLINE, "")
+    try:
+        return float(raw) if raw else None
+    except ValueError:
+        logger.warning("ignoring malformed %s=%r", ENV_DEADLINE, raw)
+        return None
+
+
+def mark(event: str, **fields) -> float:
+    """Emit one structured recovery mark; returns the wall-clock stamp."""
+    t = time.time()
+    rec = {"event": event, "t": round(t, 3)}
+    rec.update({k: v for k, v in fields.items() if v is not None})
+    logger.warning("%s %s", MARK, json.dumps(rec, sort_keys=True))
+    budget = deadline_s()
+    elapsed = fields.get("elapsed")
+    if budget is not None and elapsed is not None and elapsed > budget:
+        logger.error(
+            "%s EXCEEDED: %s took %.1fs against a %.1fs budget (%s)",
+            MARK, event, elapsed, budget,
+            json.dumps({k: v for k, v in fields.items() if k != "elapsed"},
+                       sort_keys=True),
+        )
+    return t

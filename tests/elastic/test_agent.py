@@ -7,6 +7,7 @@ worker launch."""
 
 import asyncio
 import multiprocessing as mp
+import threading
 
 import pytest
 
@@ -189,9 +190,48 @@ async def test_worker_watchdog_terminates_agent(job_args):
     agent.worker.process.alive = False
     agent.worker.process.exitcode = 1
 
+    # Await the coroutine directly: wait_for would wrap it in a Task, and a
+    # SystemExit inside a Task re-raises out of the event loop (crashing the
+    # run) instead of propagating here. The conftest's outer 30 s wait_for
+    # still bounds a hang.
     with pytest.raises(SystemExit):
-        await asyncio.wait_for(agent.worker_watch_loop(), timeout=5)
+        await agent.worker_watch_loop()
     task.cancel()
+
+
+@pytest.mark.asyncio
+async def test_heartbeats_flow_during_slow_bringup(job_args, monkeypatch):
+    """Profile-on-miss bring-up is compile-bound (minutes); the agent must
+    heartbeat through it, or the master's read deadline evicts a healthy
+    host before its worker ever launches."""
+    import oobleck_tpu.elastic.master as master_mod
+    monkeypatch.setattr(master_mod, "read_deadline", lambda interval: 0.5)
+    daemon, task = await start_master_with_job(job_args)
+    agent = OobleckAgent("127.0.0.1", daemon.port, "10.0.0.1")
+    agent.ping_interval = 0.1
+    release = threading.Event()
+    launched = []
+    monkeypatch.setattr(agent, "ensure_profile", lambda: release.wait(30))
+    monkeypatch.setattr(agent, "launch_worker", lambda: launched.append(True))
+    run_task = asyncio.create_task(agent.run())
+    try:
+        # Profiling blocks the bring-up for 3x the read deadline...
+        await asyncio.sleep(1.5)
+        # ...yet the pings kept the registration alive (and no
+        # RECONFIGURATION self-terminated the run task).
+        assert "10.0.0.1" in daemon.agents
+        assert not run_task.done()
+        assert not launched
+        release.set()
+        for _ in range(100):
+            if launched:
+                break
+            await asyncio.sleep(0.05)
+        assert launched
+    finally:
+        release.set()
+        run_task.cancel()
+        task.cancel()
 
 
 @pytest.mark.asyncio

@@ -1,0 +1,203 @@
+"""Fault-injection layer (utils/chaos.py) + the failure mode it exists to
+prove out: a hung-but-connected agent (socket open, heartbeat silent) is
+evicted by the master within its advertised read deadline — the case TCP
+disconnect detection can never see (reference master.py reads with
+timeout=None and would hang forever)."""
+
+import asyncio
+import os
+import signal
+import subprocess
+import sys
+import time
+
+import pytest
+
+from oobleck_tpu.utils import chaos as chaos_mod
+from oobleck_tpu.utils.chaos import Chaos, parse_spec
+
+
+@pytest.fixture(autouse=True)
+def _isolated_chaos():
+    """Never leak a chaos config into other tests via the process global."""
+    yield
+    chaos_mod.reset("")
+
+
+# --------------------------------------------------------------------- #
+# spec parsing
+
+
+def test_parse_spec_grammar():
+    rules = parse_spec(
+        "delay_send=0.25:ping, drop_send=ping:3,"
+        "stall_heartbeat=2@10.0.0.1, kill_at=step_end:3@10.0.0.2"
+    )
+    assert [(r.action, r.arg, r.qual, r.ip) for r in rules] == [
+        ("delay_send", "0.25", "ping", None),
+        ("drop_send", "ping", "3", None),
+        ("stall_heartbeat", "2", None, "10.0.0.1"),
+        ("kill_at", "step_end", "3", "10.0.0.2"),
+    ]
+    assert parse_spec("") == []
+
+
+@pytest.mark.parametrize("bad", [
+    "explode=now",            # unknown action
+    "delay_send",             # no '='
+    "delay_send=soon",        # non-numeric delay
+    "drop_send=ping:always",  # non-integer ordinal
+    "kill_at=step_end:x",     # non-integer ordinal
+])
+def test_parse_spec_rejects_typos_eagerly(bad):
+    # A typo'd injection spec must fail the run at parse time, not
+    # silently inject nothing and let the test pass vacuously.
+    with pytest.raises(ValueError):
+        parse_spec(bad)
+
+
+# --------------------------------------------------------------------- #
+# hook semantics
+
+
+def test_delay_and_drop_semantics():
+    c = Chaos("delay_send=0.25:ping,delay_send=0.1,drop_send=ping:2")
+    assert c.send_delay("ping") == pytest.approx(0.35)  # filtered + blanket
+    assert c.send_delay("register_agent") == pytest.approx(0.1)
+    # drop only the 2nd ping; other kinds untouched
+    assert not c.drop_send("ping")
+    assert c.drop_send("ping")
+    assert not c.drop_send("ping")
+    assert not c.drop_send("register_agent")
+
+
+def test_heartbeat_stall_threshold_and_ip_filter():
+    c = Chaos("stall_heartbeat=2@10.0.0.1")
+    # first 2 pings go out, then the agent goes silent — on the victim only
+    assert not c.heartbeat_stalled("10.0.0.1")
+    assert not c.heartbeat_stalled("10.0.0.1")
+    assert c.heartbeat_stalled("10.0.0.1")
+    assert not c.heartbeat_stalled("10.0.0.2")
+
+
+def test_inactive_chaos_is_a_noop():
+    c = Chaos("")
+    assert not c.active
+    assert c.send_delay("ping") == 0.0
+    assert not c.drop_send("ping")
+    assert not c.heartbeat_stalled(None)
+    c.barrier("step_end", ip="10.0.0.1")  # must not raise (or kill!)
+
+
+def test_kill_at_barrier_sigkills_for_real():
+    """kill_at delivers an honest SIGKILL (no cleanup, no atexit) at the
+    Nth hit of the named barrier — in a sacrificial subprocess."""
+    code = (
+        "import sys\n"
+        "from oobleck_tpu.utils.chaos import chaos\n"
+        "chaos().barrier('test_barrier', ip='10.0.0.9')\n"
+        "print('survived first hit', flush=True)\n"
+        "chaos().barrier('test_barrier', ip='10.0.0.9')\n"
+        "print('UNREACHABLE', flush=True)\n"
+    )
+    env = dict(os.environ, OOBLECK_CHAOS="kill_at=test_barrier:2@10.0.0.9")
+    p = subprocess.run([sys.executable, "-c", code], env=env,
+                       capture_output=True, text=True, timeout=60)
+    assert p.returncode == -signal.SIGKILL, (p.returncode, p.stderr)
+    assert "survived first hit" in p.stdout
+    assert "UNREACHABLE" not in p.stdout
+
+
+@pytest.mark.asyncio
+async def test_send_msg_honors_drop():
+    from oobleck_tpu.elastic.message import recv_msg, send_msg
+
+    chaos_mod.reset("drop_send=ping:1")
+    server_reader = {}
+
+    async def on_conn(reader, writer):
+        server_reader["r"] = reader
+
+    server = await asyncio.start_server(on_conn, "127.0.0.1", 0)
+    port = server.sockets[0].getsockname()[1]
+    _, w = await asyncio.open_connection("127.0.0.1", port)
+    await send_msg(w, {"kind": "ping"})        # dropped (1st ping)
+    await send_msg(w, {"kind": "ping", "n": 2})
+    msg = await recv_msg(server_reader["r"], timeout=5)
+    # the stream stays well-formed: the NEXT frame is the 2nd ping
+    assert msg == {"kind": "ping", "n": 2}
+    w.close()
+    server.close()
+
+
+# --------------------------------------------------------------------- #
+# the real-socket eviction: hung heartbeat -> bounded-time detection
+
+
+@pytest.mark.asyncio
+async def test_hung_heartbeat_peer_evicted_within_deadline(caplog):
+    """A v2 agent advertising a fast ping cadence goes silent WITHOUT
+    closing its socket. The master must evict it within its read deadline,
+    broadcast RECONFIGURATION to survivors, and stamp the RECOVERY_DEADLINE
+    detect mark with cause=heartbeat_deadline."""
+    from oobleck_tpu.config import OobleckArguments
+    from oobleck_tpu.elastic.master import OobleckMasterDaemon
+    from oobleck_tpu.elastic.message import (
+        PROTOCOL_VERSION,
+        RequestType,
+        ResponseType,
+        read_deadline,
+        recv_msg,
+        send_request,
+    )
+
+    args = OobleckArguments()
+    args.dist.node_ips = ["10.0.0.1", "10.0.0.2"]
+    daemon = OobleckMasterDaemon(port=0, launcher=None)
+    await daemon.start()
+    task = asyncio.create_task(daemon.serve_forever())
+    try:
+        r, w = await asyncio.open_connection("127.0.0.1", daemon.port)
+        await send_request(w, RequestType.LAUNCH_JOB, {"args": args.to_dict()})
+        assert (await recv_msg(r))["kind"] == ResponseType.SUCCESS.value
+        w.close()
+
+        # Survivor: v1 agent (default cadence -> 30 s deadline, outlives
+        # the test without pinging).
+        r_srv, w_srv = await asyncio.open_connection("127.0.0.1", daemon.port)
+        await send_request(w_srv, RequestType.REGISTER_AGENT,
+                           {"ip": "10.0.0.1"})
+        assert (await recv_msg(r_srv))["kind"] == ResponseType.SUCCESS.value
+
+        # Victim: v2 agent advertising a 0.5 s cadence, then total silence.
+        # Socket stays OPEN — disconnect detection has nothing to see.
+        deadline = read_deadline(0.5)
+        r_vic, w_vic = await asyncio.open_connection("127.0.0.1", daemon.port)
+        await send_request(w_vic, RequestType.REGISTER_AGENT,
+                           {"ip": "10.0.0.2", "protocol": PROTOCOL_VERSION,
+                            "ping_interval": 0.5})
+        assert (await recv_msg(r_vic))["kind"] == ResponseType.SUCCESS.value
+        assert daemon.agents["10.0.0.2"].read_deadline == deadline
+
+        t0 = time.monotonic()
+        await asyncio.sleep(1.0)
+        assert "10.0.0.2" in daemon.agents  # not evicted on mere silence...
+
+        msg = await recv_msg(r_srv, timeout=deadline + 5)
+        detected = time.monotonic() - t0
+        assert msg["kind"] == ResponseType.RECONFIGURATION.value
+        assert msg["lost_ip"] == "10.0.0.2"
+        assert "10.0.0.2" not in daemon.agents
+        assert "10.0.0.1" in daemon.agents  # survivor untouched
+        # ...but within the advertised deadline (+ scheduling slack)
+        assert detected < deadline + 3, detected
+        marks = [rec.message for rec in caplog.records
+                 if "RECOVERY_DEADLINE" in rec.message]
+        assert any('"event": "detect"' in m and "heartbeat_deadline" in m
+                   for m in marks), marks
+        assert any('"event": "broadcast"' in m for m in marks), marks
+        w_vic.close()
+        w_srv.close()
+    finally:
+        task.cancel()
+        await daemon.stop()

@@ -134,6 +134,71 @@ async def test_disconnect_broadcasts_reconfiguration(job_args):
 
 
 @pytest.mark.asyncio
+async def test_reregistration_survives_stale_connection_timeout(
+        job_args, monkeypatch, caplog):
+    """The agent's register() retry path re-dials; if the old half-dead
+    connection lingers on the master until its read deadline, that timeout
+    must NOT evict the agent's NEW live registration (or broadcast it as a
+    failure to survivors)."""
+    import oobleck_tpu.elastic.master as master_mod
+    monkeypatch.setattr(master_mod, "read_deadline", lambda interval: 0.5)
+    daemon, _, task = await start_master()
+    await launch_job(daemon, job_args)
+
+    # Old connection registers then goes silent WITHOUT closing — exactly
+    # what a leaked pre-retry socket looks like.
+    r_old, w_old = await connect(daemon)
+    await send_request(w_old, RequestType.REGISTER_AGENT, {"ip": "10.0.0.1"})
+    assert (await recv_msg(r_old))["kind"] == ResponseType.SUCCESS.value
+
+    # Fresh connection re-registers the same ip, superseding the old one.
+    r_new, w_new, _ = await register_agent(daemon, "10.0.0.1")
+    live = daemon.agents["10.0.0.1"]
+    r_srv, w_srv, _ = await register_agent(daemon, "10.0.0.2")
+
+    # Both live agents ping well past the stale connection's deadline; a
+    # spurious eviction would surface as RECONFIGURATION instead of PONG.
+    for _ in range(8):
+        for w, r in ((w_new, r_new), (w_srv, r_srv)):
+            await send_request(w, RequestType.PING)
+            assert (await recv_msg(r))["kind"] == ResponseType.PONG.value
+        await asyncio.sleep(0.2)
+
+    assert daemon.agents.get("10.0.0.1") is live
+    assert not any("RECOVERY_DEADLINE" in rec.message
+                   and '"event": "detect"' in rec.message
+                   for rec in caplog.records), "stale socket stamped a detect"
+    task.cancel()
+
+
+@pytest.mark.asyncio
+async def test_clean_exit_stamps_no_detect_mark(job_args, caplog):
+    """JOB_DONE followed by disconnect is a completion, not a failure: no
+    RECONFIGURATION broadcast AND no RECOVERY_DEADLINE detect mark — a
+    spurious detect would pollute the log-scrape recovery-latency join."""
+    daemon, _, task = await start_master()
+    await launch_job(daemon, job_args)
+    r1, w1, _ = await register_agent(daemon, "10.0.0.1")
+    r2, w2, _ = await register_agent(daemon, "10.0.0.2")
+
+    await send_request(w1, RequestType.JOB_DONE)
+    await asyncio.sleep(0.2)  # let the master process JOB_DONE first
+    w1.close()
+    for _ in range(100):
+        if "10.0.0.1" not in daemon.agents:
+            break
+        await asyncio.sleep(0.05)
+    assert "10.0.0.1" not in daemon.agents
+
+    # The survivor's next read is a PONG, not a RECONFIGURATION.
+    await send_request(w2, RequestType.PING)
+    assert (await recv_msg(r2))["kind"] == ResponseType.PONG.value
+    assert not any("RECOVERY_DEADLINE" in rec.message
+                   for rec in caplog.records), "clean exit left recovery marks"
+    task.cancel()
+
+
+@pytest.mark.asyncio
 async def test_coordinator_relay(job_args):
     """Worker's JAX coordinator address propagates to every agent
     (the reference's rank0-port chain, master.py:137-154)."""

@@ -248,28 +248,49 @@ def _kill(pid: int) -> None:
         pass
 
 
-@pytest.mark.parametrize("n_hosts,model_name,model_args,recovery_budget", [
-    (2, "gpt2", TINY_MODEL, 60),
-    (3, "gpt2", TINY_MODEL, 60),
-    # Elastic MoE across hosts: switch-MoE decoder (tuple carry with the
-    # aux accumulator) through the same recovery machinery. The recovery
-    # budget is compile-bound on the CPU test mesh (the survivor re-plans
-    # to a SINGLE fused stage it has never compiled — minutes cold); the
-    # 60 s BASELINE bound applies to TPU-class hardware with warm
-    # executable caches, asserted by the gpt2 variants above.
-    (2, "gpt2-moe-tiny", {}, 480),
-])
+@pytest.mark.parametrize(
+    "n_hosts,model_name,model_args,recovery_budget,chaos_kill", [
+        (2, "gpt2", TINY_MODEL, 60, False),
+        (3, "gpt2", TINY_MODEL, 60, False),
+        # Elastic MoE across hosts: switch-MoE decoder (tuple carry with
+        # the aux accumulator) through the same recovery machinery. The
+        # survivor re-plans to a SINGLE fused stage the pre-failure world
+        # never ran — historically a ~480 s cold compile on the CPU test
+        # mesh. With the recovery precompiler the pre-failure workers AOT
+        # that plan into the shared persistent compilation cache, so the
+        # respawn deserializes instead of compiling: budget 120 s. The
+        # failure itself is injected INSIDE the victim (OOBLECK_CHAOS
+        # SIGKILL at the step-3 barrier), not by the test poking pids.
+        (2, "gpt2-moe-tiny", {}, 120, True),
+    ])
 def test_multiprocess_mpmd_checkpoint_free_recovery(tmp_path, n_hosts,
                                                     model_name, model_args,
-                                                    recovery_budget):
+                                                    recovery_budget,
+                                                    chaos_kill):
     """n_hosts=2 exercises the degenerate single-survivor world (1-process
     collectives + own-mirror restore); n_hosts=3 exercises the REAL
     multi-survivor respawn: two survivors re-form a 2-process
     jax.distributed world and refill state through the cross-process
     freshest-mirror election."""
     hosts = [f"127.0.0.{i + 1}" for i in range(n_hosts)]
+    # Victim = LAST host: its device ids are the tail of the range, so the
+    # survivor world's assignment is a prefix — the shape the precompiler's
+    # persistent-cache entries are exact for (execution/precompile.py).
+    victim = hosts[-1]
     env = _base_env(tmp_path / "cache", 2)
     env["OOBLECK_MULTIHOST"] = "1"
+    if chaos_kill:
+        # The victim's worker SIGKILLs itself at the end of step 3; every
+        # worker holds training until the predicted-plan AOT walk is warm
+        # (PRECOMPILE_WAIT), so the kill always lands on a warm cache. The
+        # short death grace keeps the victim agent's wait for an explaining
+        # reconfiguration (none is coming — it IS the failure) off the
+        # recovery clock, and the armed deadline makes any stage running
+        # over budget scream in the log (utils/recovery.py).
+        env["OOBLECK_CHAOS"] = f"kill_at=step_end:3@{victim}"
+        env["OOBLECK_PRECOMPILE_WAIT"] = "1"
+        env["OOBLECK_WORKER_DEATH_GRACE"] = "5"
+        env["OOBLECK_RECOVERY_DEADLINE"] = str(recovery_budget)
     port = _free_port()
     cfg = {
         "dist": {"master_ip": "127.0.0.1", "master_port": port,
@@ -310,9 +331,10 @@ def test_multiprocess_mpmd_checkpoint_free_recovery(tmp_path, n_hosts,
         procs.append(master)
         # Startup window before the kill is compile-bound (MoE stage
         # programs trace slowly on a COLD persistent compile cache — the
-        # full-suite first run); the recovery_budget itself is only
-        # asserted kill->resume.
-        startup = 700 if "moe" in model_name else 420
+        # full-suite first run; PRECOMPILE_WAIT additionally AOT-compiles
+        # the predicted recovery plans before step 1); the recovery_budget
+        # itself is only asserted kill->resume.
+        startup = 900 if "moe" in model_name else 420
         deadline = time.monotonic() + startup + recovery_budget
         _wait_for(r"master listening", log, deadline)
 
@@ -342,13 +364,20 @@ def test_multiprocess_mpmd_checkpoint_free_recovery(tmp_path, n_hosts,
             rf"{n_hosts}\)", log, deadline)
         _wait_for(rf"step 2/{STEPS} loss [\d.]+", log, deadline)
 
-        # ---- failure injection: SIGKILL the LAST host's worker + agent ----
-        victim = hosts[-1]
+        # ---- failure injection: SIGKILL the LAST host ----
         survivors = hosts[:-1]
         offset = log.stat().st_size
-        t_kill = time.monotonic()
-        _kill(worker_pids[victim])
-        _kill(agent_pids[victim])
+        if chaos_kill:
+            # The victim kills ITSELF (OOBLECK_CHAOS, utils/chaos.py) at
+            # the step-3 barrier — an honest in-process crash, no outside
+            # hand on the pid. The recovery clock starts at the kill line.
+            _wait_for(r"chaos: killing worker at barrier step_end",
+                      log, deadline, after=offset)
+            t_kill = time.monotonic()
+        else:
+            t_kill = time.monotonic()
+            _kill(worker_pids[victim])
+            _kill(agent_pids[victim])
 
         _wait_for(rf"agent {re.escape(victim)} disconnected", log, deadline)
         _wait_for(rf"worker respawned for {len(survivors)} survivors",
@@ -377,6 +406,15 @@ def test_multiprocess_mpmd_checkpoint_free_recovery(tmp_path, n_hosts,
         assert float(m.group(2)) > 0
         print(f"mpmd checkpoint-free recovery ({n_hosts} hosts) "
               f"in {recovery_s:.1f}s")
+        if chaos_kill:
+            # The RECOVERY_DEADLINE chain is complete across all three
+            # processes, and no stage blew the armed budget.
+            _wait_for(r'RECOVERY_DEADLINE.*"event": "first_step"',
+                      log, deadline, after=offset)
+            text = log.read_text()[offset:]
+            for ev in ("detect", "broadcast", "notified", "respawn"):
+                assert f'"event": "{ev}"' in text, f"missing {ev} mark"
+            assert "RECOVERY_DEADLINE EXCEEDED" not in text
 
         _wait_for(rf"step {STEPS}/{STEPS} loss [\d.]+", log, deadline,
                   after=offset)

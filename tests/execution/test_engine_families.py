@@ -8,8 +8,14 @@ import pytest
 from tests.execution.test_engine import cache_env, make_engine  # noqa: F401
 
 
-@pytest.mark.parametrize("model_name", ["bert-tiny", "t5-tiny", "vit-tiny",
-                                        "resnet-tiny", "clip-tiny"])
+@pytest.mark.parametrize("model_name", [
+    "bert-tiny", "t5-tiny", "vit-tiny", "resnet-tiny", "clip-tiny",
+    "swin-micro",
+    # Decoder LMs beyond gpt2 (RoPE/GQA and ALiBi position schemes) ride the
+    # slow tier: gpt2-tiny already covers the decoder objective in tier 1.
+    pytest.param("llama-tiny", marks=pytest.mark.slow),
+    pytest.param("bloom-tiny", marks=pytest.mark.slow),
+])
 def test_engine_drives_every_family(cache_env, devices8, model_name):
     """The MPMD engine is objective-agnostic (reference pipeline.py:169-216):
     MLM encoders, encoder-decoders (incl. T5's mid-pipeline batch_layers

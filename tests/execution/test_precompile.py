@@ -1,0 +1,86 @@
+"""Bounded-time recovery: the RecoveryPrecompiler must make reconfigure()
+planning-free AND compile-free. The predicted-plan walk registers its jitted
+stage programs in the engine's shared exec cache under the exact
+stage-signature keys `_build_stage_fns` computes, so the post-failure
+instantiation cache-hits every stage instead of cold-compiling it (the 480 s
+MoE recovery hang this PR retires)."""
+
+import numpy as np
+import pytest
+
+from tests.execution.test_engine import cache_env, make_engine  # noqa: F401
+
+
+def _stage_keys(cache):
+    # Stage-signature keys are the 11-tuples _build_stage_fns computes;
+    # "grad_add" / ("opt_update", id) aux entries are keyed differently.
+    return {k for k in cache if isinstance(k, tuple) and len(k) == 11}
+
+
+def test_precompile_makes_reconfigure_compile_free(cache_env, devices8):
+    """Start the precompiler, let it finish, kill a host: reconfigure must
+    add ZERO new stage-signature keys to the exec cache — every stage
+    program of the recovery plan was already built — and training resumes
+    finite. This is the tentpole acceptance gate in miniature."""
+    engine = make_engine(num_hosts=4, steps=10, devices=devices8)
+    engine.initialize_distributed()
+    engine.instantiate_pipelines(engine.args.job.global_num_microbatch)
+    loss_before = engine._train_step()
+
+    pc = engine.start_recovery_precompile(wait=True)
+    assert pc is not None and not pc.running
+    assert pc.stats["plans"] >= 1          # live plan + n-1 (+ n-2) worlds
+    assert pc.stats["stages_compiled"] > 0
+    assert pc.stats["errors"] == 0, pc.stats
+    keys_before = _stage_keys(engine._exec_cache)
+    assert keys_before
+
+    engine.reconfigure("10.0.0.2")
+
+    assert _stage_keys(engine._exec_cache) == keys_before, (
+        "reconfigure compiled stage programs the precompiler should have "
+        "already built"
+    )
+    # the precompiler re-arms for the NEXT failure after each recovery
+    assert engine._precompiler is not None and engine._precompiler is not pc
+    engine._precompiler.wait()
+
+    losses = [engine._train_step() for _ in range(3)]
+    assert all(np.isfinite(l) for l in losses), losses
+    assert losses[-1] < loss_before
+
+
+def test_predict_replan_is_pure(cache_env, devices8):
+    """predict_replan must not mutate the engine: same host algebra and
+    template re-match reconfigure() runs, but read-only — the precompiler
+    calls it from a background thread while training steps run."""
+    engine = make_engine(num_hosts=4, steps=3, devices=devices8)
+    engine.initialize_distributed()
+    engine.instantiate_pipelines(engine.args.job.global_num_microbatch)
+    hosts_before = list(engine.host_ips)
+    ranks_before = [list(p.ranks) for p in engine.pipelines]
+
+    plan, assignment, idle = engine.predict_replan({2})
+
+    assert engine.host_ips == hosts_before
+    assert [list(p.ranks) for p in engine.pipelines] == ranks_before
+    used = sorted({h for g in assignment for h in g})
+    assert 2 not in used
+    assert set(used) <= {0, 1, 3}
+    assert plan.total_num_microbatches == engine.plan.total_num_microbatches
+
+
+def test_precompile_env_disable(cache_env, devices8, monkeypatch):
+    """OOBLECK_PRECOMPILE=0 must turn the feature off without touching the
+    config file (ops escape hatch)."""
+    engine = make_engine(num_hosts=2, steps=3, devices=devices8[:4])
+    engine.initialize_distributed()
+    engine.instantiate_pipelines(engine.args.job.global_num_microbatch)
+    monkeypatch.setenv("OOBLECK_PRECOMPILE", "0")
+    assert engine.start_recovery_precompile() is None
+    monkeypatch.setenv("OOBLECK_PRECOMPILE", "not-an-int")
+    # malformed override: warn and fall back to the config value (2)
+    pc = engine.start_recovery_precompile()
+    assert pc is not None
+    pc.wait()
+    assert pc.stats["errors"] == 0, pc.stats

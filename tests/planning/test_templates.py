@@ -110,19 +110,32 @@ def test_native_matches_python():
             assert [s.num_chips for s in a.stages] == [s.num_chips for s in b.stages]
 
 
-def test_native_builds_from_clean_tree():
-    """No binary blob ships in git (round-4 hygiene): deleting the built
-    libplanner.so must transparently rebuild it from planner.cpp on the
-    next use (build-on-import, planning/_native.py)."""
+def test_native_builds_from_clean_tree(tmp_path, monkeypatch):
+    """No binary blob ships in git (round-4 hygiene): a source tree with no
+    libplanner.so must transparently build it from planner.cpp on the next
+    use (build-on-import, planning/_native.py).
+
+    Runs against a COPY of csrc in tmp_path: the old version unlinked the
+    shared libplanner.so in-tree, racing every other test in the session
+    that had already loaded (or was about to load) the planner."""
+    import shutil
+
     from oobleck_tpu.planning import _native
 
-    if _native._SO.exists():
-        _native._SO.unlink()
-    _native._lib = None
+    csrc = tmp_path / "csrc"
+    csrc.mkdir()
+    for src in _native._CSRC.iterdir():
+        if src.name != _native._SO.name:  # clean tree: sources only
+            shutil.copy2(src, csrc / src.name)
+    monkeypatch.setattr(_native, "_CSRC", csrc)
+    monkeypatch.setattr(_native, "_SO", csrc / _native._SO.name)
+    monkeypatch.setattr(_native, "_lib", None)
     profiles = dummy_profiles(num_layers=6, chips_per_host=2, seed=0)
     out = _native.create_pipeline_templates(profiles, (1, 2), 2)
     assert _native._SO.exists(), "build-on-import did not produce the .so"
     assert out, "rebuilt planner returned no templates"
+    # teardown restores _CSRC/_SO/_lib to their pre-test values, so later
+    # tests keep using the real in-tree planner untouched.
 
 
 def test_json_roundtrip(profiles):

@@ -1,0 +1,69 @@
+"""Persistent-compilation-cache policy (utils/compile_cache.py): per-user
+0700 directory keyed by jaxlib version + host CPU signature, env-var
+disable and verbatim override, idempotent JAX wiring."""
+
+import os
+import stat
+
+import pytest
+
+from oobleck_tpu.utils.compile_cache import (
+    ensure_persistent_cache,
+    host_cpu_signature,
+    persistent_cache_dir,
+)
+
+
+def test_cpu_signature_stable_and_short():
+    a, b = host_cpu_signature(), host_cpu_signature()
+    assert a == b
+    assert len(a) == 12
+    int(a, 16)  # hex digest prefix
+
+
+def test_default_dir_is_per_user_0700(monkeypatch, tmp_path):
+    monkeypatch.delenv("JAX_COMPILATION_CACHE_DIR", raising=False)
+    monkeypatch.delenv("OOBLECK_JAX_CC", raising=False)
+    monkeypatch.setenv("TMPDIR", str(tmp_path))
+    import tempfile
+
+    tempfile.tempdir = None  # force re-resolution from TMPDIR
+    try:
+        d = persistent_cache_dir()
+    finally:
+        tempfile.tempdir = None
+    assert d is not None and d.startswith(str(tmp_path))
+    # <tmp>/oobleck_jax_cc_<user>/<jaxlib>_<cpusig>, both levels 0700:
+    # cached executables are code another process will deserialize and run.
+    parent = os.path.dirname(d)
+    assert os.path.basename(parent).startswith("oobleck_jax_cc_")
+    assert os.path.basename(d).endswith(f"_{host_cpu_signature()}")
+    for p in (parent, d):
+        assert stat.S_IMODE(os.stat(p).st_mode) == 0o700, p
+
+
+def test_env_disable_and_override(monkeypatch, tmp_path):
+    monkeypatch.setenv("OOBLECK_JAX_CC", "0")
+    assert persistent_cache_dir() is None
+    assert ensure_persistent_cache() is None
+
+    monkeypatch.setenv("OOBLECK_JAX_CC", "1")
+    monkeypatch.setenv("JAX_COMPILATION_CACHE_DIR", str(tmp_path / "custom"))
+    # the override is taken verbatim: no creation, no chmod — the
+    # operator owns permissions and sharing there.
+    assert persistent_cache_dir() == str(tmp_path / "custom")
+    assert not (tmp_path / "custom").exists()
+
+
+def test_ensure_persistent_cache_wires_jax_idempotently(monkeypatch, tmp_path):
+    import jax
+
+    monkeypatch.delenv("OOBLECK_JAX_CC", raising=False)
+    monkeypatch.setenv("JAX_COMPILATION_CACHE_DIR", str(tmp_path / "cc"))
+    before = jax.config.jax_compilation_cache_dir
+    try:
+        assert ensure_persistent_cache() == str(tmp_path / "cc")
+        assert jax.config.jax_compilation_cache_dir == str(tmp_path / "cc")
+        assert ensure_persistent_cache() == str(tmp_path / "cc")  # no-op
+    finally:
+        jax.config.update("jax_compilation_cache_dir", before)

@@ -102,7 +102,7 @@ def test_flash_inkernel_alibi_slopes_match_bias(shape, causal):
     ks = jax.random.split(jax.random.PRNGKey(11), 3)
     q, k, v = (jax.random.normal(kk, shape, jnp.float32) * 0.3 for kk in ks)
     slopes = alibi_slopes(shape[1])
-    bias = alibi_bias(shape[1], shape[2], shape[2])
+    bias = alibi_bias(shape[1], shape[2], shape[2], causal=causal)
 
     got = flash_attention(q, k, v, alibi_slopes=slopes, causal=causal)
     via_bias = flash_attention(q, k, v, bias=bias, causal=causal)
@@ -232,6 +232,24 @@ def test_ulysses_grads_match_xla(qkv, devices8):
     for a, b in zip(g1, g2):
         np.testing.assert_allclose(np.asarray(a), np.asarray(b),
                                    rtol=2e-2, atol=2e-3)
+
+
+def test_alibi_bidirectional_bias_is_symmetric_penalty():
+    """causal=False ALiBi uses -slope * |q - k|: symmetric in (q, k), never
+    positive (the signed form would REWARD attending to future keys), and
+    identical to the causal form on the lower triangle where both apply."""
+    from oobleck_tpu.ops.attention import alibi_bias
+
+    H, S = 4, 16
+    sym = np.asarray(alibi_bias(H, S, S, causal=False))
+    signed = np.asarray(alibi_bias(H, S, S, causal=True))
+    assert np.all(sym <= 0)
+    np.testing.assert_array_equal(sym, np.transpose(sym, (0, 2, 1)))
+    lower = np.tril_indices(S)
+    for h in range(H):
+        np.testing.assert_array_equal(sym[h][lower], signed[h][lower])
+    # and the signed form does reward the future half — the bug this guards
+    assert np.all(signed[:, 0, 1:] > 0)
 
 
 def test_ulysses_alibi_bias_matches_xla(qkv, devices8):
