@@ -157,18 +157,36 @@ def _measure() -> dict:
         "unit": "tokens/s/chip",
         "vs_baseline": round(vs, 3),
     }
-    # Achieved FLOP/s and MFU next to raw tokens/s: 6N per token for the
-    # matmuls (fwd+bwd) + the causal attention term.
+    # Achieved FLOP/s and MFU next to raw tokens/s, via the SAME estimate
+    # the engine's per-step MFU gauge uses (parallel/train.py) so bench and
+    # /metrics can never diverge.
+    from oobleck_tpu.parallel.train import estimate_flops_per_token, peak_flops
+    from oobleck_tpu.utils import metrics as metrics_mod
+
     n_params = sum(l.size for l in jax.tree.leaves(state.params))
     cfg = model.config
-    flops_per_token = 6 * n_params + 6 * (
-        getattr(cfg, "num_layers", 0) * getattr(cfg, "hidden_size", 0) * seq
+    flops_per_token = estimate_flops_per_token(
+        n_params, seq,
+        num_layers=getattr(cfg, "num_layers", 0),
+        hidden_size=getattr(cfg, "hidden_size", 0),
     )
     achieved = flops_per_token * tps_per_chip  # per chip
     result["tflops_per_chip"] = round(achieved / 1e12, 2)
-    peak = _peak_flops(jax.devices()[0].device_kind) if platform == "tpu" else None
+    peak = peak_flops(jax.devices()[0].device_kind) if platform == "tpu" else None
     if peak:
         result["mfu"] = round(achieved / peak, 4)
+    # Publish through the real metrics plane too: with OOBLECK_METRICS_DIR
+    # set, the headline numbers land in the same JSONL sink the engine and
+    # recovery chain write, keeping one trajectory record.
+    metrics_mod.set_role("bench")
+    reg = metrics_mod.registry()
+    reg.gauge("oobleck_bench_tokens_per_sec_per_chip",
+              "bench.py headline throughput").set(tps_per_chip)
+    reg.gauge("oobleck_bench_tflops_per_chip",
+              "bench.py achieved FLOP/s per chip").set(achieved / 1e12)
+    if peak:
+        reg.gauge("oobleck_bench_mfu", "bench.py MFU").set(achieved / peak)
+    metrics_mod.dump_jsonl()
     if flash_validated is not None:
         result["flash_validated"] = flash_validated
     if platform != "tpu":
@@ -255,14 +273,35 @@ def _validate_flash_on_device() -> bool:
         return False
 
 
-def _peak_flops(device_kind: str) -> float | None:
-    """Peak bf16 FLOP/s per chip by TPU generation (public specs)."""
-    kind = device_kind.lower()
-    for tag, peak in (("v5 lite", 197e12), ("v5e", 197e12),
-                      ("v5p", 459e12), ("v6", 918e12), ("v4", 275e12)):
-        if tag in kind:
-            return peak
-    return None
+def _metrics_sink_summary() -> dict | None:
+    """Summary of the OOBLECK_METRICS_DIR JSONL sink, or None when the dir is
+    unset/empty. Counters and histograms in the sink are per-process
+    cumulative, so only the LAST snapshot of each file counts; recovery
+    latency merges the per-process histograms before taking percentiles."""
+    from oobleck_tpu.utils import metrics as metrics_mod
+
+    d = os.environ.get(metrics_mod.ENV_METRICS_DIR)
+    if not d or not os.path.isdir(d):
+        return None
+    snaps = metrics_mod.latest_per_file(metrics_mod.read_jsonl_dir(d))
+    if not snaps:
+        return None
+    summary: dict = {"snapshots": len(snaps)}
+    for key, name in (("tokens_per_sec", "oobleck_engine_tokens_per_sec"),
+                      ("mfu", "oobleck_engine_mfu")):
+        series = metrics_mod.find_series(snaps, name)
+        if series:
+            summary[key] = round(max(s.get("value", 0.0) for s in series), 4)
+    rec = metrics_mod.merge_histogram_series(
+        metrics_mod.find_series(snaps, "oobleck_recovery_latency_seconds"))
+    if rec and rec.get("count"):
+        summary["recovery_latency_s"] = {
+            "count": int(rec["count"]),
+            "p50": round(metrics_mod.histogram_percentile(rec, 0.50), 3),
+            "p90": round(metrics_mod.histogram_percentile(rec, 0.90), 3),
+            "p99": round(metrics_mod.histogram_percentile(rec, 0.99), 3),
+        }
+    return summary
 
 
 def _cpu_proxy_env() -> dict:
@@ -281,6 +320,16 @@ def _cpu_proxy_env() -> dict:
 
 
 def _emit(result: dict) -> None:
+    # Fold in the JSONL metrics sink (engine gauges, recovery-latency
+    # percentiles) so the perf trajectory is tracked from real counters
+    # rather than ad-hoc prints. Best-effort: the ONE-JSON-line contract
+    # must survive a corrupt sink.
+    try:
+        sink = _metrics_sink_summary()
+        if sink:
+            result["metrics_sink"] = sink
+    except Exception as exc:  # noqa: BLE001 — emit must never fail
+        result["metrics_sink_error"] = f"{type(exc).__name__}: {exc}"
     print(json.dumps(result))
 
 

@@ -37,7 +37,7 @@ from oobleck_tpu.elastic.message import (
     recv_msg,
     send_request,
 )
-from oobleck_tpu.utils import recovery
+from oobleck_tpu.utils import metrics, recovery
 from oobleck_tpu.utils.chaos import chaos
 
 logger = logging.getLogger("oobleck.agent")
@@ -97,10 +97,24 @@ class OobleckAgent:
         # the worker exists. The `world` tag makes replaying a stale one
         # safe (the worker rejects mismatched generations).
         self._last_coordinator: dict | None = None
+        # Heartbeat RTT: stamp of the last PING sent; the PONG in the
+        # response loop closes the measurement.
+        self._ping_sent_at: float | None = None
+        reg = metrics.registry()
+        self._m_rtt = reg.gauge(
+            "oobleck_agent_heartbeat_rtt_seconds",
+            "Round-trip time of the last PING/PONG to the master")
+        self._m_worker_alive = reg.gauge(
+            "oobleck_agent_worker_alive",
+            "1 while this host's worker process is alive")
+        self._m_respawns = reg.counter(
+            "oobleck_agent_worker_respawns_total",
+            "Worker respawns triggered by reconfiguration")
 
     # ------------------------------------------------------------------ #
 
     async def run(self) -> None:
+        metrics.set_role("agent")
         await self.connect_to_master()
         await self.register()
         # Heartbeats must start the moment we are registered: the master's
@@ -134,7 +148,9 @@ class OobleckAgent:
         while True:
             await asyncio.sleep(1.0)
             w = self.worker
-            if w is None or w.process.is_alive():
+            alive = w is not None and w.process.is_alive()
+            self._m_worker_alive.set(1.0 if alive else 0.0)
+            if w is None or alive:
                 pending = None
                 continue
             if w.process.exitcode == 0:
@@ -303,6 +319,10 @@ class OobleckAgent:
         elapsed = time.monotonic() - t0
         logger.info("worker respawned for %d survivors in %.1fs",
                     len(self.node_ips), elapsed)
+        self._m_respawns.inc()
+        metrics.flight_recorder().record("worker_respawn", ip=self.agent_ip,
+                                         survivors=len(self.node_ips),
+                                         elapsed_s=round(elapsed, 3))
         since_notice = (
             time.monotonic() - self._notified_at
             if self._notified_at is not None else None
@@ -327,6 +347,10 @@ class OobleckAgent:
                 return
             kind = msg.get("kind")
             if kind == ResponseType.PONG.value:
+                if self._ping_sent_at is not None:
+                    rtt = time.monotonic() - self._ping_sent_at
+                    self._ping_sent_at = None
+                    self._m_rtt.set(rtt)
                 continue
             if kind == ResponseType.RECONFIGURATION.value:
                 await self.on_reconfiguration(msg["lost_ip"])
@@ -347,6 +371,8 @@ class OobleckAgent:
         """Reference on_receive_reconfiguration (agent.py:217-232)."""
         logger.warning("host %s lost", lost_ip)
         self._notified_at = time.monotonic()
+        metrics.flight_recorder().record("reconfiguration_notified",
+                                         lost_ip=lost_ip, ip=self.agent_ip)
         recovery.mark(recovery.NOTIFIED, lost_ip=lost_ip, ip=self.agent_ip)
         if lost_ip == self.agent_ip:
             # We are declared dead: the built-in failure-injection kill switch.
@@ -387,9 +413,24 @@ class OobleckAgent:
                 continue
             try:
                 async with self._send_lock:
+                    self._ping_sent_at = time.monotonic()
                     await send_request(self._writer, RequestType.PING)
+                # Piggyback this agent's registry snapshot on the heartbeat
+                # cadence — one extra fire-and-forget frame per interval.
+                await self._push_metrics("agent",
+                                         metrics.registry().snapshot())
             except ConnectionError:
                 return
+
+    async def _push_metrics(self, role: str, snapshot: dict) -> None:
+        """Ship one registry snapshot to the master (METRICS, no reply)."""
+        try:
+            async with self._send_lock:
+                await send_request(self._writer, RequestType.METRICS,
+                                   {"ip": self.agent_ip, "role": role,
+                                    "snapshot": snapshot})
+        except (ConnectionError, OSError):
+            pass  # the response/ping loops own connection-loss handling
 
     async def worker_port_loop(self) -> None:
         """Poll the worker pipe for upward messages: the coordinator
@@ -398,7 +439,12 @@ class OobleckAgent:
             try:
                 if self.worker is not None and self.worker.pipe.poll():
                     msg = self.worker.pipe.recv()
-                    if msg.get("kind") == "coordinator":
+                    if msg.get("kind") == "metrics":
+                        # Relay the worker's registry snapshot upward so the
+                        # master's /metrics covers training-quality gauges.
+                        await self._push_metrics(
+                            "worker", msg.get("snapshot") or {})
+                    elif msg.get("kind") == "coordinator":
                         # Keep the `world` generation tag intact: dropping
                         # it here would make every downstream worker take
                         # the untagged-trust branch and accept stale

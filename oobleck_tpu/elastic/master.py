@@ -32,6 +32,7 @@ import os
 import shutil
 import subprocess
 import sys
+import threading
 import time
 from dataclasses import dataclass, field
 
@@ -45,7 +46,7 @@ from oobleck_tpu.elastic.message import (
     recv_msg,
     send_response,
 )
-from oobleck_tpu.utils import recovery
+from oobleck_tpu.utils import metrics, recovery
 
 MAX_NUM_HOSTS = 32
 
@@ -61,6 +62,9 @@ class AgentInfo:
     protocol: int = 1
     ping_interval: float = DEFAULT_PING_INTERVAL
     read_deadline: float = read_deadline(DEFAULT_PING_INTERVAL)
+    # monotonic stamp of the last message on this channel; /status reports
+    # heartbeat ages from it.
+    last_seen: float = field(default_factory=time.monotonic)
 
 
 class LocalLauncher:
@@ -154,15 +158,60 @@ class OobleckMasterDaemon:
         self.coordinator_world: int | None = None  # its generation tag
         self._server: asyncio.Server | None = None
         self._pending_ips: list[str] = []
+        # Cluster metrics aggregation: latest registry snapshot per
+        # (host, role), pushed over METRICS. The threading.Lock (not an
+        # asyncio one) is deliberate — the HTTP endpoint reads this map
+        # from its own daemon threads.
+        self._snap_lock = threading.Lock()
+        self._remote_snapshots: dict[tuple[str, str], dict] = {}
+        # Recovery lifecycle for /status: detect → broadcast → resolved
+        # (first post-broadcast worker snapshot = the pipeline is stepping
+        # again).
+        self._recoveries: list[dict] = []
+        self.metrics_port: int | None = None
+        self._http: metrics.MetricsHTTPServer | None = None
+        reg = metrics.registry()
+        self._m_agents = reg.gauge(
+            "oobleck_master_agents", "Currently registered agents")
+        self._m_registrations = reg.counter(
+            "oobleck_master_registrations_total", "Agent registrations")
+        self._m_reconfigs = reg.counter(
+            "oobleck_master_reconfigurations_total",
+            "RECONFIGURATION broadcasts sent to survivors")
+        self._m_pushes = reg.counter(
+            "oobleck_master_metrics_pushes_total",
+            "METRICS snapshots received", )
 
     # ------------------------------------------------------------------ #
 
     async def start(self) -> None:
+        metrics.set_role("master")
         self._server = await asyncio.start_server(
             self._on_connected, host="0.0.0.0", port=self._requested_port
         )
         self.port = self._server.sockets[0].getsockname()[1]
         logger.info("master listening on :%d", self.port)
+        self._start_metrics_endpoint()
+
+    def _start_metrics_endpoint(self) -> None:
+        raw = os.environ.get(metrics.ENV_METRICS_PORT, "0")
+        try:
+            port = int(raw)
+        except ValueError:
+            logger.warning("malformed %s=%r ignored; using an ephemeral "
+                           "port", metrics.ENV_METRICS_PORT, raw)
+            port = 0
+        if port < 0:  # explicit opt-out
+            return
+        try:
+            self._http = metrics.MetricsHTTPServer(
+                self._render_metrics, self._status, port=port).start()
+        except OSError as e:
+            logger.warning("metrics endpoint unavailable: %s", e)
+            return
+        self.metrics_port = self._http.port
+        logger.info("metrics endpoint on :%d (/metrics, /status)",
+                    self.metrics_port)
 
     async def serve_forever(self) -> None:
         assert self._server is not None
@@ -175,6 +224,80 @@ class OobleckMasterDaemon:
         if self._server is not None:
             self._server.close()
             await self._server.wait_closed()
+        if self._http is not None:
+            self._http.close()
+            self._http = None
+
+    # ------------------------------------------------------------------ #
+    # metrics plane (called from the HTTP server's daemon threads)
+
+    def _render_metrics(self) -> str:
+        self._m_agents.set(len(self.agents))
+        snaps = [metrics.registry().snapshot()]
+        labels = [{"host": "master", "role": "master"}]
+        with self._snap_lock:
+            remotes = dict(self._remote_snapshots)
+        for (host, role), snap in sorted(remotes.items()):
+            snaps.append(snap)
+            labels.append({"host": host, "role": role})
+        return metrics.render_prometheus(snaps, labels)
+
+    def _status(self) -> dict:
+        now = time.monotonic()
+        agents = [
+            {
+                "ip": a.ip,
+                "protocol": a.protocol,
+                "ping_interval_s": a.ping_interval,
+                "read_deadline_s": a.read_deadline,
+                "heartbeat_age_s": round(now - a.last_seen, 3),
+                "clean_exit": a.clean_exit,
+            }
+            for a in self.agents.values()
+        ]
+        with self._snap_lock:
+            recoveries = [dict(r) for r in self._recoveries]
+            worker_snaps = {
+                host: snap for (host, role), snap
+                in self._remote_snapshots.items() if role == "worker"
+            }
+        # Current pipeline template, as reported by the workers themselves:
+        # the info-gauge value is the adoption step, so the highest value
+        # across all series (old plans linger in the registry) is current.
+        template = None
+        best = -1.0
+        for snap in worker_snaps.values():
+            for m in snap.get("metrics", []):
+                if m["name"] == "oobleck_engine_pipeline_template_info":
+                    for s in m["series"]:
+                        if s.get("value", 0) >= best:
+                            best = s.get("value", 0)
+                            template = s.get("labels", {})
+        return {
+            "job": self.job.model.model_name if self.job else None,
+            "agents": agents,
+            "coordinator": self.coordinator,
+            "pipeline_template": template,
+            "recoveries": recoveries,
+            "in_flight_recoveries": [
+                r for r in recoveries if r.get("resolved_at") is None
+            ],
+        }
+
+    def _record_metrics_push(self, msg: dict) -> None:
+        ip = msg.get("ip", "?")
+        role = msg.get("role", "agent")
+        snap = msg.get("snapshot") or {}
+        self._m_pushes.inc(role=role)
+        with self._snap_lock:
+            self._remote_snapshots[(ip, role)] = snap
+            if role == "worker":
+                # A worker shipping fresh metrics after a broadcast means
+                # the pipeline is stepping again: close open recoveries.
+                for r in self._recoveries:
+                    if (r.get("resolved_at") is None
+                            and r.get("broadcast_at") is not None):
+                        r["resolved_at"] = time.time()
 
     # ------------------------------------------------------------------ #
 
@@ -249,6 +372,10 @@ class OobleckMasterDaemon:
             read_deadline=read_deadline(interval),
         )
         self.agents[ip] = info
+        self._m_registrations.inc()
+        metrics.flight_recorder().record(
+            "register", ip=ip, protocol=info.protocol,
+            ping_interval=info.ping_interval)
         logger.info(
             "agent %s registered (protocol v%d, ping %.1fs, read deadline "
             "%.1fs)", ip, info.protocol, info.ping_interval,
@@ -296,6 +423,7 @@ class OobleckMasterDaemon:
                         "%.1fs); evicting hung peer", agent.ip,
                         agent.read_deadline, agent.ping_interval,
                     )
+                    self._on_failure_detected(agent.ip, "heartbeat_deadline")
                     recovery.mark(recovery.DETECT, lost_ip=agent.ip,
                                   cause="heartbeat_deadline",
                                   deadline=agent.read_deadline)
@@ -303,12 +431,18 @@ class OobleckMasterDaemon:
             except (asyncio.IncompleteReadError, ConnectionError):
                 if self._is_failure(agent):
                     logger.warning("agent %s disconnected", agent.ip)
+                    self._on_failure_detected(agent.ip, "disconnect")
                     recovery.mark(recovery.DETECT, lost_ip=agent.ip,
                                   cause="disconnect")
                 return
+            agent.last_seen = time.monotonic()
             kind = msg.get("kind")
             if kind == RequestType.PING.value:
+                metrics.flight_recorder().record("heartbeat", ip=agent.ip)
                 await send_response(agent.writer, ResponseType.PONG)
+            elif kind == RequestType.METRICS.value:
+                # Fire-and-forget: no response, never back-pressures pings.
+                self._record_metrics_push(msg)
             elif kind == RequestType.GET_DIST_INFO.value:
                 info = DistributionInfo(
                     agent_ips=list(self.agents.keys()),
@@ -345,6 +479,19 @@ class OobleckMasterDaemon:
         not when a re-registration already superseded this connection."""
         return not agent.clean_exit and self.agents.get(agent.ip) is agent
 
+    def _on_failure_detected(self, lost_ip: str, cause: str) -> None:
+        """Flight-record the detection, open a /status recovery entry, and
+        dump the ring — this is the postmortem moment."""
+        with self._snap_lock:
+            self._recoveries.append({
+                "lost_ip": lost_ip, "cause": cause,
+                "detected_at": time.time(), "broadcast_at": None,
+                "resolved_at": None,
+            })
+        fr = metrics.flight_recorder()
+        fr.record("detect", ip=lost_ip, cause=cause)
+        fr.dump(f"failure_detected:{lost_ip}")
+
     async def _close_agent(self, ip: str) -> None:
         """Reference close_agent (master.py:192-203): drop the agent and
         broadcast the loss to survivors — unless the agent announced a clean
@@ -360,6 +507,17 @@ class OobleckMasterDaemon:
                                     {"lost_ip": ip})
             except ConnectionError:
                 pass
+        self._m_reconfigs.inc()
+        with self._snap_lock:
+            for r in self._recoveries:
+                if r["lost_ip"] == ip and r["broadcast_at"] is None:
+                    r["broadcast_at"] = time.time()
+        fr = metrics.flight_recorder()
+        fr.record("reconfiguration_broadcast", lost_ip=ip,
+                  survivors=len(self.agents))
+        # Second dump so the postmortem file holds the complete sequence
+        # detect → broadcast (the detect-time dump races the broadcast).
+        fr.dump(f"reconfiguration_broadcast:{ip}")
         recovery.mark(recovery.BROADCAST, lost_ip=ip,
                       survivors=len(self.agents))
 

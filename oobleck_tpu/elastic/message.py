@@ -58,6 +58,11 @@ class RequestType(str, Enum):
     # drops the agent WITHOUT broadcasting RECONFIGURATION — completion must
     # not look like a failure to the surviving agents.
     JOB_DONE = "job_done"
+    # Fire-and-forget metrics push: an agent ships registry snapshots
+    # ({"ip", "role", "snapshot"}) for itself and its workers so the master
+    # can serve a merged cluster-wide /metrics view. No response — a slow
+    # metrics path must never back-pressure the heartbeat channel.
+    METRICS = "metrics"
 
 
 class ResponseType(str, Enum):

@@ -96,8 +96,10 @@ def worker_main(pipe, agent_ip: str, args_dict: dict) -> None:
     import signal as _signal
 
     faulthandler.register(_signal.SIGUSR1, all_threads=True)
+    from oobleck_tpu.utils import metrics
     from oobleck_tpu.utils.chaos import chaos
 
+    metrics.set_role("worker")
     chaos().barrier("worker_start", ip=agent_ip)
     args = OobleckArguments.from_dict(args_dict)
     job = args.job

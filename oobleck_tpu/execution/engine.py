@@ -60,7 +60,7 @@ from oobleck_tpu.parallel.train import make_optimizer
 from oobleck_tpu.planning.instantiator import HeterogeneousPlan, PipelineInstantiator
 from oobleck_tpu.planning.profiler import load_profile, profile
 from oobleck_tpu.planning.templates import PipelineTemplate, TemplateGenerator
-from oobleck_tpu.utils import recovery
+from oobleck_tpu.utils import metrics, recovery
 from oobleck_tpu.utils.chaos import chaos
 from oobleck_tpu.utils.timer import measure_time, sync_timers
 
@@ -592,6 +592,38 @@ class OobleckEngine:
 
         self._control_msgs: _queue.Queue = _queue.Queue()
 
+        # Training-quality metrics (utils/metrics.py): per-step gauges the
+        # master aggregates cluster-wide via the METRICS push.
+        reg = metrics.registry()
+        self._m_step_seconds = reg.histogram(
+            "oobleck_engine_step_seconds", "Wall time per training step")
+        self._m_steps = reg.counter(
+            "oobleck_engine_steps_total", "Completed training steps")
+        self._m_loss = reg.gauge(
+            "oobleck_engine_loss", "Training loss of the last step")
+        self._m_tokens_per_sec = reg.gauge(
+            "oobleck_engine_tokens_per_sec",
+            "Global training throughput of the last step")
+        self._m_mfu = reg.gauge(
+            "oobleck_engine_mfu",
+            "Model FLOPs utilization estimate of the last step")
+        self._m_bubble = reg.gauge(
+            "oobleck_engine_pipeline_bubble_fraction",
+            "Pipeline bubble fraction (kind=schedule: 1F1B closed form; "
+            "kind=measured: 1 - stage dispatch busy time / step time)")
+        self._m_reconfigs = reg.counter(
+            "oobleck_engine_reconfigurations_total",
+            "In-place reconfigurations completed")
+        self._m_template = reg.gauge(
+            "oobleck_engine_pipeline_template_info",
+            "Current pipeline layout (labels); value = step when adopted")
+        # (flops_per_token, peak_flops_per_chip|None, n_chips), resolved
+        # lazily on the first step; None when the model defies estimation.
+        self._flops_cache: Any = _UNSET
+        # The engine owns its tracer so reconfigure() can close a mid-window
+        # jax.profiler trace before tearing the old topology down.
+        self._tracer = None
+
         self.optimizer = make_optimizer(
             learning_rate=args.job.learning_rate,
             warmup_steps=args.job.warmup_steps,
@@ -1002,6 +1034,7 @@ class OobleckEngine:
                            "meta": {"step": self.step}}
             self._materialize_fused(global_num_microbatch,
                                     num_iterations_done, epoch, payload)
+            self._set_template_gauge()
             return
 
         ar_across = [p.allreduce_across_hosts for p in self.profiles]
@@ -1011,6 +1044,7 @@ class OobleckEngine:
         logger.info("execution plan: %s", self.plan)
         self._materialize_plan(self.plan, num_iterations_done, epoch,
                                old_params=old_params, old_opt=old_opt)
+        self._set_template_gauge()
 
     def _fused_devices(self) -> list:
         return [
@@ -1315,6 +1349,100 @@ class OobleckEngine:
         self.step += 1
         return global_loss
 
+    def _set_template_gauge(self) -> None:
+        """Current pipeline layout for /status: labels describe the plan,
+        the value is the step it was adopted at (the master picks the
+        series with the highest value as current)."""
+        if self.plan is not None:
+            self._m_template.set(
+                self.step,
+                pipelines=str(self.plan.total_num_pipelines),
+                stages="/".join(str(t.num_stages)
+                                for t in self.plan.instances),
+                microbatches="/".join(str(m)
+                                      for m in self.plan.num_microbatches),
+                hosts=str(len(self.host_ips)),
+            )
+        elif self.fused is not None:
+            self._m_template.set(
+                self.step, path="fused", hosts=str(len(self.host_ips)))
+
+    def _flops_info(self):
+        """(flops_per_token, peak_flops_per_chip|None, n_chips) for the MFU
+        gauge; None when the model defies the 6N estimate (cached)."""
+        if self._flops_cache is not _UNSET:
+            return self._flops_cache
+        try:
+            from oobleck_tpu.parallel.train import (
+                count_params,
+                estimate_flops_per_token,
+                peak_flops,
+            )
+
+            cfg = self.model.config
+            fpt = estimate_flops_per_token(
+                count_params(self.model), self.seq_len,
+                num_layers=getattr(cfg, "num_layers", 0),
+                hidden_size=getattr(cfg, "hidden_size", 0),
+            )
+            devices = self.devices or jax.devices()
+            self._flops_cache = (
+                fpt, peak_flops(devices[0].device_kind), len(devices))
+        except Exception as e:  # MFU is best-effort; training never pays
+            logger.info("MFU estimate unavailable: %s", e)
+            self._flops_cache = None
+        return self._flops_cache
+
+    def _bubble_fractions(self, step_s: float) -> dict[str, float]:
+        """Schedule-derived 1F1B bubble (S-1)/(M+S-1) plus, when per-stage
+        dispatch times exist, a measured 1 - busy/(S*step) variant."""
+        out: dict[str, float] = {}
+        sched_num = sched_den = 0.0
+        busy_s = 0.0
+        busy_slots = 0
+        for pipe in self.pipelines:
+            s = pipe.num_stages
+            m = pipe.num_microbatches
+            if m + s > 1:
+                sched_num += m * (s - 1) / (m + s - 1)
+                sched_den += m
+            if pipe.last_stage_busy_s:
+                busy_s += sum(pipe.last_stage_busy_s.values())
+                busy_slots += s
+        if sched_den:
+            out["schedule"] = sched_num / sched_den
+        if busy_slots and step_s > 0:
+            out["measured"] = max(0.0, 1.0 - busy_s / (busy_slots * step_s))
+        return out
+
+    def _record_step_metrics(self, loss: float, step_s: float) -> None:
+        self._m_steps.inc()
+        self._m_step_seconds.observe(step_s)
+        self._m_loss.set(loss)
+        if step_s > 0:
+            tokens = self.args.job.global_microbatch_size * self.seq_len
+            tps = tokens / step_s
+            self._m_tokens_per_sec.set(tps)
+            info = self._flops_info()
+            if info is not None:
+                fpt, peak, n_chips = info
+                if peak and n_chips:
+                    self._m_mfu.set(fpt * tps / n_chips / peak)
+        for kind, frac in self._bubble_fractions(step_s).items():
+            self._m_bubble.set(frac, kind=kind)
+
+    def _publish_metrics(self) -> None:
+        """Ship the registry snapshot up the agent pipe (relayed to the
+        master's /metrics) and append it to the JSONL sink."""
+        snap = metrics.registry().snapshot()
+        snap["step"] = self.step
+        if self.agent_pipe is not None:
+            try:
+                self.agent_pipe.send({"kind": "metrics", "snapshot": snap})
+            except (OSError, ValueError):
+                pass  # agent gone; the watch loops own that failure
+        metrics.dump_jsonl(snap)
+
     def train(self) -> None:
         """Reference train loop (engine.py:651-668) + loss reporting and
         periodic checkpointing (capability the reference lacks)."""
@@ -1323,24 +1451,33 @@ class OobleckEngine:
         max_steps = self.args.job.steps
         interval = self.args.execution.checkpoint_interval
         sync_interval = self.args.execution.replica_sync_interval
-        tracer = StepTracer()
+        self._tracer = StepTracer()
         try:
             while self.step < max_steps:
-                tracer.on_step(self.step)
+                self._tracer.on_step(self.step)
                 self._maybe_reconfigure()
                 # Fault-injection points (utils/chaos.py): the barrier ip/
                 # ordinal selectors let a test SIGKILL exactly one worker at
                 # exactly one step boundary.
                 chaos().barrier("step_start", ip=self.agent_ip)
+                t0 = time.perf_counter()
                 loss = self._train_step()
+                step_s = time.perf_counter() - t0
                 chaos().barrier("step_end", ip=self.agent_ip)
-                if self._recovering:
+                first_after_recovery = self._recovering
+                if first_after_recovery:
                     self._recovering = False
                     recovery.mark(
                         recovery.FIRST_STEP, step=self.step, ip=self.agent_ip,
                         elapsed=None if self._recovered_at is None else round(
                             time.monotonic() - self._recovered_at, 3),
                     )
+                self._record_step_metrics(loss, step_s)
+                if first_after_recovery:
+                    # Push at once: the master resolves the in-flight
+                    # recovery in /status on the first worker snapshot, and
+                    # must not wait out the periodic publish interval.
+                    self._publish_metrics()
                 logger.info("step %d/%d loss %.4f", self.step, max_steps, loss)
                 if self.step % 10 == 0:
                     timers = sync_timers()
@@ -1352,6 +1489,7 @@ class OobleckEngine:
                     logger.info("step timer: %s | %s%s",
                                 timers.get("step"), _device_memory_summary(),
                                 wire)
+                    self._publish_metrics()
                 if sync_interval and self.step % sync_interval == 0:
                     self._sync_replicas()
                 if interval and self.step % interval == 0:
@@ -1365,7 +1503,10 @@ class OobleckEngine:
                 self.save_checkpoint()
         finally:
             self._mirror_flush()
-            tracer.close()
+            self._publish_metrics()
+            if self._tracer is not None:
+                self._tracer.close()
+                self._tracer = None
 
     # ------------------------------------------------------------------ #
 
@@ -2033,6 +2174,10 @@ class OobleckEngine:
             logger.warning("unknown lost host %s", lost_ip)
             return
         lost_host = self._host_index[lost_ip]
+        # A mid-window jax.profiler trace must not straddle the topology
+        # change: close it now; the tracer re-arms on its next window.
+        if self._tracer is not None:
+            self._tracer.close()
         if self.fused is not None:
             self._reconfigure_fused(lost_ip, lost_host, t0)
             return
@@ -2066,6 +2211,12 @@ class OobleckEngine:
         self.recovery_times.append(elapsed)
         self._recovering = True
         self._recovered_at = time.monotonic()
+        self._m_reconfigs.inc(path="mpmd")
+        self._set_template_gauge()
+        recovery.observe_latency(elapsed, stage="reconfigure")
+        metrics.flight_recorder().record(
+            "engine_reconfigured", lost_ip=lost_ip, path="mpmd",
+            elapsed_s=round(elapsed, 3), step=self.step)
         logger.warning(
             "reconfigured after losing %s in %.2fs: %s", lost_ip, elapsed, plan,
         )
@@ -2093,6 +2244,12 @@ class OobleckEngine:
         self.fused = new_fused
         elapsed = time.perf_counter() - t0
         self.recovery_times.append(elapsed)
+        self._m_reconfigs.inc(path="fused")
+        self._set_template_gauge()
+        recovery.observe_latency(elapsed, stage="reconfigure")
+        metrics.flight_recorder().record(
+            "engine_reconfigured", lost_ip=lost_ip, path="fused",
+            elapsed_s=round(elapsed, 3), step=self.step)
         stranded = len(devices) - mesh.devices.size
         self.stranded_chips.append(stranded)
         logger.warning(

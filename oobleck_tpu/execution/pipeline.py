@@ -25,6 +25,7 @@ single-controller JAX runtime:
 from __future__ import annotations
 
 import logging
+import time
 from collections import deque
 from dataclasses import dataclass, field
 from typing import Any, Callable
@@ -205,6 +206,9 @@ class PipelineInstance:
         self._exec_cache = exec_cache if exec_cache is not None else {}
         self.comm = comm
         self._process_of_rank = process_of_rank
+        # Filled by each train_step: per-stage dispatch busy seconds, read
+        # by the engine's measured pipeline-bubble gauge.
+        self.last_stage_busy_s: dict[int, float] = {}
         my_process = comm.process_index if comm is not None else None
 
         tp = max(1, tensor_parallel)
@@ -696,6 +700,11 @@ class PipelineInstance:
         stash: dict[tuple[int, int], Any] = {}   # forward input stash for bwd
         losses: list[Any] = []
         grads: dict[int, Any] = {}
+        # Per-stage dispatch busy time this step, for the engine's measured
+        # pipeline-bubble gauge. Wall-clock around the fwd/bwd dispatch:
+        # exact on CPU (synchronous), a dispatch-cost floor under async
+        # device execution.
+        stage_busy: dict[int, float] = {}
 
         def params_of(st):
             return tuple(self.params[li] for li in st.layer_ids)
@@ -735,7 +744,10 @@ class PipelineInstance:
                     return
                 x = None if is_first else acts[key]
                 mb = stage_batch[m] if stage_batch is not None else None
+                t0 = time.perf_counter()
                 out = st.fwd(params_of(st), x, mb)
+                stage_busy[ins.stage] = (stage_busy.get(ins.stage, 0.0)
+                                         + time.perf_counter() - t0)
                 stash[key] = x
                 if is_last:
                     losses.append(out)
@@ -754,11 +766,14 @@ class PipelineInstance:
                     return
                 x = stash.pop(key)
                 mb = stage_batch[m] if stage_batch is not None else None
+                t0 = time.perf_counter()
                 if is_last:
                     stage_grads, dx = st.bwd(params_of(st), x, mb)
                 else:
                     dy = gacts.pop(key)
                     stage_grads, dx = st.bwd(params_of(st), x, mb, dy)
+                stage_busy[ins.stage] = (stage_busy.get(ins.stage, 0.0)
+                                         + time.perf_counter() - t0)
                 accumulate(st, stage_grads)
                 if dx is not None:
                     stash[(ins.stage, m, "dx")] = dx
@@ -779,6 +794,7 @@ class PipelineInstance:
             execute(ins)
 
         self.grads = grads
+        self.last_stage_busy_s = stage_busy
         if not losses:
             return None  # last stage lives on another process
         loss = sum(losses[1:], start=losses[0]) / len(losses)

@@ -139,6 +139,27 @@ class RecoveryPrecompiler:
             self.stats["stages_cached"], self.stats["aux_compiled"],
             self.stats["errors"], self.stats["elapsed_s"],
         )
+        # Mirror the walk's outcome into the metrics plane: each re-arm is a
+        # fresh instance, so incrementing by this run's totals keeps the
+        # process-lifetime counters cumulative.
+        from oobleck_tpu.utils import metrics
+
+        reg = metrics.registry()
+        reg.counter("oobleck_precompile_plans_total",
+                    "Recovery plans walked by the AOT precompiler").inc(
+                        self.stats["plans"])
+        stages = reg.counter(
+            "oobleck_precompile_stages_total",
+            "Stage programs seen by the AOT precompiler, by outcome")
+        for result, key in (("compiled", "stages_compiled"),
+                            ("cached", "stages_cached"),
+                            ("aux", "aux_compiled"), ("error", "errors")):
+            if self.stats[key]:
+                stages.inc(self.stats[key], result=result)
+        from oobleck_tpu.utils.compile_cache import cache_event
+
+        cache_event("hit", self.stats["stages_cached"])
+        cache_event("miss", self.stats["stages_compiled"])
 
     def _predicted_pipelines(self, live_pipelines):
         """Yield lists of (non-materialized) PipelineInstances: first the

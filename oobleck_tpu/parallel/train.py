@@ -118,6 +118,32 @@ def shift_targets(tokens_mb: np.ndarray) -> np.ndarray:
     )
 
 
+def count_params(model) -> int:
+    """Total parameter count via eval_shape (no device allocation)."""
+    shapes = jax.eval_shape(lambda: model.init_params(jax.random.PRNGKey(0)))
+    return sum(int(np.prod(l.shape)) for l in jax.tree.leaves(shapes))
+
+
+def estimate_flops_per_token(n_params: int, seq_len: int, *,
+                             num_layers: int = 0,
+                             hidden_size: int = 0) -> float:
+    """Training FLOPs per token: 6N for the matmuls (fwd+bwd) plus the
+    causal-attention term. Shared by bench.py's headline MFU and the
+    engine's per-step MFU gauge so the two can never diverge."""
+    return 6.0 * n_params + 6.0 * (num_layers * hidden_size * seq_len)
+
+
+def peak_flops(device_kind: str) -> float | None:
+    """Peak bf16 FLOP/s per chip by TPU generation (public specs);
+    None for unknown kinds (CPU, GPU) — MFU is then unreported."""
+    kind = device_kind.lower()
+    for tag, peak in (("v5 lite", 197e12), ("v5e", 197e12),
+                      ("v5p", 459e12), ("v6", 918e12), ("v4", 275e12)):
+        if tag in kind:
+            return peak
+    return None
+
+
 def build_train_step(model, mesh, *, num_microbatches: int, optimizer=None,
                      remat: bool | None = None):
     """Build (init_fn, step_fn) for the fused SPMD path.

@@ -117,6 +117,11 @@ class Chaos:
                 n = self._count(r)
                 if r.nth is None or n == r.nth:
                     logger.warning("chaos: dropping %s message", kind)
+                    from oobleck_tpu.utils import metrics
+
+                    metrics.flight_recorder().record(
+                        "chaos_injection", action="drop_send", kind=kind,
+                        hit=n)
                     return True
         return False
 
@@ -146,6 +151,15 @@ class Chaos:
                     "chaos: killing worker at barrier %s (hit %d, pid %d)",
                     name, n, os.getpid(),
                 )
+                # Persist the victim's flight recorder while we still can:
+                # SIGKILL leaves no other trace of the injection in the
+                # postmortem artifacts.
+                from oobleck_tpu.utils import metrics
+
+                metrics.flight_recorder().record(
+                    "chaos_injection", action="kill_at", barrier=name,
+                    hit=n, ip=ip, pid=os.getpid())
+                metrics.flight_recorder().dump(f"chaos_kill_at:{name}")
                 logging.shutdown()
                 os.kill(os.getpid(), signal.SIGKILL)
                 time.sleep(60)  # SIGKILL delivery is async; never proceed

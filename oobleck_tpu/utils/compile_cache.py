@@ -33,6 +33,20 @@ import tempfile
 _cpu_sig_cache: str | None = None
 
 
+def cache_event(event: str, n: int = 1) -> None:
+    """Count one persistent-cache event (enabled/disabled/hit/miss) in the
+    metrics registry. Hits/misses come from the recovery precompiler (the
+    one consumer that can tell a deserialization from a cold compile);
+    enable/disable comes from ensure_persistent_cache."""
+    if n <= 0:
+        return
+    from oobleck_tpu.utils import metrics
+
+    metrics.registry().counter(
+        "oobleck_compile_cache_events_total",
+        "Persistent compile-cache events by kind").inc(n, event=event)
+
+
 def host_cpu_signature() -> str:
     """Short stable digest of the CPU features XLA:CPU specializes against.
 
@@ -98,9 +112,11 @@ def ensure_persistent_cache() -> str | None:
     persistent cache both sides share (execution/precompile.py)."""
     d = persistent_cache_dir()
     if d is None:
+        cache_event("disabled")
         return None
     import jax
 
     if jax.config.jax_compilation_cache_dir != d:
         jax.config.update("jax_compilation_cache_dir", d)
+        cache_event("enabled")
     return d

@@ -24,6 +24,8 @@ import logging
 import os
 import time
 
+from oobleck_tpu.utils import metrics
+
 logger = logging.getLogger("oobleck.recovery")
 
 MARK = "RECOVERY_DEADLINE"
@@ -46,14 +48,38 @@ def deadline_s() -> float | None:
         return None
 
 
+def _latency_histogram() -> metrics.Histogram:
+    return metrics.registry().histogram(
+        "oobleck_recovery_latency_seconds",
+        "Per-chain-stage recovery latency (labeled by stage)",
+        buckets=metrics.RECOVERY_BUCKETS,
+    )
+
+
+def observe_latency(seconds: float, stage: str) -> None:
+    """Feed the recovery-latency histogram outside the mark chain (e.g. the
+    engine's in-place reconfigure wall time)."""
+    _latency_histogram().observe(float(seconds), stage=stage)
+
+
 def mark(event: str, **fields) -> float:
-    """Emit one structured recovery mark; returns the wall-clock stamp."""
+    """Emit one structured recovery mark; returns the wall-clock stamp.
+
+    Besides the greppable log line, every mark increments the
+    ``oobleck_recovery_marks_total`` counter, and marks that carry an
+    ``elapsed`` observe it into the per-stage recovery-latency histogram —
+    the /metrics view of the same chain the log scrape reconstructs."""
     t = time.time()
     rec = {"event": event, "t": round(t, 3)}
     rec.update({k: v for k, v in fields.items() if v is not None})
     logger.warning("%s %s", MARK, json.dumps(rec, sort_keys=True))
-    budget = deadline_s()
+    reg = metrics.registry()
+    reg.counter("oobleck_recovery_marks_total",
+                "RECOVERY_DEADLINE marks emitted").inc(stage=event)
     elapsed = fields.get("elapsed")
+    if elapsed is not None:
+        _latency_histogram().observe(float(elapsed), stage=event)
+    budget = deadline_s()
     if budget is not None and elapsed is not None and elapsed > budget:
         logger.error(
             "%s EXCEEDED: %s took %.1fs against a %.1fs budget (%s)",
@@ -61,4 +87,9 @@ def mark(event: str, **fields) -> float:
             json.dumps({k: v for k, v in fields.items() if k != "elapsed"},
                        sort_keys=True),
         )
+        reg.counter("oobleck_recovery_deadline_breaches_total",
+                    "Marks whose elapsed exceeded the budget").inc(
+                        stage=event)
+        # A breached deadline is the postmortem moment: persist the ring.
+        metrics.flight_recorder().dump(f"recovery_deadline_exceeded:{event}")
     return t

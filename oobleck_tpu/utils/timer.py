@@ -6,14 +6,24 @@ timer.py:8-21): wall-clock accumulation per named region, reported by the
 engine every 10 steps. No deepspeed here — a plain monotonic-clock
 accumulator; device-side sync is the caller's readback (see
 profiler._sync / SKILL.md note on the axon relay).
+
+Thread-safe: the step path mutates the accumulators from the training
+thread while ``sync_timers()`` reads them from logging/metrics paths (and
+the live-mirror writer runs off-thread), so every access goes through one
+module lock and readers get copies. Each observation is also routed into
+the metrics registry (``oobleck_timer_seconds{region=...}``) so timer
+regions appear in /metrics alongside the engine gauges.
 """
 
 from __future__ import annotations
 
 import functools
+import threading
 import time
 from collections import defaultdict
-from dataclasses import dataclass, field
+from dataclasses import dataclass
+
+from oobleck_tpu.utils import metrics
 
 
 @dataclass
@@ -30,8 +40,27 @@ class TimerStats:
         return (f"TimerStats(n={self.count}, last={self.last_s*1e3:.1f}ms, "
                 f"mean={self.mean_s*1e3:.1f}ms)")
 
+    def copy(self) -> "TimerStats":
+        return TimerStats(self.count, self.total_s, self.last_s)
 
+
+_lock = threading.Lock()
 _timers: dict[str, TimerStats] = defaultdict(TimerStats)
+
+
+def _histogram() -> metrics.Histogram:
+    return metrics.registry().histogram(
+        "oobleck_timer_seconds", "Wall time of named engine regions")
+
+
+def record(name: str, seconds: float) -> None:
+    """Record one observation for region `name`."""
+    with _lock:
+        st = _timers[name]
+        st.count += 1
+        st.total_s += seconds
+        st.last_s = seconds
+    _histogram().observe(seconds, region=name)
 
 
 def measure_time(name: str):
@@ -44,19 +73,19 @@ def measure_time(name: str):
             try:
                 return fn(*args, **kwargs)
             finally:
-                dt = time.perf_counter() - t0
-                st = _timers[name]
-                st.count += 1
-                st.total_s += dt
-                st.last_s = dt
+                record(name, time.perf_counter() - t0)
         return wrapper
 
     return deco
 
 
 def sync_timers() -> dict[str, TimerStats]:
-    return dict(_timers)
+    """Copies, not live references: a caller iterating the result must not
+    race the step thread's in-place mutation."""
+    with _lock:
+        return {name: st.copy() for name, st in _timers.items()}
 
 
 def reset_timers() -> None:
-    _timers.clear()
+    with _lock:
+        _timers.clear()

@@ -8,15 +8,24 @@ on-demand trace dump for a window of steps.
 
 Enable with OOBLECK_TRACE_DIR=/path — the engine wraps steps in named
 annotations and writes a perfetto-compatible trace for steps
-[OOBLECK_TRACE_START, OOBLECK_TRACE_START + OOBLECK_TRACE_STEPS).
+[OOBLECK_TRACE_START, OOBLECK_TRACE_START + OOBLECK_TRACE_STEPS). Set
+OOBLECK_TRACE_EVERY=<n> to re-arm the window every n steps for long runs
+(window k covers [START + k*EVERY, START + k*EVERY + STEPS)).
+
+Lifecycle: the engine owns one StepTracer per train() and calls close()
+from its finally AND from reconfigure() — a mid-window failure or topology
+change must not leave a jax.profiler trace open (start_trace raises on
+double-start, and an unclosed trace loses its buffered data).
 """
 
 from __future__ import annotations
 
-import contextlib
+import logging
 import os
 
 import jax
+
+logger = logging.getLogger("oobleck.tracing")
 
 
 def annotate(name: str):
@@ -24,29 +33,70 @@ def annotate(name: str):
     return jax.profiler.TraceAnnotation(name)
 
 
+def _env_int(name: str, default: int) -> int:
+    raw = os.environ.get(name, "")
+    try:
+        return int(raw) if raw else default
+    except ValueError:
+        logger.warning("ignoring malformed %s=%r", name, raw)
+        return default
+
+
 class StepTracer:
-    """Traces a configured window of training steps to OOBLECK_TRACE_DIR."""
+    """Traces windows of training steps to OOBLECK_TRACE_DIR."""
 
     def __init__(self):
         self.trace_dir = os.environ.get("OOBLECK_TRACE_DIR")
-        self.start = int(os.environ.get("OOBLECK_TRACE_START", "3"))
-        self.steps = int(os.environ.get("OOBLECK_TRACE_STEPS", "3"))
+        self.start = _env_int("OOBLECK_TRACE_START", 3)
+        self.steps = _env_int("OOBLECK_TRACE_STEPS", 3)
+        # 0 = one window (legacy behavior); n > 0 re-arms every n steps.
+        self.every = _env_int("OOBLECK_TRACE_EVERY", 0)
         self._active = False
+        self._done = False  # one-shot mode: window consumed (or closed)
+
+    def _window_start(self, step: int) -> int:
+        if self.every > 0 and step >= self.start:
+            k = (step - self.start) // self.every
+            return self.start + k * self.every
+        return self.start
 
     def on_step(self, step: int) -> None:
-        if not self.trace_dir:
+        if not self.trace_dir or self.steps <= 0:
             return
-        if (not self._active and step >= self.start
-                and step < self.start + self.steps):
-            # >= so a checkpoint-resumed run past `start` still traces its
-            # first post-resume window.
-            jax.profiler.start_trace(self.trace_dir)
+        ws = self._window_start(step)
+        in_window = ws <= step < ws + self.steps
+        if self._active:
+            if not in_window:
+                self._stop()
+            else:
+                return
+        if self._done and self.every <= 0:
+            return
+        if in_window:
+            try:
+                jax.profiler.start_trace(self.trace_dir)
+            except RuntimeError as e:
+                # Another component holds a trace open; skip this window
+                # rather than kill training.
+                logger.warning("trace window skipped: %s", e)
+                self._done = True
+                return
             self._active = True
-        elif self._active and step >= self.start + self.steps:
+
+    def _stop(self) -> None:
+        try:
             jax.profiler.stop_trace()
-            self._active = False
+        except RuntimeError as e:
+            logger.warning("stop_trace failed: %s", e)
+        self._active = False
+        if self.every <= 0:
+            self._done = True
 
     def close(self) -> None:
+        """Idempotent: stop an open window (engine shutdown/reconfigure).
+        One-shot mode stays closed; periodic mode re-arms at the next
+        window boundary."""
         if self._active:
-            jax.profiler.stop_trace()
-            self._active = False
+            self._stop()
+        if self.every <= 0:
+            self._done = True

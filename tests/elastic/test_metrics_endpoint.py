@@ -1,0 +1,160 @@
+"""Master /metrics + /status endpoints over the real control plane:
+agents register over TCP, push METRICS snapshots, and the stdlib HTTP
+endpoint serves the merged cluster view from its own daemon threads."""
+
+import asyncio
+import json
+import urllib.request
+
+import pytest
+
+from oobleck_tpu.elastic.message import RequestType, ResponseType, recv_msg, send_request
+from oobleck_tpu.utils import metrics
+
+from .test_control_plane import (  # noqa: F401 — job_args is a fixture
+    job_args,
+    launch_job,
+    register_agent,
+    start_master,
+)
+
+
+def _get(port: int, path: str) -> tuple[int, dict, bytes]:
+    with urllib.request.urlopen(
+            f"http://127.0.0.1:{port}{path}", timeout=5) as resp:
+        return resp.status, dict(resp.headers), resp.read()
+
+
+def _worker_snapshot(step: int, tps: float) -> dict:
+    """A registry snapshot as a worker process would push it."""
+    reg = metrics.Registry()
+    reg.gauge("oobleck_engine_tokens_per_sec").set(tps)
+    reg.gauge("oobleck_engine_pipeline_template_info").set(
+        float(step), pipelines="2", stages="2/2", hosts="2")
+    snap = reg.snapshot()
+    snap["step"] = step
+    return snap
+
+
+@pytest.mark.asyncio
+async def test_master_serves_cluster_metrics_and_status(job_args):
+    daemon, _, task = await start_master()
+    try:
+        assert daemon.metrics_port, "master must expose an HTTP endpoint"
+        await launch_job(daemon, job_args)
+        r1, w1, _ = await register_agent(daemon, "10.0.0.1")
+        r2, w2, _ = await register_agent(daemon, "10.0.0.2")
+
+        # Agents push their own and their worker's snapshots (as the real
+        # ping_loop / worker_port_loop relay does); METRICS has no response.
+        agent_reg = metrics.Registry()
+        agent_reg.gauge("oobleck_agent_heartbeat_rtt_seconds").set(0.002)
+        await send_request(w1, RequestType.METRICS, {
+            "ip": "10.0.0.1", "role": "agent",
+            "snapshot": agent_reg.snapshot()})
+        await send_request(w1, RequestType.METRICS, {
+            "ip": "10.0.0.1", "role": "worker",
+            "snapshot": _worker_snapshot(step=10, tps=1234.5)})
+        # An older template series from another worker must lose to the
+        # higher adoption step above.
+        old = _worker_snapshot(step=3, tps=999.0)
+        await send_request(w2, RequestType.METRICS, {
+            "ip": "10.0.0.2", "role": "worker", "snapshot": old})
+
+        # The pushes are fire-and-forget: round-trip a PING to know the
+        # master consumed everything sent before it on the same stream.
+        for w, r in ((w1, r1), (w2, r2)):
+            await send_request(w, RequestType.PING)
+            assert (await recv_msg(r))["kind"] == ResponseType.PONG.value
+
+        status, headers, body = await asyncio.to_thread(
+            _get, daemon.metrics_port, "/metrics")
+        assert status == 200
+        assert "text/plain" in headers["Content-Type"]
+        text = body.decode()
+        assert "# TYPE oobleck_master_agents gauge" in text
+        assert 'oobleck_master_agents{host="master",role="master"} 2' in text
+        assert ('oobleck_agent_heartbeat_rtt_seconds'
+                '{host="10.0.0.1",role="agent"} 0.002') in text
+        assert ('oobleck_engine_tokens_per_sec'
+                '{host="10.0.0.1",role="worker"} 1234.5') in text
+        assert ('oobleck_engine_tokens_per_sec'
+                '{host="10.0.0.2",role="worker"} 999') in text
+        # series labels win over the per-snapshot extras on collision
+        assert ('oobleck_master_metrics_pushes_total'
+                '{host="master",role="worker"} 2') in text
+
+        status, headers, body = await asyncio.to_thread(
+            _get, daemon.metrics_port, "/status")
+        assert status == 200
+        payload = json.loads(body)
+        assert {a["ip"] for a in payload["agents"]} == {"10.0.0.1",
+                                                        "10.0.0.2"}
+        for a in payload["agents"]:
+            assert a["heartbeat_age_s"] >= 0
+            assert not a["clean_exit"]
+        assert payload["job"] == job_args.model.model_name
+        # Highest adoption step wins the template pick.
+        assert payload["pipeline_template"]["pipelines"] == "2"
+        assert payload["recoveries"] == []
+        assert payload["in_flight_recoveries"] == []
+    finally:
+        await daemon.stop()
+        task.cancel()
+
+
+@pytest.mark.asyncio
+async def test_status_tracks_recovery_lifecycle(job_args, tmp_path,
+                                                monkeypatch):
+    """disconnect → /status shows an in-flight recovery stamped detect+
+    broadcast; a post-broadcast worker push resolves it; the master's
+    flight dump holds the detect AND the reconfiguration broadcast."""
+    monkeypatch.setenv(metrics.ENV_METRICS_DIR, str(tmp_path))
+    daemon, _, task = await start_master()
+    try:
+        await launch_job(daemon, job_args)
+        r1, w1, _ = await register_agent(daemon, "10.0.0.1")
+        r2, w2, _ = await register_agent(daemon, "10.0.0.2")
+
+        w2.close()  # host 2 dies silently
+        msg = await recv_msg(r1, timeout=5)
+        assert msg["kind"] == ResponseType.RECONFIGURATION.value
+
+        payload = daemon._status()
+        (rec,) = payload["recoveries"]
+        assert rec["lost_ip"] == "10.0.0.2"
+        assert rec["detected_at"] is not None
+        assert rec["broadcast_at"] is not None
+        assert rec["resolved_at"] is None
+        assert len(payload["in_flight_recoveries"]) == 1
+
+        # Survivor's worker steps again → pushes metrics → resolved.
+        await send_request(w1, RequestType.METRICS, {
+            "ip": "10.0.0.1", "role": "worker",
+            "snapshot": _worker_snapshot(step=11, tps=1000.0)})
+        await send_request(w1, RequestType.PING)
+        assert (await recv_msg(r1))["kind"] == ResponseType.PONG.value
+
+        payload = daemon._status()
+        assert payload["in_flight_recoveries"] == []
+        assert payload["recoveries"][0]["resolved_at"] is not None
+
+        dumps = sorted(p for p in tmp_path.iterdir()
+                       if p.name.startswith("flight-master-"))
+        assert dumps, "failure detection must dump the flight ring"
+        # The later dump (reconfiguration_broadcast) holds the whole story.
+        events = [json.loads(line)
+                  for line in dumps[-1].read_text().splitlines()]
+        assert "reconfiguration_broadcast" in events[0]["reason"]
+        # The process-global ring may hold events from earlier tests'
+        # teardowns; anchor on THIS failure's ip and the latest occurrences.
+        det = [i for i, e in enumerate(events)
+               if e["event"] == "detect" and e.get("ip") == "10.0.0.2"]
+        bc = [i for i, e in enumerate(events)
+              if e["event"] == "reconfiguration_broadcast"
+              and e.get("lost_ip") == "10.0.0.2"]
+        assert det and bc, "dump must hold the injected failure + broadcast"
+        assert det[-1] < bc[-1]
+    finally:
+        await daemon.stop()
+        task.cancel()

@@ -291,6 +291,11 @@ def test_multiprocess_mpmd_checkpoint_free_recovery(tmp_path, n_hosts,
         env["OOBLECK_PRECOMPILE_WAIT"] = "1"
         env["OOBLECK_WORKER_DEATH_GRACE"] = "5"
         env["OOBLECK_RECOVERY_DEADLINE"] = str(recovery_budget)
+        # Metrics-plane acceptance: every process writes JSONL snapshots
+        # and flight-recorder dumps here; the master serves /metrics and
+        # /status on an ephemeral port announced in its log.
+        metrics_dir = tmp_path / "metrics"
+        env["OOBLECK_METRICS_DIR"] = str(metrics_dir)
     port = _free_port()
     cfg = {
         "dist": {"master_ip": "127.0.0.1", "master_port": port,
@@ -415,6 +420,67 @@ def test_multiprocess_mpmd_checkpoint_free_recovery(tmp_path, n_hosts,
             for ev in ("detect", "broadcast", "notified", "respawn"):
                 assert f'"event": "{ev}"' in text, f"missing {ev} mark"
             assert "RECOVERY_DEADLINE EXCEEDED" not in text
+
+            # ---- metrics plane: scrape the master while the recovered
+            # world is still training ----
+            import json
+            import urllib.request
+
+            mport = int(_wait_for(r"metrics endpoint on :(\d+)", log,
+                                  deadline).group(1))
+
+            def _get(path: str) -> bytes:
+                with urllib.request.urlopen(
+                        f"http://127.0.0.1:{mport}{path}", timeout=10) as r:
+                    assert r.status == 200
+                    return r.read()
+
+            # The post-recovery worker push is in flight (pipe -> agent ->
+            # TCP); poll until the cluster-wide view shows it.
+            prom = ""
+            while time.monotonic() < deadline:
+                prom = _get("/metrics").decode()
+                if re.search(r'oobleck_engine_tokens_per_sec\{[^}]*'
+                             r'role="worker"', prom):
+                    break
+                time.sleep(0.5)
+            assert re.search(
+                r'oobleck_engine_tokens_per_sec\{[^}]*role="worker"[^}]*\} '
+                r'[0-9.eE+]+', prom), "no worker throughput gauge:\n" + prom
+            assert "# TYPE oobleck_recovery_latency_seconds histogram" in prom
+            lat_counts = [
+                int(c) for c in re.findall(
+                    r'oobleck_recovery_latency_seconds_count\{[^}]*\} (\d+)',
+                    prom)
+            ]
+            assert sum(lat_counts) > 0, (
+                "recovery-latency histogram empty:\n" + prom)
+
+            status = json.loads(_get("/status"))
+            assert {a["ip"] for a in status["agents"]} == set(survivors), (
+                "post-recovery agent set wrong: " + repr(status["agents"]))
+            assert any(r["lost_ip"] == victim and r["broadcast_at"]
+                       for r in status["recoveries"]), status["recoveries"]
+
+            # ---- flight recorder dumps ----
+            flights = {
+                p: [json.loads(line) for line in
+                    p.read_text().splitlines()]
+                for p in sorted(metrics_dir.glob("flight-*.jsonl"))
+            }
+            assert flights, "no flight-recorder dump written"
+            # The victim recorded the injection before SIGKILLing itself.
+            assert any(any(e["event"] == "chaos_injection" for e in evs)
+                       for evs in flights.values()), list(flights)
+            # The master's broadcast-time dump holds the whole failure
+            # sequence: detect -> reconfiguration_broadcast.
+            assert any(
+                "detect" in kinds and "reconfiguration_broadcast" in kinds
+                and kinds.index("detect")
+                < kinds.index("reconfiguration_broadcast")
+                for kinds in ([e["event"] for e in evs]
+                              for evs in flights.values())
+            ), "no dump holds detect -> broadcast: " + repr(list(flights))
 
         _wait_for(rf"step {STEPS}/{STEPS} loss [\d.]+", log, deadline,
                   after=offset)

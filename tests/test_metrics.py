@@ -1,0 +1,210 @@
+"""Unit tests for the metrics plane (oobleck_tpu/utils/metrics.py):
+registry semantics, Prometheus rendering, percentile math, the JSONL
+sink round-trip, the flight recorder ring, and the HTTP endpoints."""
+
+import json
+import threading
+import urllib.request
+
+import pytest
+
+from oobleck_tpu.utils import metrics
+from oobleck_tpu.utils.metrics import (
+    FlightRecorder,
+    MetricsHTTPServer,
+    Registry,
+    histogram_percentile,
+    latest_per_file,
+    merge_histogram_series,
+    render_prometheus,
+)
+
+
+def test_counter_gauge_histogram_basics():
+    reg = Registry()
+    c = reg.counter("c_total", "a counter")
+    c.inc()
+    c.inc(2.5, stage="detect")
+    assert c.value() == 1.0
+    assert c.value(stage="detect") == 2.5
+
+    g = reg.gauge("g", "a gauge")
+    g.set(4.0, kind="x")
+    g.inc(0.5, kind="x")
+    assert g.value(kind="x") == 4.5
+
+    h = reg.histogram("h_seconds", "a histogram", buckets=(1.0, 10.0))
+    h.observe(0.5)
+    h.observe(5.0)
+    h.observe(50.0)  # beyond last bucket: only sum/count/+Inf
+    (s,) = h.series()
+    assert s["counts"] == [1, 1]
+    assert s["count"] == 3
+    assert s["sum"] == pytest.approx(55.5)
+
+
+def test_registry_same_name_returns_same_family_and_type_conflict_raises():
+    reg = Registry()
+    assert reg.counter("x") is reg.counter("x")
+    with pytest.raises(ValueError):
+        reg.gauge("x")
+
+
+def test_registry_thread_safety():
+    reg = Registry()
+    c = reg.counter("n_total")
+
+    def work():
+        for _ in range(1000):
+            c.inc(worker="w")
+
+    threads = [threading.Thread(target=work) for _ in range(8)]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join()
+    assert c.value(worker="w") == 8000
+
+
+def test_render_prometheus_merges_snapshots_with_extra_labels():
+    a, b = Registry(), Registry()
+    a.gauge("oobleck_up", "liveness").set(1.0)
+    b.gauge("oobleck_up", "liveness").set(1.0)
+    b.histogram("lat_seconds", buckets=(1.0, 2.0)).observe(1.5, stage="s")
+    text = render_prometheus(
+        [a.snapshot(), b.snapshot()],
+        extra_labels=[{"host": "h1", "role": "agent"},
+                      {"host": "h2", "role": "worker"}],
+    )
+    assert "# TYPE oobleck_up gauge" in text
+    assert '# HELP oobleck_up liveness' in text
+    assert 'oobleck_up{host="h1",role="agent"} 1' in text
+    assert 'oobleck_up{host="h2",role="worker"} 1' in text
+    # histogram: cumulative buckets, +Inf, _sum/_count; series labels merged
+    # after the snapshot-level extras, `le` rendered last
+    assert 'lat_seconds_bucket{host="h2",role="worker",stage="s",le="1.0"} 0' in text
+    assert 'lat_seconds_bucket{host="h2",role="worker",stage="s",le="2.0"} 1' in text
+    assert 'lat_seconds_bucket{host="h2",role="worker",stage="s",le="+Inf"} 1' in text
+    assert 'lat_seconds_count{host="h2",role="worker",stage="s"} 1' in text
+
+
+def test_histogram_percentile_interpolates():
+    series = {"buckets": [1.0, 2.0, 4.0], "counts": [2, 2, 0],
+              "sum": 6.0, "count": 4}
+    assert histogram_percentile(series, 0.5) == pytest.approx(1.0)
+    assert histogram_percentile(series, 0.75) == pytest.approx(1.5)
+    assert histogram_percentile({"buckets": [], "counts": [],
+                                 "sum": 0, "count": 0}, 0.5) is None
+    # beyond the last bucket: falls back to mean, floored at the last edge
+    tail = {"buckets": [1.0], "counts": [0], "sum": 30.0, "count": 3}
+    assert histogram_percentile(tail, 0.9) == pytest.approx(10.0)
+
+
+def test_merge_histogram_series_sums_matching_layouts():
+    s1 = {"buckets": [1.0, 2.0], "counts": [1, 0], "sum": 0.5, "count": 1}
+    s2 = {"buckets": [1.0, 2.0], "counts": [0, 2], "sum": 3.0, "count": 2}
+    other = {"buckets": [9.0], "counts": [5], "sum": 1.0, "count": 5}
+    merged = merge_histogram_series([s1, s2, other])
+    assert merged["counts"] == [1, 2]
+    assert merged["count"] == 3
+    assert merged["sum"] == pytest.approx(3.5)
+    assert merge_histogram_series([]) is None
+
+
+def test_jsonl_sink_round_trip(tmp_path, monkeypatch):
+    monkeypatch.setenv(metrics.ENV_METRICS_DIR, str(tmp_path))
+    reg = Registry()
+    reg.gauge("oobleck_engine_tokens_per_sec").set(123.0)
+    path1 = metrics.dump_jsonl(reg.snapshot())
+    reg.gauge("oobleck_engine_tokens_per_sec").set(456.0)
+    path2 = metrics.dump_jsonl(reg.snapshot())
+    assert path1 == path2  # same process → same file, appended
+
+    # torn tail from a SIGKILLed writer must be skipped, not fatal
+    with open(path1, "a") as f:
+        f.write('{"truncat')
+
+    snaps = metrics.read_jsonl_dir(str(tmp_path))
+    assert len(snaps) == 2
+    latest = latest_per_file(snaps)
+    assert len(latest) == 1
+    series = metrics.find_series(latest, "oobleck_engine_tokens_per_sec")
+    assert [s["value"] for s in series] == [456.0]
+
+
+def test_dump_jsonl_disabled_without_dir(monkeypatch):
+    monkeypatch.delenv(metrics.ENV_METRICS_DIR, raising=False)
+    assert metrics.dump_jsonl() is None
+
+
+def test_flight_recorder_ring_and_dump(tmp_path, monkeypatch):
+    monkeypatch.setenv(metrics.ENV_METRICS_DIR, str(tmp_path))
+    fr = FlightRecorder(capacity=3)
+    for i in range(5):
+        fr.record("heartbeat", n=i)
+    events = fr.events()
+    assert len(events) == 3  # bounded ring keeps the most recent
+    assert [e["n"] for e in events] == [2, 3, 4]
+
+    path = fr.dump("unit_test")
+    with open(path) as f:
+        lines = [json.loads(line) for line in f]
+    assert lines[0]["event"] == "dump"
+    assert lines[0]["reason"] == "unit_test"
+    assert [e["n"] for e in lines[1:]] == [2, 3, 4]
+
+    # a second dump gets a fresh sequence number, not an overwrite
+    assert fr.dump("again") != path
+
+
+def test_flight_recorder_dump_disabled_without_dir(monkeypatch):
+    monkeypatch.delenv(metrics.ENV_METRICS_DIR, raising=False)
+    fr = FlightRecorder(capacity=2)
+    fr.record("x")
+    assert fr.dump("nowhere") is None
+    assert len(fr.events()) == 1  # ring untouched
+
+
+def test_http_server_serves_metrics_and_status():
+    reg = Registry()
+    reg.counter("oobleck_master_registrations_total").inc(3)
+    srv = MetricsHTTPServer(
+        metrics_fn=lambda: render_prometheus([reg.snapshot()]),
+        status_fn=lambda: {"agents": [], "ok": True},
+        port=0, host="127.0.0.1",
+    ).start()
+    try:
+        base = f"http://127.0.0.1:{srv.port}"
+        with urllib.request.urlopen(base + "/metrics", timeout=5) as resp:
+            assert resp.status == 200
+            assert "text/plain" in resp.headers["Content-Type"]
+            body = resp.read().decode()
+        assert "oobleck_master_registrations_total 3" in body
+        with urllib.request.urlopen(base + "/status", timeout=5) as resp:
+            assert resp.status == 200
+            assert resp.headers["Content-Type"] == "application/json"
+            assert json.loads(resp.read()) == {"agents": [], "ok": True}
+        with pytest.raises(urllib.error.HTTPError) as exc:
+            urllib.request.urlopen(base + "/nope", timeout=5)
+        assert exc.value.code == 404
+    finally:
+        srv.close()
+
+
+def test_http_server_handler_failure_returns_500_not_crash():
+    def boom():
+        raise RuntimeError("broken scrape")
+
+    srv = MetricsHTTPServer(metrics_fn=boom, status_fn=dict,
+                            port=0, host="127.0.0.1").start()
+    try:
+        with pytest.raises(urllib.error.HTTPError) as exc:
+            urllib.request.urlopen(
+                f"http://127.0.0.1:{srv.port}/metrics", timeout=5)
+        assert exc.value.code == 500
+        # the server thread survives: /status still works
+        with urllib.request.urlopen(
+                f"http://127.0.0.1:{srv.port}/status", timeout=5) as resp:
+            assert resp.status == 200
+    finally:
+        srv.close()

@@ -1,0 +1,109 @@
+"""Tests for utils/recovery.py: mark lines, deadline budget accounting,
+malformed-env handling, and the metrics wired off the mark chain."""
+
+import logging
+
+import pytest
+
+from oobleck_tpu.utils import metrics, recovery
+
+
+@pytest.fixture()
+def clean_registry():
+    """The recovery marks feed the PROCESS-GLOBAL registry; snapshot-diff
+    against a cleared one so assertions are deterministic."""
+    metrics.registry().clear()
+    yield metrics.registry()
+    metrics.registry().clear()
+
+
+def _hist_series(reg, stage):
+    for s in reg.histogram("oobleck_recovery_latency_seconds",
+                           buckets=metrics.RECOVERY_BUCKETS).series():
+        if s["labels"] == {"stage": stage}:
+            return s
+    return None
+
+
+def test_mark_emits_structured_line(caplog, clean_registry):
+    with caplog.at_level(logging.WARNING, logger="oobleck.recovery"):
+        t = recovery.mark(recovery.DETECT, lost_ip="10.0.0.3")
+    assert t > 0
+    line = next(r.message for r in caplog.records
+                if recovery.MARK in r.message)
+    assert '"event": "detect"' in line
+    assert '"lost_ip": "10.0.0.3"' in line
+
+
+def test_deadline_breach_emits_exceeded_line(monkeypatch, caplog,
+                                             clean_registry):
+    monkeypatch.setenv(recovery.ENV_DEADLINE, "5")
+    with caplog.at_level(logging.WARNING, logger="oobleck.recovery"):
+        recovery.mark(recovery.RESPAWN, lost_ip="10.0.0.3", elapsed=9.0)
+    exceeded = [r for r in caplog.records
+                if f"{recovery.MARK} EXCEEDED" in r.message]
+    assert len(exceeded) == 1
+    assert exceeded[0].levelno == logging.ERROR
+    assert "9.0s against a 5.0s budget" in exceeded[0].message
+    breaches = clean_registry.counter(
+        "oobleck_recovery_deadline_breaches_total")
+    assert breaches.value(stage=recovery.RESPAWN) == 1
+
+
+def test_within_budget_no_exceeded_line(monkeypatch, caplog, clean_registry):
+    monkeypatch.setenv(recovery.ENV_DEADLINE, "30")
+    with caplog.at_level(logging.WARNING, logger="oobleck.recovery"):
+        recovery.mark(recovery.RESPAWN, lost_ip="10.0.0.3", elapsed=9.0)
+    assert not any("EXCEEDED" in r.message for r in caplog.records)
+
+
+def test_malformed_deadline_warned_and_ignored(monkeypatch, caplog,
+                                               clean_registry):
+    monkeypatch.setenv(recovery.ENV_DEADLINE, "fast-please")
+    with caplog.at_level(logging.WARNING, logger="oobleck.recovery"):
+        assert recovery.deadline_s() is None
+        # a mark with a huge elapsed must NOT be treated as a breach
+        recovery.mark(recovery.RESPAWN, elapsed=1e6)
+    assert any("malformed" in r.message for r in caplog.records)
+    assert not any("EXCEEDED" in r.message for r in caplog.records)
+
+
+def test_unset_deadline_is_none(monkeypatch):
+    monkeypatch.delenv(recovery.ENV_DEADLINE, raising=False)
+    assert recovery.deadline_s() is None
+
+
+def test_marks_increment_counter_and_latency_histogram(monkeypatch,
+                                                       clean_registry):
+    monkeypatch.delenv(recovery.ENV_DEADLINE, raising=False)
+    recovery.mark(recovery.DETECT, lost_ip="a")
+    recovery.mark(recovery.BROADCAST, lost_ip="a", elapsed=0.2)
+    recovery.mark(recovery.BROADCAST, lost_ip="b", elapsed=7.0)
+
+    marks = clean_registry.counter("oobleck_recovery_marks_total")
+    assert marks.value(stage=recovery.DETECT) == 1
+    assert marks.value(stage=recovery.BROADCAST) == 2
+
+    # only marks carrying `elapsed` observe latency, labeled per stage
+    assert _hist_series(clean_registry, recovery.DETECT) is None
+    s = _hist_series(clean_registry, recovery.BROADCAST)
+    assert s["count"] == 2
+    assert s["sum"] == pytest.approx(7.2)
+
+
+def test_observe_latency_feeds_histogram(clean_registry):
+    recovery.observe_latency(1.5, stage="reconfigure")
+    s = _hist_series(clean_registry, "reconfigure")
+    assert s["count"] == 1
+    assert s["sum"] == pytest.approx(1.5)
+
+
+def test_breach_dumps_flight_ring(monkeypatch, tmp_path, clean_registry):
+    monkeypatch.setenv(metrics.ENV_METRICS_DIR, str(tmp_path))
+    monkeypatch.setenv(recovery.ENV_DEADLINE, "1")
+    metrics.flight_recorder().record("reconfiguration_notified", ip="x")
+    recovery.mark(recovery.FIRST_STEP, lost_ip="x", elapsed=2.0)
+    dumps = [p for p in tmp_path.iterdir() if p.name.startswith("flight-")]
+    assert dumps, "deadline breach must persist the flight ring"
+    header = dumps[0].read_text().splitlines()[0]
+    assert "recovery_deadline_exceeded:first_step" in header
