@@ -2,7 +2,7 @@
 
 PY ?= python
 
-.PHONY: lint test test-slow bench
+.PHONY: lint test test-slow tier1 bench ckpt-bench
 
 # Lint via ruff (config in pyproject.toml). Degrades to a skip when ruff
 # is not installed — the hermetic CI image does not ship it, and the gate
@@ -23,5 +23,15 @@ test:
 test-slow:
 	JAX_PLATFORMS=cpu $(PY) -m pytest tests/ -q -m slow -p no:cacheprovider
 
+# The exact tier-1 gate command from ROADMAP.md (timeout, log tee, dot
+# count and all), so "make tier1" and the driver can never diverge.
+tier1:
+	bash -c "set -o pipefail; rm -f /tmp/_t1.log; timeout -k 10 870 env JAX_PLATFORMS=cpu python -m pytest tests/ -q -m 'not slow' --continue-on-collection-errors -p no:cacheprovider -p no:xdist -p no:randomly 2>&1 | tee /tmp/_t1.log; rc=\$${PIPESTATUS[0]}; echo DOTS_PASSED=\$$(grep -aE '^[.FEsx]+( *\[ *[0-9]+%\])?\$$' /tmp/_t1.log | tr -cd . | wc -c); exit \$$rc"
+
 bench:
 	$(PY) bench.py
+
+# Checkpoint-stall microbench: async writer vs sync baseline p50/p99
+# (oobleck_tpu/ckpt/bench.py; also folded into bench.py's "ckpt" key).
+ckpt-bench:
+	JAX_PLATFORMS=cpu $(PY) -m oobleck_tpu.ckpt.bench

@@ -191,6 +191,15 @@ def _measure() -> dict:
         result["flash_validated"] = flash_validated
     if platform != "tpu":
         result["platform"] = platform
+    # Checkpoint-stall microbench (oobleck_tpu/ckpt/bench.py): async writer
+    # vs sync baseline p50/p99 so the durability tax is tracked next to
+    # throughput. Best-effort — a broken disk must not eat the headline.
+    try:
+        from oobleck_tpu.ckpt.bench import measure_stalls
+
+        result["ckpt"] = measure_stalls(saves=4, mb=16)
+    except Exception as exc:  # noqa: BLE001
+        result["ckpt"] = {"error": f"{type(exc).__name__}: {exc}"}
     if os.environ.get("BENCH_COMPARE") == "1":
         # Opt-in: the MPMD interpreter path on the same config, so fused vs
         # interpreter can be compared on identical hardware (round-3 verdict

@@ -1,0 +1,110 @@
+"""Durable-state plane: async sharded checkpointing with atomic
+manifests, preemption-aware flush, and crash-consistent restore.
+
+The reference has NO checkpointing (weights are randomly re-materialized
+at startup) and the in-memory mirror plane cannot survive whole-slice
+preemption — routine on TPU. This package is the durable half of the
+two-tier story (Chameleon, arXiv:2508.21613: cheap in-memory redundancy
+for peer failures, durable checkpoints for slice loss), built from four
+parts:
+
+  snapshot.py  reference-capture snapshots at a step barrier (stall ==
+               drain-wait + traversal, never device_get + disk)
+  writer.py    background writer: at most one snapshot in flight,
+               per-process shard files, rank-0 atomic manifest commit,
+               keep-last-k GC, SIGTERM flush hook, chaos barrier
+  manifest.py  on-disk layout, checksums, atomic rename commit
+  restore.py   validate -> quarantine -> assemble -> rebuild trees
+
+`DurableStatePlane` is the facade the engine (and the
+execution/checkpoint.py compat shim) talks to.
+"""
+
+from __future__ import annotations
+
+from pathlib import Path
+from typing import Any
+
+from oobleck_tpu.ckpt import manifest, restore, snapshot, writer  # noqa: F401
+from oobleck_tpu.ckpt.restore import (  # noqa: F401
+    CheckpointCorrupt,
+    complete_step_dirs,
+    load_step_dir,
+    restore_latest,
+)
+from oobleck_tpu.ckpt.writer import SnapshotWriter  # noqa: F401
+
+
+class DurableStatePlane:
+    """One process's handle on a checkpoint root directory.
+
+    save()/save_stacked() return the train-loop stall in seconds (the
+    metric the async-writer design is judged on); restore_latest()
+    returns the engine checkpoint payload or None. The plane is safe to
+    keep for the engine's lifetime — flush() before reading the root
+    from another process."""
+
+    def __init__(self, root: str | Path, *, process_index: int = 0,
+                 world_size: int = 1, keep_last: int = 3,
+                 asynchronous: bool = True, commit_timeout: float = 120.0,
+                 ip: str | None = None):
+        self.root = Path(root).resolve()
+        self.writer = SnapshotWriter(
+            self.root, process_index=process_index, world_size=world_size,
+            keep_last=keep_last, asynchronous=asynchronous,
+            commit_timeout=commit_timeout, ip=ip)
+
+    @property
+    def process_index(self) -> int:
+        return self.writer.process_index
+
+    @property
+    def world_size(self) -> int:
+        return self.writer.world_size
+
+    @property
+    def last_durable_step(self) -> int:
+        return self.writer.last_durable_step
+
+    def _meta(self, step: int, num_iterations_done: int, epoch: int,
+              extra: dict | None) -> dict:
+        return {"step": step, "num_iterations_done": num_iterations_done,
+                "epoch": epoch, **(extra or {})}
+
+    def save(self, *, step: int, params: dict[int, Any],
+             opt_state: dict[int, Any], num_iterations_done: int = 0,
+             epoch: int = 0, extra: dict | None = None) -> float:
+        """Checkpoint layer-keyed state; returns stall seconds."""
+        snap = snapshot.capture_layers(
+            params, opt_state, step=step,
+            meta=self._meta(step, num_iterations_done, epoch, extra))
+        return self.writer.submit(snap)
+
+    def save_stacked(self, *, step: int, params: Any, opt_leaves: list,
+                     num_iterations_done: int = 0, epoch: int = 0,
+                     extra: dict | None = None) -> float:
+        """Checkpoint the fused path's raw stacked state (cross-host
+        sharded arrays ride as per-process shard pieces)."""
+        snap = snapshot.capture_stacked(
+            params, opt_leaves, step=step,
+            meta=self._meta(step, num_iterations_done, epoch, extra))
+        return self.writer.submit(snap)
+
+    def restore_latest(self, *, quarantine_bad: bool | None = None
+                       ) -> dict | None:
+        """Newest restorable payload; quarantining defaults to process 0
+        only (one renamer per shared filesystem)."""
+        self.writer.flush()
+        if quarantine_bad is None:
+            quarantine_bad = self.writer.process_index == 0
+        return restore.restore_latest(self.root,
+                                      quarantine_bad=quarantine_bad)
+
+    def flush(self, timeout: float | None = None) -> bool:
+        return self.writer.flush(timeout)
+
+    def close(self) -> None:
+        self.writer.close()
+
+    def install_preemption_hook(self) -> None:
+        self.writer.install_preemption_hook()

@@ -1,0 +1,258 @@
+"""Crash-consistent restore for the durable-state plane.
+
+`restore_latest` walks step dirs newest-first and returns the first one
+that proves itself whole: global manifest present (the commit marker),
+per-process manifest crcs match, data-file crcs match, every sharded
+array fully covered by the pieces on disk. Anything less is QUARANTINED
+(renamed into `<root>/quarantine/`) rather than crashing the restore or
+— worse — being silently half-loaded: a torn checkpoint must cost at
+most `interval` steps of progress, never the run.
+
+Quarantining only happens from one process (the caller passes
+`quarantine_bad=False` on non-zero ranks) so a shared-filesystem
+multi-process restore doesn't race renames; every process still skips
+the same dirs because validation is deterministic over the same bytes.
+"""
+
+from __future__ import annotations
+
+import logging
+import os
+import time
+from pathlib import Path
+from typing import Any
+
+import numpy as np
+
+from oobleck_tpu.ckpt import manifest as mf
+from oobleck_tpu.utils import metrics
+
+logger = logging.getLogger("oobleck.ckpt")
+
+
+class CheckpointCorrupt(Exception):
+    """A step dir failed validation (checksum / coverage / parse)."""
+
+
+def step_dirs(root: str | Path) -> list[tuple[int, Path]]:
+    """All step dirs under root, newest step first."""
+    root = Path(root)
+    if not root.is_dir():
+        return []
+    out = []
+    for child in root.iterdir():
+        step = mf.parse_step_dir(child.name)
+        if step is not None and child.is_dir():
+            out.append((step, child))
+    out.sort(reverse=True)
+    return out
+
+
+def complete_step_dirs(root: str | Path) -> list[tuple[int, Path]]:
+    """Step dirs with a committed global manifest, newest first. No deep
+    validation — cheap enough for `latest_checkpoint` queries."""
+    return [(s, d) for s, d in step_dirs(root)
+            if (d / mf.GLOBAL_MANIFEST).exists()]
+
+
+def quarantine(root: str | Path, step_dir: Path, reason: str) -> Path | None:
+    """Move a distrusted step dir aside (never deleted: it is evidence).
+    Returns the new location, or None when the move fails (e.g. a
+    concurrent quarantine won the rename)."""
+    qdir = Path(root) / mf.QUARANTINE_DIR
+    qdir.mkdir(parents=True, exist_ok=True)
+    dest = qdir / f"{step_dir.name}.{reason}.{os.getpid()}-{time.time_ns()}"
+    try:
+        os.rename(step_dir, dest)
+    except OSError as e:
+        logger.warning("could not quarantine %s: %s", step_dir, e)
+        return None
+    metrics.registry().counter(
+        "oobleck_ckpt_quarantined_total",
+        "Corrupt/incomplete checkpoint step dirs quarantined",
+    ).inc(reason=reason)
+    metrics.flight_recorder().record(
+        "ckpt_quarantine", dir=step_dir.name, reason=reason)
+    logger.warning("quarantined checkpoint dir %s -> %s (%s)",
+                   step_dir.name, dest, reason)
+    return dest
+
+
+# -- validation + assembly ---------------------------------------------- #
+
+def _validated_manifests(d: Path) -> tuple[dict, list[dict]]:
+    gm_path = d / mf.GLOBAL_MANIFEST
+    try:
+        gm = mf.read_json(gm_path)
+    except (OSError, ValueError) as e:
+        raise CheckpointCorrupt(f"unreadable global manifest: {e}") from e
+    if gm.get("format") != mf.FORMAT_VERSION:
+        raise CheckpointCorrupt(
+            f"unknown manifest format {gm.get('format')!r}")
+    procs = []
+    for rec in gm.get("processes", []):
+        path = d / rec["file"]
+        if not path.exists():
+            raise CheckpointCorrupt(f"missing manifest {rec['file']}")
+        if mf.file_crc32(path) != rec["crc32"] \
+                or path.stat().st_size != rec["bytes"]:
+            raise CheckpointCorrupt(f"manifest checksum mismatch: "
+                                    f"{rec['file']}")
+        try:
+            pm = mf.read_json(path)
+        except (OSError, ValueError) as e:
+            raise CheckpointCorrupt(
+                f"unreadable manifest {rec['file']}: {e}") from e
+        if pm.get("step") != gm.get("step"):
+            raise CheckpointCorrupt(f"step mismatch in {rec['file']}")
+        procs.append(pm)
+    if not procs:
+        raise CheckpointCorrupt("global manifest lists no processes")
+    return gm, procs
+
+
+def _assemble(d: Path, procs: list[dict]) -> dict[str, np.ndarray]:
+    """Merge every process's pieces into full host arrays, verifying data
+    checksums and global-index coverage."""
+    values: dict[str, np.ndarray] = {}
+    masks: dict[str, np.ndarray] = {}
+    for pm in procs:
+        data_path = d / pm["data_file"]
+        if not data_path.exists():
+            raise CheckpointCorrupt(f"missing data file {pm['data_file']}")
+        if mf.file_crc32(data_path) != pm["data_crc32"]:
+            raise CheckpointCorrupt(
+                f"data checksum mismatch: {pm['data_file']}")
+        with np.load(data_path) as data:
+            for e in pm["entries"]:
+                key = e["key"]
+                dt = mf.dtype_from_name(e["dtype"])
+                try:
+                    arr = data[e["npz"]].view(dt).reshape(e["shape"])
+                except (KeyError, ValueError) as err:
+                    raise CheckpointCorrupt(
+                        f"bad piece {e['npz']} in {pm['data_file']}: {err}"
+                    ) from err
+                gshape = tuple(e["global_shape"])
+                if e["index"] is None:
+                    try:
+                        # np.ascontiguousarray promoted 0-d scalars to 1-d
+                        # at write time; the global shape is authoritative.
+                        values.setdefault(key, arr.reshape(gshape))
+                    except ValueError as err:
+                        raise CheckpointCorrupt(
+                            f"{key}: full piece shape {arr.shape} != "
+                            f"global {gshape}") from err
+                    continue
+                out = values.get(key)
+                if out is None or key not in masks:
+                    out = values[key] = np.empty(gshape, dt)
+                    masks[key] = np.zeros(gshape, bool)
+                idx = mf.decode_index(e["index"])
+                out[idx] = arr
+                masks[key][idx] = True
+    for key, mask in masks.items():
+        if not mask.all():
+            raise CheckpointCorrupt(
+                f"{key}: shard pieces cover only "
+                f"{int(mask.sum())}/{mask.size} elements")
+    return values
+
+
+def _nest(flat: dict[str, Any]):
+    """Rebuild a tree from '/'-joined path keys; '#i' components become
+    list elements (tuples restore as lists). An empty path ('') is a bare
+    leaf."""
+    if list(flat.keys()) == [""]:
+        return flat[""]
+    root: dict = {}
+    for key, v in flat.items():
+        comps = key.split("/")
+        node = root
+        for c in comps[:-1]:
+            node = node.setdefault(c, {})
+        node[comps[-1]] = v
+
+    def conv(node):
+        if not isinstance(node, dict):
+            return node
+        if node and all(k.startswith("#") for k in node):
+            return [conv(node[f"#{i}"]) for i in range(len(node))]
+        return {k: conv(v) for k, v in node.items()}
+
+    return conv(root)
+
+
+def _rebuild(values: dict[str, np.ndarray], kind: str, meta: dict) -> dict:
+    if kind == mf.KIND_FUSED_STACKED:
+        pflat = {k[len("fs/p"):].lstrip("/"): v for k, v in values.items()
+                 if k == "fs/p" or k.startswith("fs/p/")}
+        oflat = {int(k.rsplit("/", 1)[1]): values[k] for k in values
+                 if k.startswith("fs/o/")}
+        return {"kind": kind,
+                "params": _nest(pflat),
+                "opt": [oflat[i] for i in range(len(oflat))],
+                "meta": meta}
+    params: dict[int, dict[str, Any]] = {}
+    opt: dict[int, dict[int, np.ndarray]] = {}
+    for key, v in values.items():
+        tag, _, rest = key.partition("/")
+        li_s, _, path = rest.partition("/")
+        li = int(li_s)
+        if tag == "p":
+            params.setdefault(li, {})[path] = v
+        elif tag == "o":
+            leaves = opt.setdefault(li, {})
+            if path != "~":  # "~" marks a leafless state: layer, no leaves
+                leaves[int(path)] = v
+        else:
+            raise CheckpointCorrupt(f"unknown key namespace {key!r}")
+    return {
+        "params": {li: _nest(flat) for li, flat in params.items()},
+        "opt": {li: [leaves[i] for i in range(len(leaves))]
+                for li, leaves in opt.items()},
+        "meta": meta,
+    }
+
+
+def load_step_dir(d: str | Path) -> dict:
+    """Validate + load ONE committed step dir. Raises CheckpointCorrupt.
+
+    Returns the engine checkpoint payload: {"params": {layer: tree},
+    "opt": {layer: [flat leaves]}, "meta": {...}} — or, for
+    kind=fused_stacked, {"kind", "params": stacked tree, "opt": [leaves],
+    "meta"} for the engine to layerize."""
+    d = Path(d)
+    if not (d / mf.GLOBAL_MANIFEST).exists():
+        raise CheckpointCorrupt("no committed global manifest")
+    gm, procs = _validated_manifests(d)
+    values = _assemble(d, procs)
+    return _rebuild(values, gm.get("kind", mf.KIND_LAYERS), gm.get("meta", {}))
+
+
+def restore_latest(root: str | Path, *, quarantine_bad: bool = True
+                   ) -> dict | None:
+    """Newest restorable checkpoint payload under root, or None.
+
+    Uncommitted and corrupt step dirs are skipped (and quarantined when
+    `quarantine_bad`); the walk falls back to the next-newest complete
+    step. Call only when no writer is active on this root (startup)."""
+    root = Path(root)
+    for step, d in step_dirs(root):
+        if not (d / mf.GLOBAL_MANIFEST).exists():
+            logger.warning(
+                "checkpoint %s has no committed manifest (crash "
+                "mid-write?); skipping", d.name)
+            if quarantine_bad:
+                quarantine(root, d, "uncommitted")
+            continue
+        try:
+            payload = load_step_dir(d)
+        except CheckpointCorrupt as e:
+            logger.error("checkpoint %s failed validation: %s", d.name, e)
+            if quarantine_bad:
+                quarantine(root, d, "corrupt")
+            continue
+        logger.info("restored checkpoint %s (step %d)", d.name, step)
+        return payload
+    return None

@@ -1,0 +1,199 @@
+"""Step-barrier state snapshots for the durable-state plane.
+
+A Snapshot is taken ON the training thread, and `stage_to_host` (called
+by the writer at submit time, still on the training thread) copies every
+captured array to host memory before the train loop proceeds. Reference
+capture alone is NOT safe: the train step donates its input state
+(donate_argnums), so by the next step the captured buffers may be reused
+by XLA — and on CPU `np.asarray(jax_array)` is a zero-copy VIEW of the
+XLA buffer, so even a "host" reference can alias donated memory (a
+use-after-free SIGSEGV, observed in the multiprocess elastic test). The
+checkpoint stall is therefore drain-wait + device→host staging; the npz
+pack, fsync, and manifest commit — the expensive part — still run on the
+writer thread. The memory bill is one staged host copy of the state
+until the write drains, bounded by the at-most-one-in-flight rule.
+
+Key schema (flat string keys; the restore side rebuilds trees from them):
+
+    p/<layer>/<path...>   a params leaf of layer <layer>
+    o/<layer>/<i>         the i-th flat optimizer-state leaf of the layer
+    fs/p/<path...>        fused_stacked: a raw stacked params leaf
+    fs/o/<i>              fused_stacked: the i-th flat opt-state leaf
+
+Path components are dict keys verbatim and `#<i>` for sequence elements
+(tuples restore as lists — model params are nested dicts, so the engine
+never sees the difference).
+
+Sharded capture: an array that is not fully replicated materializes as
+its distinct replica-0 addressable shards, each tagged with its global
+index — every process contributes only what its devices hold, which is
+what makes cross-host-sharded (FSDP-across-hosts) state checkpointable
+at all. A process holding only redundant replicas of an array contributes
+no piece for it; the global manifest merge makes the union whole.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+from typing import Any
+
+import jax
+import numpy as np
+
+from oobleck_tpu.ckpt import manifest as mf
+
+
+def _path_component(entry) -> str:
+    from jax.tree_util import (
+        DictKey,
+        FlattenedIndexKey,
+        GetAttrKey,
+        SequenceKey,
+    )
+
+    if isinstance(entry, DictKey):
+        key = str(entry.key)
+        if "/" in key or key.startswith("#") or key.startswith("."):
+            raise ValueError(f"unserializable tree key {key!r}")
+        return key
+    if isinstance(entry, SequenceKey):
+        return f"#{entry.idx}"
+    if isinstance(entry, GetAttrKey):
+        return entry.name
+    if isinstance(entry, FlattenedIndexKey):
+        return f"#{entry.key}"
+    raise ValueError(f"unserializable tree path entry {entry!r}")
+
+
+def flatten_with_keys(tree, prefix: str) -> list[tuple[str, Any]]:
+    """[(key, leaf)] with keys `<prefix>/<comp>/<comp>...`; a bare leaf
+    (no tree structure) keys as `<prefix>` alone."""
+    leaves, _ = jax.tree_util.tree_flatten_with_path(tree)
+    out = []
+    for path, leaf in leaves:
+        comps = [_path_component(e) for e in path]
+        out.append(("/".join([prefix, *comps]) if comps else prefix, leaf))
+    return out
+
+
+@dataclass
+class Snapshot:
+    """One step's durable state: flat (key, value) pairs plus run-position
+    metadata. Values start as jax arrays / numpy arrays / scalars;
+    `stage_to_host` rewrites them to HostValue copies before the writer
+    thread ever sees them."""
+
+    step: int
+    kind: str
+    meta: dict
+    entries: list[tuple[str, Any]] = field(default_factory=list)
+
+
+class HostValue:
+    """A captured value staged to independent host memory: the global
+    shape/dtype plus this process's [(index, array-copy)] pieces."""
+
+    __slots__ = ("shape", "dtype", "pieces")
+
+    def __init__(self, shape: tuple, dtype: str,
+                 pieces: list[tuple[Any, np.ndarray]]):
+        self.shape = shape
+        self.dtype = dtype
+        self.pieces = pieces
+
+
+def stage_to_host(snap: Snapshot) -> None:
+    """Replace every entry's value with a HostValue COPY, in place.
+
+    Must run on the training thread before the next train step can
+    donate the captured buffers (writer.submit calls it for both sync
+    and async modes)."""
+    snap.entries = [
+        (key, value if isinstance(value, HostValue) else HostValue(
+            global_shape_of(value), global_dtype_of(value),
+            materialize_value(value)))
+        for key, value in snap.entries
+    ]
+
+
+def capture_layers(params: dict[int, Any], opt_state: dict[int, Any],
+                   *, step: int, meta: dict) -> Snapshot:
+    """Layer-keyed engine state -> Snapshot. `opt_state` values may be
+    optax trees or already-flat leaf lists; both store as flat leaves
+    (checkpoint convention: the engine re-derives the optax structure
+    from optimizer.init at restore)."""
+    entries: list[tuple[str, Any]] = []
+    for li in sorted(params):
+        entries.extend(flatten_with_keys(params[li], f"p/{li}"))
+    for li in sorted(opt_state):
+        leaves = jax.tree.leaves(opt_state[li])
+        if not leaves:
+            # Leafless states (e.g. a bare EmptyState) must still restore
+            # as "layer present, zero leaves", not "layer unknown".
+            entries.append((f"o/{li}/~", np.zeros(0, np.float32)))
+        for i, leaf in enumerate(leaves):
+            entries.append((f"o/{li}/{i}", leaf))
+    return Snapshot(step=step, kind=mf.KIND_LAYERS, meta=dict(meta),
+                    entries=entries)
+
+
+def capture_stacked(params: Any, opt_leaves: list, *, step: int,
+                    meta: dict) -> Snapshot:
+    """Fused path, cross-host-sharded state: capture the raw stacked
+    TrainState leaves shard-wise (kind=fused_stacked)."""
+    entries = flatten_with_keys(params, "fs/p")
+    for i, leaf in enumerate(opt_leaves):
+        entries.append((f"fs/o/{i}", leaf))
+    return Snapshot(step=step, kind=mf.KIND_FUSED_STACKED, meta=dict(meta),
+                    entries=entries)
+
+
+def materialize_value(value) -> list[tuple[Any, np.ndarray]]:
+    """Stage one captured value to host: [(index, array)] pieces.
+
+    index None = the piece IS the full array. For a sharded jax array the
+    pieces are this process's distinct replica-0 shards with their global
+    indices; the list may be EMPTY on a process holding only redundant
+    replicas (some other process owns replica 0 of every region).
+
+    jax-array pieces are COPIED (np.array, not np.asarray): a view of an
+    XLA CPU buffer would alias memory the next donating train step reuses.
+    """
+    if isinstance(value, HostValue):
+        return value.pieces
+    if isinstance(value, jax.Array) and not value.is_fully_replicated:
+        pieces: list[tuple[Any, np.ndarray]] = []
+        seen: set = set()
+        full = value.is_fully_addressable
+        for sh in value.addressable_shards:
+            # Across processes, replica_id==0 selects exactly one copy of
+            # each global region; within one process (fully addressable)
+            # index dedup alone suffices.
+            if not full and sh.replica_id != 0:
+                continue
+            key = tuple((s.start, s.stop, s.step) for s in sh.index)
+            if key in seen:
+                continue
+            seen.add(key)
+            pieces.append((sh.index, np.array(sh.data)))
+        if len(pieces) == 1 and pieces[0][1].shape == value.shape:
+            return [(None, pieces[0][1])]
+        return pieces
+    if isinstance(value, jax.Array):
+        return [(None, np.array(value))]
+    return [(None, np.asarray(value))]
+
+
+def global_shape_of(value) -> tuple:
+    if isinstance(value, HostValue):
+        return value.shape
+    return tuple(np.shape(value)) if not isinstance(value, jax.Array) \
+        else tuple(value.shape)
+
+
+def global_dtype_of(value) -> str:
+    if isinstance(value, HostValue):
+        return value.dtype
+    if isinstance(value, (jax.Array, np.ndarray)):
+        return mf.dtype_name(value.dtype)
+    return mf.dtype_name(np.asarray(value).dtype)

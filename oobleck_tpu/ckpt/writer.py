@@ -1,0 +1,356 @@
+"""Background checkpoint writer: async, sharded, atomically committed.
+
+The train loop calls `submit(snapshot)` at a step barrier. With
+`asynchronous=True` (default) submit blocks while a PREVIOUS write is
+still draining (at most one in flight), stages the snapshot to host
+COPIES (mandatory — the train step donates its state buffers, see
+snapshot.py), then hands it to a daemon thread; the loop's stall per
+checkpoint is drain-wait + host staging, not pack + disk + commit.
+`asynchronous=False` is the synchronous baseline the stall histogram is
+judged against.
+
+Write protocol per process (see manifest.py for the layout):
+
+    1. stage shards to host, pack into `.tmp-shards-<p>.npz`, fsync
+    2. crc32 the file, os.replace to `shards-<p>.npz`
+    3.   -- chaos barrier "ckpt_mid_write" (SIGKILL injection point) --
+    4. atomically write `manifest-<p>.json` (data file crc inside)
+    5. process 0 only: poll the shared directory until every process's
+       manifest exists and parses, then atomically commit MANIFEST.json
+       (per-process manifest crcs inside) and GC old steps (keep-last-k)
+
+No collective appears anywhere: cross-process coordination is the shared
+filesystem, so the writer thread can never interleave with (or deadlock
+against) the train loop's collectives. If a peer dies mid-write, the
+rank-0 commit poll times out, the step stays uncommitted, and restore
+later quarantines it — durability degrades to the previous complete
+step, never to a torn one.
+
+SIGTERM (the TPU maintenance/preemption notice) is handled by
+`install_preemption_hook`: drain the in-flight snapshot within
+OOBLECK_CKPT_FLUSH_GRACE seconds (default 10), then hand the signal
+back, so a preempted worker keeps its newest checkpoint instead of
+tearing it.
+"""
+
+from __future__ import annotations
+
+import logging
+import os
+import shutil
+import signal
+import threading
+import time
+from pathlib import Path
+
+import numpy as np
+
+from oobleck_tpu.ckpt import manifest as mf
+from oobleck_tpu.ckpt import snapshot as snp
+from oobleck_tpu.utils import metrics
+from oobleck_tpu.utils.chaos import chaos
+
+logger = logging.getLogger("oobleck.ckpt")
+
+# Chaos barrier hit between shard-data rename and manifest write: a
+# kill_at=ckpt_mid_write directive leaves exactly the torn-checkpoint
+# state restore must survive.
+CHAOS_BARRIER_MID_WRITE = "ckpt_mid_write"
+
+FLUSH_GRACE_ENV = "OOBLECK_CKPT_FLUSH_GRACE"
+
+
+def _flush_grace() -> float:
+    try:
+        return float(os.environ.get(FLUSH_GRACE_ENV, "10"))
+    except ValueError:
+        return 10.0
+
+
+class SnapshotWriter:
+    """Per-process writer for one checkpoint root directory."""
+
+    def __init__(self, root: str | Path, *, process_index: int = 0,
+                 world_size: int = 1, keep_last: int = 3,
+                 asynchronous: bool = True, commit_timeout: float = 120.0,
+                 ip: str | None = None):
+        self.root = Path(root).resolve()
+        self.root.mkdir(parents=True, exist_ok=True)
+        self.process_index = process_index
+        self.world_size = world_size
+        self.keep_last = keep_last          # <= 0 disables GC
+        self.asynchronous = asynchronous
+        self.commit_timeout = commit_timeout
+        self.ip = ip
+        self.last_durable_step = -1
+        self.last_error: BaseException | None = None
+
+        self._cond = threading.Condition()
+        self._job: snp.Snapshot | None = None
+        self._thread: threading.Thread | None = None
+        self._closed = False
+        self._hook_installed = False
+
+        reg = metrics.registry()
+        self._m_stall = reg.histogram(
+            "oobleck_ckpt_stall_seconds",
+            "Train-loop stall per checkpoint (mode=async: drain+enqueue; "
+            "mode=sync: full capture+write+commit)",
+            buckets=metrics.CKPT_STALL_BUCKETS)
+        self._m_write = reg.histogram(
+            "oobleck_ckpt_write_seconds",
+            "Wall time of one full checkpoint write (stage+data+manifest"
+            "+commit), off-thread in async mode")
+        self._m_bytes = reg.counter(
+            "oobleck_ckpt_bytes_total", "Checkpoint shard bytes written")
+        self._m_saves = reg.counter(
+            "oobleck_ckpt_saves_total", "Checkpoint snapshots written")
+        self._m_last_durable = reg.gauge(
+            "oobleck_ckpt_last_durable_step",
+            "Newest step with a committed (restorable) checkpoint")
+        self._m_gc = reg.counter(
+            "oobleck_ckpt_gc_deleted_total",
+            "Old checkpoint step dirs pruned by keep-last-k GC")
+        self._m_commit_timeouts = reg.counter(
+            "oobleck_ckpt_commit_timeouts_total",
+            "Global-manifest commits abandoned waiting for peer manifests")
+
+    # -- submission ------------------------------------------------------ #
+
+    def submit(self, snap: snp.Snapshot) -> float:
+        """Queue one snapshot; returns the train-loop stall in seconds.
+
+        Async: blocks while the previous write is in flight (the
+        double-buffer drain), stages the snapshot to host copies, then
+        enqueues and returns. Sync: performs the full write inline."""
+        t0 = time.perf_counter()
+        snp.stage_to_host(snap)
+        if not self.asynchronous:
+            try:
+                self._write(snap)
+            except Exception as e:  # noqa: BLE001 — durability must not kill training
+                self.last_error = e
+                logger.exception("checkpoint write failed (step %d)",
+                                 snap.step)
+            stall = time.perf_counter() - t0
+            self._m_stall.observe(stall, mode="sync")
+            return stall
+        with self._cond:
+            while self._job is not None and not self._closed:
+                self._cond.wait(0.05)
+            if self._closed:
+                raise RuntimeError("SnapshotWriter is closed")
+            self._job = snap
+            if self._thread is None or not self._thread.is_alive():
+                self._thread = threading.Thread(
+                    target=self._worker, name="oobleck-ckpt-writer",
+                    daemon=True)
+                self._thread.start()
+            self._cond.notify_all()
+        stall = time.perf_counter() - t0
+        self._m_stall.observe(stall, mode="async")
+        return stall
+
+    def _worker(self) -> None:
+        while True:
+            with self._cond:
+                while self._job is None and not self._closed:
+                    self._cond.wait(0.5)
+                if self._job is None:
+                    return
+                snap = self._job
+            try:
+                self._write(snap)
+            except Exception as e:  # noqa: BLE001
+                self.last_error = e
+                logger.exception("checkpoint write failed (step %d)",
+                                 snap.step)
+            finally:
+                with self._cond:
+                    self._job = None
+                    self._cond.notify_all()
+
+    def flush(self, timeout: float | None = None) -> bool:
+        """Wait until no write is in flight; True when drained."""
+        deadline = None if timeout is None else time.monotonic() + timeout
+        with self._cond:
+            while self._job is not None:
+                remaining = None if deadline is None \
+                    else deadline - time.monotonic()
+                if remaining is not None and remaining <= 0:
+                    return False
+                self._cond.wait(0.05 if remaining is None
+                                else min(0.05, remaining))
+        return True
+
+    def close(self) -> None:
+        self.flush()
+        with self._cond:
+            self._closed = True
+            self._cond.notify_all()
+        if self._thread is not None and self._thread.is_alive():
+            self._thread.join(timeout=5.0)
+
+    # -- preemption ------------------------------------------------------ #
+
+    def install_preemption_hook(self) -> None:
+        """Chain a SIGTERM handler that drains the in-flight snapshot
+        before the process obeys the signal. No-op off the main thread
+        (signal.signal would raise) and when already installed."""
+        if self._hook_installed:
+            return
+        try:
+            prev = signal.getsignal(signal.SIGTERM)
+
+            def _handler(signum, frame):
+                grace = _flush_grace()
+                logger.warning(
+                    "SIGTERM: flushing in-flight checkpoint "
+                    "(grace %.1fs, last durable step %d)",
+                    grace, self.last_durable_step)
+                self.flush(timeout=grace)
+                metrics.flight_recorder().record(
+                    "ckpt_preemption_flush", step=self.last_durable_step,
+                    ip=self.ip)
+                if callable(prev):
+                    prev(signum, frame)
+                else:
+                    signal.signal(signal.SIGTERM, signal.SIG_DFL)
+                    os.kill(os.getpid(), signal.SIGTERM)
+
+            signal.signal(signal.SIGTERM, _handler)
+            self._hook_installed = True
+        except ValueError:
+            logger.debug("not on the main thread; preemption hook skipped")
+
+    # -- the write ------------------------------------------------------- #
+
+    def _write(self, snap: snp.Snapshot) -> None:
+        t0 = time.monotonic()
+        p = self.process_index
+        d = self.root / mf.step_dir_name(snap.step)
+        d.mkdir(parents=True, exist_ok=True)
+        # Re-saving a step from a previous incarnation: clear our own stale
+        # artifacts so the commit poll can't trust old bytes.
+        data_path = d / mf.data_file_name(p)
+        man_path = d / mf.proc_manifest_name(p)
+        if p == 0:
+            (d / mf.GLOBAL_MANIFEST).unlink(missing_ok=True)
+        data_path.unlink(missing_ok=True)
+        man_path.unlink(missing_ok=True)
+
+        # Stage to host + pack. Every piece rides as a flat uint8 view
+        # (ml_dtypes have no portable npz descr); manifest entries carry
+        # dtype/shape/global placement.
+        arrays: dict[str, np.ndarray] = {}
+        entries: list[dict] = []
+        total = 0
+        for key, value in snap.entries:
+            gshape = snp.global_shape_of(value)
+            gdtype = snp.global_dtype_of(value)
+            for index, arr in snp.materialize_value(value):
+                arr = np.ascontiguousarray(arr)
+                name = f"e{len(arrays)}"
+                arrays[name] = arr.reshape(-1).view(np.uint8)
+                entries.append({
+                    "key": key,
+                    "npz": name,
+                    "dtype": gdtype,
+                    "shape": list(arr.shape),
+                    "global_shape": list(gshape),
+                    "index": mf.encode_index(index),
+                })
+                total += arr.nbytes
+        tmp = d / f".tmp-{mf.data_file_name(p)}"
+        with open(tmp, "wb") as f:
+            np.savez(f, **arrays)
+            f.flush()
+            os.fsync(f.fileno())
+        crc = mf.file_crc32(tmp)
+        nbytes = tmp.stat().st_size
+        os.replace(tmp, data_path)
+        mf.fsync_dir(d)
+
+        chaos().barrier(CHAOS_BARRIER_MID_WRITE, ip=self.ip)
+
+        mf.atomic_write_json(man_path, {
+            "format": mf.FORMAT_VERSION,
+            "process": p,
+            "world_size": self.world_size,
+            "step": snap.step,
+            "kind": snap.kind,
+            "data_file": data_path.name,
+            "data_crc32": crc,
+            "data_bytes": nbytes,
+            "entries": entries,
+        })
+        self._m_bytes.inc(total)
+        self._m_saves.inc()
+        if p == 0:
+            self._commit(d, snap)
+        dur = time.monotonic() - t0
+        self._m_write.observe(dur)
+        logger.info("ckpt write step %d: %.3fs, %d B, %d pieces (proc %d)",
+                    snap.step, dur, total, len(entries), p)
+
+    def _commit(self, d: Path, snap: snp.Snapshot) -> None:
+        """Rank 0: wait for every per-process manifest, then atomically
+        commit the global manifest and prune old steps."""
+        deadline = time.monotonic() + self.commit_timeout
+        names = [mf.proc_manifest_name(q) for q in range(self.world_size)]
+        while True:
+            missing = [n for n in names if not (d / n).exists()]
+            if not missing:
+                break
+            if time.monotonic() > deadline:
+                self._m_commit_timeouts.inc()
+                logger.error(
+                    "ckpt step %d: gave up waiting %.0fs for peer "
+                    "manifests %s; step stays uncommitted", snap.step,
+                    self.commit_timeout, missing)
+                return
+            time.sleep(0.02)
+        procs = []
+        for n in names:
+            path = d / n
+            pm = mf.read_json(path)
+            if pm.get("step") != snap.step or pm.get("kind") != snap.kind:
+                logger.error("ckpt step %d: stale peer manifest %s; "
+                             "not committing", snap.step, n)
+                return
+            procs.append({"file": n, "crc32": mf.file_crc32(path),
+                          "bytes": path.stat().st_size})
+        mf.atomic_write_json(d / mf.GLOBAL_MANIFEST, {
+            "format": mf.FORMAT_VERSION,
+            "step": snap.step,
+            "kind": snap.kind,
+            "world_size": self.world_size,
+            "meta": snap.meta,
+            "processes": procs,
+        })
+        self.last_durable_step = snap.step
+        self._m_last_durable.set(snap.step)
+        logger.info("saved checkpoint %s", d)
+        metrics.flight_recorder().record(
+            "ckpt_commit", step=snap.step, world_size=self.world_size)
+        self._gc()
+
+    def _gc(self) -> None:
+        if self.keep_last <= 0:
+            return
+        complete = []
+        for child in self.root.iterdir():
+            step = mf.parse_step_dir(child.name)
+            if step is None or not child.is_dir():
+                continue
+            if (child / mf.GLOBAL_MANIFEST).exists():
+                complete.append((step, child))
+        complete.sort(reverse=True)
+        for step, child in complete[self.keep_last:]:
+            # Remove the commit marker FIRST so a crash mid-delete leaves
+            # an uncommitted (ignorable) dir, not a torn "complete" one.
+            (child / mf.GLOBAL_MANIFEST).unlink(missing_ok=True)
+            shutil.rmtree(child, ignore_errors=True)
+            self._m_gc.inc()
+            logger.info("ckpt GC: pruned %s (keep_last=%d)", child.name,
+                        self.keep_last)

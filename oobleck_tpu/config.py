@@ -105,6 +105,11 @@ class ExecutionArguments:
     attention_impl: str = "auto"  # auto | xla | pallas | ring | ulysses
     checkpoint_dir: str | None = None
     checkpoint_interval: int = 0  # steps; 0 disables
+    # Durable-state plane knobs (oobleck_tpu/ckpt). keep_last <= 0 keeps
+    # every step; checkpoint_async=False is the synchronous baseline
+    # (the train loop stalls for the full device->host->disk write).
+    checkpoint_keep_last: int = 3
+    checkpoint_async: bool = True
     # Checkpoint-FREE multi-host recovery (reference engine.py:238-309:
     # survivors broadcast live states, no checkpoint reload): each worker
     # mirrors its LOCAL layers' live state to a host-local file every
@@ -145,6 +150,27 @@ class ExecutionArguments:
                 "attention_impl must be auto|xla|pallas|ring|ulysses, got "
                 f"{self.attention_impl!r}"
             )
+
+    def apply_durable_env_overrides(self) -> None:
+        """Runtime overrides for the durable-state plane — preemption
+        notice handling and checkpoint cadence are deployment properties,
+        not model properties, so they must be settable without editing the
+        job yaml: OOBLECK_CKPT_DIR, OOBLECK_CKPT_INTERVAL,
+        OOBLECK_CKPT_KEEP, OOBLECK_CKPT_ASYNC (0/1)."""
+        import os
+
+        v = os.environ.get("OOBLECK_CKPT_DIR")
+        if v:
+            self.checkpoint_dir = v
+        v = os.environ.get("OOBLECK_CKPT_INTERVAL")
+        if v:
+            self.checkpoint_interval = int(v)
+        v = os.environ.get("OOBLECK_CKPT_KEEP")
+        if v:
+            self.checkpoint_keep_last = int(v)
+        v = os.environ.get("OOBLECK_CKPT_ASYNC")
+        if v:
+            self.checkpoint_async = v.lower() not in ("0", "false", "no")
 
     def resolved_path(self) -> str:
         # auto: fused is still the default home for sequence parallelism

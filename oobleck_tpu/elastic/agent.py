@@ -292,9 +292,22 @@ class OobleckAgent:
             # worker-side generation tagging drops it if it is stale.
             parent_pipe.send(self._last_coordinator)
 
-    def _stop_worker(self, timeout: float = 15.0) -> None:
+    def _stop_worker(self, timeout: float | None = None) -> None:
         """Terminate the worker, escalating to SIGKILL — a worker wedged in
-        a collective with a dead peer can ignore SIGTERM."""
+        a collective with a dead peer can ignore SIGTERM.
+
+        SIGTERM triggers the worker's checkpoint preemption hook (ckpt/
+        writer.py drains any in-flight snapshot before obeying), so the
+        default join timeout covers the flush grace: killing inside the
+        grace window would tear the very checkpoint the hook protects."""
+        if timeout is None:
+            from oobleck_tpu.ckpt.writer import FLUSH_GRACE_ENV
+
+            try:
+                grace = float(os.environ.get(FLUSH_GRACE_ENV, "10"))
+            except ValueError:
+                grace = 10.0
+            timeout = max(15.0, grace + 5.0)
         w = self.worker
         self.worker = None  # watch loop must not treat this as a death
         if w is None or not w.process.is_alive():

@@ -273,11 +273,23 @@ class OobleckMasterDaemon:
                         if s.get("value", 0) >= best:
                             best = s.get("value", 0)
                             template = s.get("labels", {})
+        # Newest restorable checkpoint step across the cluster (rank 0 owns
+        # the commit, so max over workers is the committed truth); -1 until
+        # the first durable commit, None when checkpointing is off.
+        last_durable = None
+        for snap in worker_snaps.values():
+            for m in snap.get("metrics", []):
+                if m["name"] == "oobleck_ckpt_last_durable_step":
+                    for s in m["series"]:
+                        v = int(s.get("value", -1))
+                        if last_durable is None or v > last_durable:
+                            last_durable = v
         return {
             "job": self.job.model.model_name if self.job else None,
             "agents": agents,
             "coordinator": self.coordinator,
             "pipeline_template": template,
+            "last_durable_step": last_durable,
             "recoveries": recoveries,
             "in_flight_recoveries": [
                 r for r in recoveries if r.get("resolved_at") is None

@@ -1,19 +1,30 @@
-"""Checkpoint / resume.
+"""Checkpoint / resume — thin compat shim over the durable-state plane.
 
 The reference does NOT support checkpointing (README.md:103; weights are
 randomly re-materialized at startup, layer.py:26-37) — its recovery story is
 purely in-memory. On TPU, preemption is routine, so this is a required
 capability gap to close (SURVEY §5 "Checkpoint / resume").
 
-Design: one orbax checkpoint per save step holding a plain pytree:
+The implementation lives in `oobleck_tpu/ckpt` (async sharded writes,
+atomic manifests, crash-consistent restore); the engine talks to that
+plane directly. This module keeps the original synchronous function
+signatures for existing callers and tests, with the original payload
+shape:
 
-    {"params": {str(layer): tree}, "opt": {str(layer): tree},
-     "meta": {"step", "num_iterations_done", "epoch", "model_name",
-              "global_num_microbatch"}}
+    {"params": {layer: tree}, "opt": {layer: [flat leaves]},
+     "meta": {"step", "num_iterations_done", "epoch", "model_name", ...}}
 
 Layer-keyed (not pipeline-keyed) so a restore can re-instantiate ANY plan
-shape — checkpoints survive cluster-size changes the same way reconfiguration
-does. Saves collect each layer once from whichever pipeline owns it.
+shape — checkpoints survive cluster-size changes the same way
+reconfiguration does.
+
+Behavior changes vs the old orbax wrapper, both deliberate:
+  * `latest_checkpoint` only returns step dirs with a COMMITTED manifest —
+    a crash mid-save can no longer poison resume with a torn directory;
+  * saves need no cross-process barrier (each process's write is
+    independent; rank 0 commits via the filesystem), so in a multi-process
+    world only process 0 writes here — it receives the full collected
+    state, matching the old orbax primary-writes semantics.
 """
 
 from __future__ import annotations
@@ -35,9 +46,16 @@ def to_host_local(x):
     index is covered by SOME local shard (params replicated across the data
     axis, or sharded only along within-host axes) the full value can be
     assembled locally with no collective. Raises when local coverage is
-    incomplete (cross-host FSDP needs a distributed checkpoint format)."""
-    if not isinstance(x, jax.Array) or x.is_fully_replicated or x.is_fully_addressable:
+    incomplete (cross-host FSDP takes the ckpt plane's sharded-write path
+    instead — engine.save_checkpoint falls back to `save_stacked`).
+
+    jax arrays are COPIED: np.asarray of an XLA CPU buffer is a zero-copy
+    view, and the train step donates its state buffers — a view would
+    alias memory the next step reuses (SIGSEGV)."""
+    if not isinstance(x, jax.Array):
         return np.asarray(x)
+    if x.is_fully_replicated or x.is_fully_addressable:
+        return np.array(x)
     out = np.empty(x.shape, x.dtype)
     covered = np.zeros(x.shape, bool)
     seen: set = set()
@@ -59,63 +77,40 @@ def to_host_local(x):
     return out
 
 
-def _to_host(tree):
-    return jax.tree.map(to_host_local, tree)
-
-
 def save_checkpoint(path: str | Path, *, step: int, params: dict[int, Any],
                     opt_state: dict[int, Any], num_iterations_done: int,
                     epoch: int, extra: dict | None = None) -> Path:
-    """Write checkpoint for `step`; returns its directory."""
-    import orbax.checkpoint as ocp
+    """Write checkpoint for `step` synchronously; returns its directory.
+
+    Callers pass the full collected layer state (the engine's multi-host
+    path collects it first); in a multi-process world only process 0
+    writes, everyone else returns the target path untouched."""
+    from oobleck_tpu import ckpt
 
     path = Path(path).resolve()
-    path.mkdir(parents=True, exist_ok=True)
-    target = path / f"step_{step}"
-    payload = {
-        "params": {str(k): _to_host(v) for k, v in params.items()},
-        # Optimizer states are stored as flat leaf lists: optax states are
-        # NamedTuple pytrees whose node types a structure-free restore cannot
-        # rebuild; the engine re-derives the structure from optimizer.init
-        # and refills these leaves.
-        "opt": {str(k): [to_host_local(l) for l in jax.tree.leaves(v)]
-                for k, v in opt_state.items()},
-        "meta": {
-            "step": step,
-            "num_iterations_done": num_iterations_done,
-            "epoch": epoch,
-            **(extra or {}),
-        },
-    }
-    ckpt = ocp.PyTreeCheckpointer()
-    ckpt.save(target, payload, force=True)
+    target = path / ckpt.manifest.step_dir_name(step)
+    if jax.process_count() > 1 and jax.process_index() != 0:
+        return target
+    plane = ckpt.DurableStatePlane(path, asynchronous=False, keep_last=0)
+    plane.save(step=step, params=params, opt_state=opt_state,
+               num_iterations_done=num_iterations_done, epoch=epoch,
+               extra=extra)
+    plane.close()
     logger.info("saved checkpoint %s", target)
     return target
 
 
 def latest_checkpoint(path: str | Path) -> Path | None:
-    path = Path(path)
-    if not path.exists():
-        return None
-    steps = []
-    for p in path.iterdir():
-        if p.is_dir() and p.name.startswith("step_"):
-            try:
-                steps.append((int(p.name.split("_", 1)[1]), p))
-            except ValueError:
-                continue
-    return max(steps)[1] if steps else None
+    """Newest step dir with a COMMITTED manifest; torn dirs are invisible."""
+    from oobleck_tpu.ckpt.restore import complete_step_dirs
+
+    dirs = complete_step_dirs(path)
+    return dirs[0][1] if dirs else None
 
 
 def load_checkpoint(target: str | Path) -> dict:
-    """Load a checkpoint directory into host-memory pytrees with int layer
-    keys restored."""
-    import orbax.checkpoint as ocp
+    """Load one checkpoint directory into host-memory pytrees with int
+    layer keys; validates checksums (raises ckpt.CheckpointCorrupt)."""
+    from oobleck_tpu import ckpt
 
-    ckpt = ocp.PyTreeCheckpointer()
-    payload = ckpt.restore(Path(target).resolve())
-    return {
-        "params": {int(k): v for k, v in payload["params"].items()},
-        "opt": {int(k): v for k, v in payload["opt"].items()},
-        "meta": payload["meta"],
-    }
+    return ckpt.load_step_dir(target)

@@ -586,6 +586,13 @@ class OobleckEngine:
         self._mirror_thread: threading.Thread | None = None
         self._mirror_skipped = 0
         self.mirror_write_s: list[float] = []
+        # Durable-state plane (oobleck_tpu/ckpt): the persistent half of
+        # the two-tier recovery story — mirrors refill peers, checkpoints
+        # survive whole-slice preemption. Built lazily (needs the resolved
+        # process/world identity); env vars can retarget it per deployment.
+        args.execution.apply_durable_env_overrides()
+        self._durable = None
+        self.ckpt_stall_s: list[float] = []
         self._pending_lost: list[str] = []
         self._lock = threading.Lock()
         import queue as _queue
@@ -987,9 +994,11 @@ class OobleckEngine:
 
     # ------------------------------------------------------------------ #
 
-    def instantiate_pipelines(self, global_num_microbatch: int,
-                              num_iterations_done: int = 0, epoch: int = 0) -> None:
-        old_params = old_opt = None
+    def _restore_durable_state(self) -> dict | None:
+        """ONE restore API over both persistence planes: live-state
+        mirrors (peer recovery, freshest) and the durable checkpoint plane
+        (survives whole-slice loss). The freshest source wins per the step
+        election; checkpoint state fills layers no surviving mirror holds."""
         restored = self.try_restore_checkpoint()
         if self.multihost and self.args.execution.mirror_dir:
             # Collective — every process calls regardless of mirror state.
@@ -1013,6 +1022,12 @@ class OobleckEngine:
                 # completes closes the RECOVERY_DEADLINE chain.
                 self._recovering = True
                 self._recovered_at = time.monotonic()
+        return restored
+
+    def instantiate_pipelines(self, global_num_microbatch: int,
+                              num_iterations_done: int = 0, epoch: int = 0) -> None:
+        old_params = old_opt = None
+        restored = self._restore_durable_state()
         if restored is not None:
             old_params = restored["params"]
             # Optimizer leaves were stored flat; rebuild the optax structure.
@@ -1452,6 +1467,11 @@ class OobleckEngine:
         interval = self.args.execution.checkpoint_interval
         sync_interval = self.args.execution.replica_sync_interval
         self._tracer = StepTracer()
+        plane = self._durable_plane()
+        if plane is not None:
+            # SIGTERM (TPU maintenance / preemption notice) drains the
+            # in-flight snapshot before the process obeys the signal.
+            plane.install_preemption_hook()
         try:
             while self.step < max_steps:
                 self._tracer.on_step(self.step)
@@ -1493,7 +1513,9 @@ class OobleckEngine:
                 if sync_interval and self.step % sync_interval == 0:
                     self._sync_replicas()
                 if interval and self.step % interval == 0:
-                    self.save_checkpoint()
+                    # Async submit: the loop stalls only for drain+capture;
+                    # the write happens off-thread (oobleck_tpu/ckpt).
+                    self.save_checkpoint(wait=False)
                 mirror_every = self.args.execution.mirror_interval
                 if (self.multihost and self.args.execution.mirror_dir
                         and mirror_every
@@ -1503,6 +1525,8 @@ class OobleckEngine:
                 self.save_checkpoint()
         finally:
             self._mirror_flush()
+            if self._durable is not None:
+                self._durable.flush()
             self._publish_metrics()
             if self._tracer is not None:
                 self._tracer.close()
@@ -1604,47 +1628,143 @@ class OobleckEngine:
                     self.optimizer, full[li]["o"], dst,
                 )
 
-    def save_checkpoint(self) -> None:
-        from oobleck_tpu.execution.checkpoint import save_checkpoint
-
+    def _durable_plane(self):
+        """Lazy handle on the durable-state plane (oobleck_tpu/ckpt), or
+        None when checkpointing is off. Rebuilt if the process identity or
+        target dir changed (a respawned multi-host world resolves its comm
+        after __init__)."""
         ckpt_dir = self.args.execution.checkpoint_dir
         if not ckpt_dir:
-            return
-        # Multi-process: EVERY process calls save — orbax writes host-type
-        # values from the primary process only but runs a cross-process
-        # barrier inside save(); gating non-zero processes out deadlocks it.
-        if self.fused is not None:
-            params, opt = self.fused.layer_state()
-        elif self.multihost:
-            # COLLECTIVE: every process assembles the identical full state
-            # (orbax then writes host values from the primary only).
-            full = self._fill_full_state()
-            params = {li: v["p"] for li, v in full.items()}
-            opt = {li: v["o"] for li, v in full.items()}
+            return None
+        from pathlib import Path
+
+        from oobleck_tpu import ckpt
+
+        pi = ws = None
+        if self.multihost and self.comm is not None:
+            pi, ws = self.comm.process_index, self.comm.process_count
         else:
-            self._sync_replicas()
-            params, opt = self._collect_layer_state()
-        save_checkpoint(
-            ckpt_dir, step=self.step, params=params, opt_state=opt,
+            # Fused multi-host worlds have no MPMD comm; their process
+            # identity is jax.distributed's (1/1 when uninitialized).
+            pi, ws = jax.process_index(), jax.process_count()
+        d = self._durable
+        if (d is None or str(d.root) != str(Path(ckpt_dir).resolve())
+                or d.process_index != pi or d.world_size != ws):
+            if d is not None:
+                d.close()
+            ex = self.args.execution
+            self._durable = ckpt.DurableStatePlane(
+                ckpt_dir, process_index=pi, world_size=ws,
+                keep_last=ex.checkpoint_keep_last,
+                asynchronous=ex.checkpoint_async, ip=self.agent_ip)
+        return self._durable
+
+    def _elected_local_layer_state(self):
+        """Multi-host MPMD, NO collective: every layer's writer is the
+        minimum process owning it — derivable from the plan on every
+        process identically — so each process contributes a disjoint slice
+        of the global layer set and the plane's manifest merge makes the
+        checkpoint whole. Replaces the old _fill_full_state collective on
+        the save path (which shipped every layer to every host just so
+        one of them could write)."""
+        me = self.comm.process_index if self.comm is not None else 0
+        owner: dict[int, int] = {}
+        for pipe in self.pipelines:
+            for st in pipe.stages:
+                proc = st.process if st.process is not None else 0
+                for li in st.layer_ids:
+                    owner[li] = min(owner.get(li, 1 << 30), proc)
+        params: dict[int, Any] = {}
+        opt: dict[int, Any] = {}
+        for pipe in self.pipelines:
+            if not pipe.participates_locally:
+                continue
+            for li, p in pipe.params.items():
+                if owner.get(li) == me and li not in params:
+                    params[li] = p
+                    opt[li] = self.opt_states[pipe.pipeline_id][li]
+        return params, opt
+
+    def save_checkpoint(self, wait: bool = True) -> None:
+        """Snapshot + submit to the durable-state plane. Every process
+        calls this (each writes only its elected layers' shards; process 0
+        commits the manifest — no collective, no barrier). `wait=False` is
+        the train-loop mode: the call returns once the snapshot is staged
+        to host and enqueued; the stall is drain + staging, not the
+        write."""
+        plane = self._durable_plane()
+        if plane is None:
+            return
+        meta = dict(
             num_iterations_done=self.dataloaders[0].num_iterations_done,
             epoch=self.dataloaders[0].epoch,
             extra={"model_name": self.args.model.model_name},
         )
+        if self.fused is not None:
+            try:
+                params, opt = self.fused.layer_state()
+            except ValueError:
+                # Cross-host-sharded fused state: host-local layer assembly
+                # is impossible (to_host_local raises). Write the raw
+                # stacked leaves shard-wise instead — restore layerizes
+                # them (_layerize_stacked) where model+optimizer live.
+                st = self.fused.state
+                stall = plane.save_stacked(
+                    step=self.step, params=st.params,
+                    opt_leaves=jax.tree.leaves(st.opt_state), **meta)
+                self.ckpt_stall_s.append(stall)
+                if wait:
+                    plane.flush()
+                return
+        elif self.multihost:
+            params, opt = self._elected_local_layer_state()
+        else:
+            self._sync_replicas()
+            params, opt = self._collect_layer_state()
+        stall = plane.save(step=self.step, params=params, opt_state=opt,
+                           **meta)
+        self.ckpt_stall_s.append(stall)
+        if wait:
+            plane.flush()
 
     def try_restore_checkpoint(self) -> dict | None:
-        """Load the newest checkpoint from execution.checkpoint_dir, if any.
-        Returns the payload for instantiate_pipelines-time consumption."""
-        from oobleck_tpu.execution.checkpoint import latest_checkpoint, load_checkpoint
-
-        ckpt_dir = self.args.execution.checkpoint_dir
-        if not ckpt_dir:
+        """Load the newest restorable checkpoint from the durable-state
+        plane, if any. Torn/corrupt step dirs are quarantined (by process
+        0) and skipped. Returns the payload for instantiate_pipelines-time
+        consumption."""
+        plane = self._durable_plane()
+        if plane is None:
             return None
-        target = latest_checkpoint(ckpt_dir)
-        if target is None:
+        payload = plane.restore_latest()
+        if payload is None:
             return None
-        payload = load_checkpoint(target)
-        logger.info("restoring from %s (step %s)", target, payload["meta"]["step"])
+        if payload.get("kind") == "fused_stacked":
+            payload = self._layerize_stacked(payload)
+        from oobleck_tpu.ckpt import manifest as _mf
+        step = payload["meta"]["step"]
+        logger.info("restoring from durable checkpoint %s (step %s)",
+                    _mf.step_dir_name(step), step)
         return payload
+
+    def _layerize_stacked(self, payload: dict) -> dict:
+        """Convert a fused_stacked payload (raw stacked TrainState on
+        host) into the layer-keyed checkpoint form — pure host-side tree
+        restructuring via the fused path's own converters."""
+        from oobleck_tpu.execution.fused import (
+            opt_state_to_layers,
+            params_to_layers,
+        )
+
+        params = payload["params"]
+        struct = jax.tree.structure(
+            jax.eval_shape(self.optimizer.init, params))
+        opt_state = jax.tree.unflatten(struct, payload["opt"])
+        p_layers = params_to_layers(self.model, params)
+        o_layers = opt_state_to_layers(self.model, self.optimizer, params,
+                                       opt_state)
+        return {"params": p_layers,
+                "opt": {li: jax.tree.leaves(v) for li, v in o_layers.items()},
+                "meta": payload["meta"]}
 
     # -- checkpoint-free live-state mirror (multi-host MPMD) ------------ #
 

@@ -21,8 +21,11 @@ directives; each directive is ``action=arg[:qual][@ip]``:
                                 named barrier, on that host only
 
 Barriers are explicit calls (``chaos().barrier("step_end", ip=...)``)
-placed at recovery-relevant points: worker start, step start/end. The
-``@ip`` filter selects a victim in a cluster whose processes share one
+placed at recovery-relevant points: worker start, step start/end, and
+``ckpt_mid_write`` — between the checkpoint writer's shard-data rename
+and its manifest write (ckpt/writer.py), the exact window where a kill
+leaves a torn checkpoint the restore path must quarantine. The ``@ip``
+filter selects a victim in a cluster whose processes share one
 environment; directives without ``@ip`` match every process.
 
 Inactive chaos (no env var) costs one attribute read per hook — the

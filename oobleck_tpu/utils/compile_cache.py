@@ -26,11 +26,20 @@ from __future__ import annotations
 
 import getpass
 import hashlib
+import logging
 import os
 import platform
 import tempfile
+import zlib
+
+logger = logging.getLogger("oobleck.compile_cache")
 
 _cpu_sig_cache: str | None = None
+
+# Compressed-entry magics: jax's compilation cache compresses serialized
+# executables with zstandard when importable, zlib otherwise.
+_ZSTD_MAGIC = b"\x28\xb5\x2f\xfd"
+_SCRUB_STAMP = ".oobleck_scrub_stamp"
 
 
 def cache_event(event: str, n: int = 1) -> None:
@@ -103,6 +112,96 @@ def persistent_cache_dir() -> str | None:
     return d
 
 
+def _entry_corrupt(path: str) -> bool:
+    """True when a cache entry is PROVABLY corrupt: empty, or a truncated/
+    damaged compressed stream. Entries in a format we cannot validate
+    (zstd without the zstandard module, or an unrecognized header) are
+    left alone — eviction must never eat a valid entry."""
+    try:
+        with open(path, "rb") as f:
+            blob = f.read()
+    except OSError:
+        return False
+    if not blob:
+        return True  # a crash mid-write left an empty entry
+    if blob[:4] == _ZSTD_MAGIC:
+        try:
+            import zstandard
+        except ImportError:
+            return False
+        try:
+            dec = zstandard.ZstdDecompressor().decompressobj()
+            for i in range(0, len(blob), 1 << 20):
+                dec.decompress(blob[i:i + (1 << 20)])
+            return False
+        except zstandard.ZstdError:
+            return True
+    if blob[0] != 0x78:  # zlib header byte
+        return False
+    try:
+        dec = zlib.decompressobj()
+        for i in range(0, len(blob), 1 << 20):
+            dec.decompress(blob[i:i + (1 << 20)])
+        # A truncated stream decompresses without error but never reaches
+        # EOF — the exact state a killed writer leaves behind, and the one
+        # that wedges deserialization at use time.
+        return not dec.eof
+    except zlib.error:
+        return True
+
+
+def scrub_persistent_cache(d: str | None = None, *, force: bool = False) -> int:
+    """Detect and evict poisoned/corrupt persistent-cache entries.
+
+    A cache entry that fails to decompress can wedge execution at USE time
+    (observed: a hang inside a float(loss) readback on a cached fused
+    program — the failure mode that broke the fused multiprocess recovery
+    test), so corruption is caught at startup instead: every entry newer
+    than the last scrub is validated and deleted on failure (JAX then
+    recompiles and rewrites it). Returns the number evicted.
+
+    Incremental via a stamp file so repeated startups only pay for new
+    entries; `force=True` rescans everything."""
+    d = d if d is not None else persistent_cache_dir()
+    if d is None or not os.path.isdir(d):
+        return 0
+    stamp = os.path.join(d, _SCRUB_STAMP)
+    last = 0.0
+    if not force:
+        try:
+            last = os.stat(stamp).st_mtime
+        except OSError:
+            pass
+    evicted = 0
+    for name in os.listdir(d):
+        if name.startswith("."):
+            continue
+        path = os.path.join(d, name)
+        try:
+            st = os.stat(path)
+        except OSError:
+            continue
+        if not os.path.isfile(path) or (not force and st.st_mtime < last):
+            continue
+        if _entry_corrupt(path):
+            try:
+                os.unlink(path)
+            except OSError:
+                continue
+            evicted += 1
+            logger.warning(
+                "evicted corrupt persistent-cache entry %s (%d B): "
+                "deserialization would fail or hang; it will recompile",
+                name, st.st_size)
+    try:
+        with open(stamp, "w") as f:
+            f.write("scrub marker; entries older than this mtime are validated\n")
+    except OSError:
+        pass
+    cache_event("evicted_corrupt", evicted)
+    return evicted
+
+
 def ensure_persistent_cache() -> str | None:
     """Point JAX's persistent compilation cache at `persistent_cache_dir()`.
 
@@ -117,6 +216,9 @@ def ensure_persistent_cache() -> str | None:
     import jax
 
     if jax.config.jax_compilation_cache_dir != d:
+        # First enable in this process: validate entries written since the
+        # last scrub before anything deserializes them.
+        scrub_persistent_cache(d)
         jax.config.update("jax_compilation_cache_dir", d)
         cache_event("enabled")
     return d

@@ -44,6 +44,13 @@ DEFAULT_BUCKETS = (0.001, 0.0025, 0.005, 0.01, 0.025, 0.05, 0.1, 0.25,
 # RECOVERY_DEADLINE budget in chaos tests is tens of seconds).
 RECOVERY_BUCKETS = (0.1, 0.25, 0.5, 1.0, 2.5, 5.0, 10.0, 15.0, 30.0,
                     60.0, 120.0, 300.0, 600.0)
+# Checkpoint train-loop stalls: the async writer's enqueue is tens of
+# microseconds (reference capture, no device_get), the sync baseline is
+# the full write — the histogram must resolve both ends to evidence the
+# "<25% of synchronous stall" acceptance bar (oobleck_tpu/ckpt).
+CKPT_STALL_BUCKETS = (0.0001, 0.00025, 0.0005, 0.001, 0.0025, 0.005,
+                      0.01, 0.025, 0.05, 0.1, 0.25, 0.5, 1.0, 2.5, 5.0,
+                      10.0, 30.0, 60.0)
 
 
 def _label_key(labels: dict[str, str]) -> tuple[tuple[str, str], ...]:

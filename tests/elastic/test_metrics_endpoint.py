@@ -25,12 +25,15 @@ def _get(port: int, path: str) -> tuple[int, dict, bytes]:
         return resp.status, dict(resp.headers), resp.read()
 
 
-def _worker_snapshot(step: int, tps: float) -> dict:
+def _worker_snapshot(step: int, tps: float,
+                     durable_step: int | None = None) -> dict:
     """A registry snapshot as a worker process would push it."""
     reg = metrics.Registry()
     reg.gauge("oobleck_engine_tokens_per_sec").set(tps)
     reg.gauge("oobleck_engine_pipeline_template_info").set(
         float(step), pipelines="2", stages="2/2", hosts="2")
+    if durable_step is not None:
+        reg.gauge("oobleck_ckpt_last_durable_step").set(float(durable_step))
     snap = reg.snapshot()
     snap["step"] = step
     return snap
@@ -131,13 +134,17 @@ async def test_status_tracks_recovery_lifecycle(job_args, tmp_path,
         # Survivor's worker steps again → pushes metrics → resolved.
         await send_request(w1, RequestType.METRICS, {
             "ip": "10.0.0.1", "role": "worker",
-            "snapshot": _worker_snapshot(step=11, tps=1000.0)})
+            "snapshot": _worker_snapshot(step=11, tps=1000.0,
+                                         durable_step=10)})
         await send_request(w1, RequestType.PING)
         assert (await recv_msg(r1))["kind"] == ResponseType.PONG.value
 
         payload = daemon._status()
         assert payload["in_flight_recoveries"] == []
         assert payload["recoveries"][0]["resolved_at"] is not None
+        # The worker's checkpoint gauge surfaces cluster-wide: the master
+        # now reports the newest restorable step next to the recovery view.
+        assert payload["last_durable_step"] == 10
 
         dumps = sorted(p for p in tmp_path.iterdir()
                        if p.name.startswith("flight-master-"))

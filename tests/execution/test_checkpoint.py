@@ -40,6 +40,18 @@ def test_latest_picks_max_step(tmp_path):
     assert latest_checkpoint(tmp_path / "missing") is None
 
 
+def test_latest_skips_uncommitted_step_dir(tmp_path):
+    """A numerically-newer step dir WITHOUT a committed manifest (a writer
+    died mid-write, or another process is still writing) must never be
+    selected as the resume point."""
+    save_checkpoint(tmp_path, step=5, params={0: {"w": np.ones(2)}},
+                    opt_state={0: ()}, num_iterations_done=0, epoch=0)
+    torn = tmp_path / "step_9"
+    torn.mkdir()
+    (torn / "shards-00000.npz").write_bytes(b"partial write")
+    assert latest_checkpoint(tmp_path).name == "step_5"
+
+
 def test_engine_checkpoint_resume(cache_env, devices8, tmp_path):
     """Train 2 steps -> checkpoint -> fresh engine with FEWER hosts restores
     step/params/data position and continues."""
@@ -71,6 +83,45 @@ def test_engine_checkpoint_resume(cache_env, devices8, tmp_path):
 
     loss = engine2._train_step()
     assert np.isfinite(loss)
+
+
+def test_engine_checkpoint_resume_grow(cache_env, devices8, tmp_path):
+    """Save on a SMALL cluster, restore on a BIGGER one (2 -> 4 hosts):
+    the re-planned pipelines slice layers differently, so layer-keyed
+    params AND optimizer state must land by layer id, not by position."""
+    engine = make_engine(num_hosts=2, steps=4, devices=devices8[:4])
+    engine.args.execution.checkpoint_dir = str(tmp_path)
+    engine.initialize_distributed()
+    engine.instantiate_pipelines(engine.args.job.global_num_microbatch)
+    engine._train_step()
+    engine._train_step()
+    engine.save_checkpoint()
+    p_before, o_before = engine._collect_layer_state()
+    saved_p = {li: [np.asarray(x, np.float32) for x in jax.tree.leaves(t)]
+               for li, t in p_before.items()}
+    saved_o = {li: [np.asarray(x, np.float32) for x in jax.tree.leaves(t)]
+               for li, t in o_before.items()}
+
+    engine2 = make_engine(num_hosts=4, steps=4, devices=devices8)
+    engine2.args.execution.checkpoint_dir = str(tmp_path)
+    engine2.initialize_distributed()
+    engine2.instantiate_pipelines(engine2.args.job.global_num_microbatch)
+
+    assert engine2.step == 2
+    p_after, o_after = engine2._collect_layer_state()
+    assert set(p_after) == set(saved_p)
+    for li, want in saved_p.items():
+        got = [np.asarray(x, np.float32)
+               for x in jax.tree.leaves(p_after[li])]
+        for g, w in zip(got, want, strict=True):
+            np.testing.assert_allclose(g, w, rtol=1e-6)
+    for li, want in saved_o.items():
+        got = [np.asarray(x, np.float32)
+               for x in jax.tree.leaves(o_after[li])]
+        for g, w in zip(got, want, strict=True):
+            np.testing.assert_allclose(g, w, rtol=1e-6)
+
+    assert np.isfinite(engine2._train_step())
 
 
 def test_live_mirror_roundtrip_bitwise(tmp_path, devices8):

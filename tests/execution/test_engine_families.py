@@ -9,12 +9,18 @@ from tests.execution.test_engine import cache_env, make_engine  # noqa: F401
 
 
 @pytest.mark.parametrize("model_name", [
-    "bert-tiny", "t5-tiny", "vit-tiny", "resnet-tiny", "clip-tiny",
-    "swin-micro",
+    "bert-tiny", "vit-tiny", "resnet-tiny", "clip-tiny",
     # Decoder LMs beyond gpt2 (RoPE/GQA and ALiBi position schemes) ride the
     # slow tier: gpt2-tiny already covers the decoder objective in tier 1.
     pytest.param("llama-tiny", marks=pytest.mark.slow),
     pytest.param("bloom-tiny", marks=pytest.mark.slow),
+    # T5 (the one mid-pipeline batch_layers bridge) and swin (shifted
+    # windows) are the two slowest compiles of the family sweep (t5-tiny
+    # alone ~97 s — a third of the tier-1 overrun); bert/vit keep the
+    # encoder and image objectives in tier 1, so these two ride the slow
+    # tier with the other heavy families.
+    pytest.param("t5-tiny", marks=pytest.mark.slow),
+    pytest.param("swin-micro", marks=pytest.mark.slow),
 ])
 def test_engine_drives_every_family(cache_env, devices8, model_name):
     """The MPMD engine is objective-agnostic (reference pipeline.py:169-216):

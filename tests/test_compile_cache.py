@@ -1,9 +1,11 @@
 """Persistent-compilation-cache policy (utils/compile_cache.py): per-user
 0700 directory keyed by jaxlib version + host CPU signature, env-var
-disable and verbatim override, idempotent JAX wiring."""
+disable and verbatim override, idempotent JAX wiring, and corrupt-entry
+scrubbing (a poisoned entry can wedge execution at deserialize time)."""
 
 import os
 import stat
+import zlib
 
 import pytest
 
@@ -11,6 +13,7 @@ from oobleck_tpu.utils.compile_cache import (
     ensure_persistent_cache,
     host_cpu_signature,
     persistent_cache_dir,
+    scrub_persistent_cache,
 )
 
 
@@ -67,3 +70,42 @@ def test_ensure_persistent_cache_wires_jax_idempotently(monkeypatch, tmp_path):
         assert ensure_persistent_cache() == str(tmp_path / "cc")  # no-op
     finally:
         jax.config.update("jax_compilation_cache_dir", before)
+
+
+def test_scrub_evicts_truncated_entry(tmp_path):
+    """Regression for the PR 2 failure mode: a deliberately truncated
+    compressed entry (what a killed writer leaves) must be deleted; valid
+    and unvalidatable entries must survive."""
+    good = zlib.compress(b"serialized executable " * 64)
+    (tmp_path / "good_entry").write_bytes(good)
+    truncated = zlib.compress(b"poisoned payload " * 256)[:23]
+    (tmp_path / "truncated_entry").write_bytes(truncated)
+    # Unknown format: not provably corrupt -> must be left alone.
+    (tmp_path / "unknown_format").write_bytes(b"\x00\x01not-compressed")
+    # Empty entry: a crash mid-write -> corrupt.
+    (tmp_path / "empty_entry").write_bytes(b"")
+
+    assert scrub_persistent_cache(str(tmp_path), force=True) == 2
+    assert (tmp_path / "good_entry").read_bytes() == good
+    assert (tmp_path / "unknown_format").exists()
+    assert not (tmp_path / "truncated_entry").exists()
+    assert not (tmp_path / "empty_entry").exists()
+
+
+def test_scrub_is_incremental_via_stamp(tmp_path):
+    """Entries older than the stamp are skipped; new corruption is still
+    caught by the next scrub."""
+    (tmp_path / "old_good").write_bytes(zlib.compress(b"x" * 100))
+    assert scrub_persistent_cache(str(tmp_path), force=True) == 0
+    assert (tmp_path / ".oobleck_scrub_stamp").exists()
+    # Stamp must not pattern-match as an entry on the next force scan.
+    bad = zlib.compress(b"poisoned " * 128)[:17]
+    (tmp_path / "new_bad").write_bytes(bad)
+    os.utime(tmp_path / "new_bad")  # strictly newer than the stamp
+    assert scrub_persistent_cache(str(tmp_path)) == 1
+    assert not (tmp_path / "new_bad").exists()
+    assert (tmp_path / "old_good").exists()
+
+
+def test_scrub_missing_dir_is_noop(tmp_path):
+    assert scrub_persistent_cache(str(tmp_path / "nope")) == 0
