@@ -2,7 +2,7 @@
 
 PY ?= python
 
-.PHONY: lint test test-slow tier1 bench ckpt-bench
+.PHONY: lint test test-slow tier1 bench ckpt-bench serve-bench
 
 # Lint via ruff (config in pyproject.toml). Degrades to a skip when ruff
 # is not installed — the hermetic CI image does not ship it, and the gate
@@ -35,3 +35,9 @@ bench:
 # (oobleck_tpu/ckpt/bench.py; also folded into bench.py's "ckpt" key).
 ckpt-bench:
 	JAX_PLATFORMS=cpu $(PY) -m oobleck_tpu.ckpt.bench
+
+# Serving-plane microbench: tokens/sec, TTFT p50/p99, hot-reload pause vs
+# full restore (oobleck_tpu/serve/bench.py; also under bench.py's "serve"
+# key).
+serve-bench:
+	JAX_PLATFORMS=cpu $(PY) -m oobleck_tpu.serve.bench

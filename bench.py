@@ -10,6 +10,9 @@ Budget layout (wall-clock caps, enforced with subprocess timeouts):
   probe   : 60 s, one retry            -> is the TPU relay alive at all?
   measure : 240 s on the real device   -> the actual benchmark
   fallback: 120 s tiny CPU proxy       -> sanity signal when TPU unreachable
+  serve   : 75 s CPU subprocess        -> serving microbench under "serve"
+                                          (never on the TPU relay: its
+                                          multi-threaded dispatch wedges it)
 When the TPU is unreachable the emitted value is the last good TPU
 measurement from BENCH_BASELINE.json (clearly noted), with the CPU proxy's
 number in the note; if even that file is missing, the CPU proxy value is
@@ -282,6 +285,37 @@ def _validate_flash_on_device() -> bool:
         return False
 
 
+SERVE_BENCH_TIMEOUT_S = 75
+
+
+def _serve_summary() -> dict:
+    """Serving-plane microbench (oobleck_tpu/serve/bench.py) in a
+    throwaway CPU subprocess. NEVER in-process on TPU: the serving stack
+    dispatches from several threads (batcher, reload watcher, HTTP), and
+    concurrent dispatch through the axon relay is the documented
+    wedge-the-chip-claim pattern — it hung the round-1-calibrated inner
+    measurement past its 280 s cap when run inline."""
+    env = dict(os.environ)
+    env.update({"JAX_PLATFORMS": "cpu", "PALLAS_AXON_POOL_IPS": "",
+                "OOBLECK_METRICS_DIR": ""})
+    env.pop(_INNER_ENV, None)
+    proc = subprocess.Popen(
+        [sys.executable, "-m", "oobleck_tpu.serve.bench"],
+        stdout=subprocess.PIPE, stderr=subprocess.PIPE, text=True, env=env)
+    try:
+        out, err = proc.communicate(timeout=SERVE_BENCH_TIMEOUT_S)
+    except subprocess.TimeoutExpired:
+        proc.kill()
+        return {"error": f"serve bench hung >{SERVE_BENCH_TIMEOUT_S}s"}
+    if proc.returncode != 0:
+        tail = (err or "").strip().splitlines()[-1:] or ["no stderr"]
+        return {"error": f"serve bench exit {proc.returncode}: {tail[0][:160]}"}
+    try:
+        return json.loads(out.strip())
+    except Exception as exc:  # noqa: BLE001
+        return {"error": f"unparseable serve bench output: {exc}"}
+
+
 def _metrics_sink_summary() -> dict | None:
     """Summary of the OOBLECK_METRICS_DIR JSONL sink, or None when the dir is
     unset/empty. Counters and histograms in the sink are per-process
@@ -339,6 +373,12 @@ def _emit(result: dict) -> None:
             result["metrics_sink"] = sink
     except Exception as exc:  # noqa: BLE001 — emit must never fail
         result["metrics_sink_error"] = f"{type(exc).__name__}: {exc}"
+    # Serving microbench (tokens/sec, TTFT, reload pause vs restore):
+    # CPU subprocess, bounded, best-effort — see _serve_summary.
+    try:
+        result["serve"] = _serve_summary()
+    except Exception as exc:  # noqa: BLE001 — emit must never fail
+        result["serve"] = {"error": f"{type(exc).__name__}: {exc}"}
     print(json.dumps(result))
 
 

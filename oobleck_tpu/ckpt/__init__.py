@@ -29,6 +29,7 @@ from oobleck_tpu.ckpt import manifest, restore, snapshot, writer  # noqa: F401
 from oobleck_tpu.ckpt.restore import (  # noqa: F401
     CheckpointCorrupt,
     complete_step_dirs,
+    load_latest,
     load_step_dir,
     restore_latest,
 )
@@ -90,15 +91,21 @@ class DurableStatePlane:
             meta=self._meta(step, num_iterations_done, epoch, extra))
         return self.writer.submit(snap)
 
-    def restore_latest(self, *, quarantine_bad: bool | None = None
-                       ) -> dict | None:
-        """Newest restorable payload; quarantining defaults to process 0
-        only (one renamer per shared filesystem)."""
+    def load_latest(self, *, quarantine_bad: bool | None = None
+                    ) -> tuple[int, dict] | None:
+        """Newest restorable (step, payload); quarantining defaults to
+        process 0 only (one renamer per shared filesystem). Shared
+        step-selection for the engine restore and the serve loader."""
         self.writer.flush()
         if quarantine_bad is None:
             quarantine_bad = self.writer.process_index == 0
-        return restore.restore_latest(self.root,
-                                      quarantine_bad=quarantine_bad)
+        return restore.load_latest(self.root, quarantine_bad=quarantine_bad)
+
+    def restore_latest(self, *, quarantine_bad: bool | None = None
+                       ) -> dict | None:
+        """Newest restorable payload (load_latest without the step)."""
+        res = self.load_latest(quarantine_bad=quarantine_bad)
+        return None if res is None else res[1]
 
     def flush(self, timeout: float | None = None) -> bool:
         return self.writer.flush(timeout)

@@ -230,13 +230,17 @@ def load_step_dir(d: str | Path) -> dict:
     return _rebuild(values, gm.get("kind", mf.KIND_LAYERS), gm.get("meta", {}))
 
 
-def restore_latest(root: str | Path, *, quarantine_bad: bool = True
-                   ) -> dict | None:
-    """Newest restorable checkpoint payload under root, or None.
+def load_latest(root: str | Path, *, quarantine_bad: bool = False
+                ) -> tuple[int, dict] | None:
+    """Newest complete step -> (step, payload), or None.
 
-    Uncommitted and corrupt step dirs are skipped (and quarantined when
-    `quarantine_bad`); the walk falls back to the next-newest complete
-    step. Call only when no writer is active on this root (startup)."""
+    The single source of truth for "which checkpoint do we load": walks
+    step dirs newest-first, skips torn/corrupt dirs (quarantining them only
+    when `quarantine_bad`), and returns the first that validates. The
+    default is read-only because most callers are not the owner of the
+    root: the serve loader (serve/reload.py) polls a root a live trainer
+    is still writing to and must never rename dirs out from under it —
+    only the trainer's own startup restore may quarantine."""
     root = Path(root)
     for step, d in step_dirs(root):
         if not (d / mf.GLOBAL_MANIFEST).exists():
@@ -254,5 +258,16 @@ def restore_latest(root: str | Path, *, quarantine_bad: bool = True
                 quarantine(root, d, "corrupt")
             continue
         logger.info("restored checkpoint %s (step %d)", d.name, step)
-        return payload
+        return step, payload
     return None
+
+
+def restore_latest(root: str | Path, *, quarantine_bad: bool = True
+                   ) -> dict | None:
+    """Newest restorable checkpoint payload under root, or None.
+
+    Thin wrapper over `load_latest` keeping the trainer-startup contract:
+    uncommitted and corrupt step dirs are quarantined by default (call only
+    when no writer is active on this root)."""
+    res = load_latest(root, quarantine_bad=quarantine_bad)
+    return None if res is None else res[1]

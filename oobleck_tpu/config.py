@@ -182,11 +182,44 @@ class ExecutionArguments:
 
 
 @dataclass
+class ServeArguments:
+    """Elastic serving plane knobs (oobleck_tpu/serve).
+
+    The server consumes the durable-state plane's checkpoint root
+    (`execution.checkpoint_dir` / OOBLECK_CKPT_DIR) and hot-reloads the
+    newest committed step while serving."""
+
+    port: int = 0                 # HTTP port; 0 = ephemeral (tests)
+    slots: int = 4                # continuous-batching decode slots
+    max_seq: int = 256            # KV-cache length per slot (prompt + gen)
+    max_queue: int = 64           # bounded admission queue; full -> reject
+    reload_secs: float = 5.0      # checkpoint-watcher poll period
+    max_tokens_default: int = 64  # per-request cap when unspecified
+
+    def apply_serve_env_overrides(self) -> None:
+        """Deployment-property overrides, same contract as the durable
+        plane's: OOBLECK_SERVE_PORT, OOBLECK_SERVE_SLOTS,
+        OOBLECK_SERVE_RELOAD_SECS are settable without editing job yaml."""
+        import os
+
+        v = os.environ.get("OOBLECK_SERVE_PORT")
+        if v:
+            self.port = int(v)
+        v = os.environ.get("OOBLECK_SERVE_SLOTS")
+        if v:
+            self.slots = int(v)
+        v = os.environ.get("OOBLECK_SERVE_RELOAD_SECS")
+        if v:
+            self.reload_secs = float(v)
+
+
+@dataclass
 class OobleckArguments:
     dist: DistributedArguments = field(default_factory=DistributedArguments)
     job: JobArguments = field(default_factory=JobArguments)
     model: ModelArguments = field(default_factory=ModelArguments)
     execution: ExecutionArguments = field(default_factory=ExecutionArguments)
+    serve: ServeArguments = field(default_factory=ServeArguments)
 
     # ---- plain-dict serialization (wire + yaml) ----
 
@@ -200,6 +233,7 @@ class OobleckArguments:
             job=JobArguments(**d.get("job", {})),
             model=ModelArguments(**d.get("model", {})),
             execution=ExecutionArguments(**d.get("execution", {})),
+            serve=ServeArguments(**d.get("serve", {})),
         )
 
     @classmethod

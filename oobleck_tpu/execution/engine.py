@@ -1735,13 +1735,13 @@ class OobleckEngine:
         plane = self._durable_plane()
         if plane is None:
             return None
-        payload = plane.restore_latest()
-        if payload is None:
+        res = plane.load_latest()  # shared step-selection (ckpt/restore.py)
+        if res is None:
             return None
+        step, payload = res
         if payload.get("kind") == "fused_stacked":
             payload = self._layerize_stacked(payload)
         from oobleck_tpu.ckpt import manifest as _mf
-        step = payload["meta"]["step"]
         logger.info("restoring from durable checkpoint %s (step %s)",
                     _mf.step_dir_name(step), step)
         return payload

@@ -273,8 +273,11 @@ class GPTModel:
 
     def apply_block(self, p, x: jax.Array, ctx: ShardCtx | None = None) -> jax.Array:
         x = self.attention_sublayer(p, x, ctx)
+        return self.mlp_sublayer(p, x, ctx)
 
-        # --- mlp ---
+    def mlp_sublayer(self, p, x: jax.Array, ctx: ShardCtx | None = None) -> jax.Array:
+        """ln2 -> gelu MLP -> residual. Shape-agnostic over leading dims:
+        the decode path calls it on [B, E] single-token activations."""
         c = self.config
         dt = c.dtype
         t = ctx.tensor if ctx else None
@@ -288,9 +291,12 @@ class GPTModel:
         return x + out
 
     def attention_sublayer(self, p, x: jax.Array,
-                           ctx: ShardCtx | None = None) -> jax.Array:
+                           ctx: ShardCtx | None = None, *,
+                           return_kv: bool = False):
         """ln1 -> attention (impl dispatch, ALiBi, TP/SP aware) -> residual.
-        Split out of apply_block so MoE variants swap only the MLP half."""
+        Split out of apply_block so MoE variants swap only the MLP half.
+        `return_kv=True` (prefill) also returns this layer's K/V [B, H, S, D]
+        for the serving KV cache."""
         c = self.config
         dt = c.dtype
         t = ctx.tensor if ctx else None
@@ -347,6 +353,8 @@ class GPTModel:
         wo = _maybe_unshard(p["attn"]["wo"], f_, 2).astype(dt)          # [Hl,D,E]
         out = jnp.einsum("bhsd,hde->bse", attn_out, wo)
         out = _maybe_reduce_from_tp(out, t) + p["attn"]["bo"].astype(dt)
+        if return_kv:
+            return x + out, qkv[1], qkv[2]
         return x + out
 
     def head(self, p, x: jax.Array, ctx: ShardCtx | None = None) -> jax.Array:
@@ -395,6 +403,84 @@ class GPTModel:
 
     def loss(self, params, batch) -> jax.Array:
         return self.loss_from_logits(self.forward(params, batch["input_ids"]), batch)
+
+    # ------------------------------------------------------------------ #
+    # incremental decode (serving)                                        #
+    # ------------------------------------------------------------------ #
+
+    def init_kv_cache(self, batch_size: int, max_seq: int, dtype: Any = None):
+        """Preallocated per-layer KV cache, stacked [L, B, H, S, D] (compute
+        dtype, bf16 by default) so decode scans blocks and cache slices
+        together. `batch_size` is the number of continuous-batching slots."""
+        c = self.config
+        shape = (c.num_layers, batch_size, c.num_heads, max_seq, c.head_dim)
+        dt = c.dtype if dtype is None else dtype
+        return {"k": jnp.zeros(shape, dt), "v": jnp.zeros(shape, dt)}
+
+    def _decode_attention_sublayer(self, p, x, k_cache, v_cache, pos):
+        """attention_sublayer for ONE new token per slot against the KV
+        cache. x [B, E]; k_cache/v_cache [B, H, S, D]; pos [B]."""
+        c = self.config
+        dt = c.dtype
+        from oobleck_tpu.ops.attention import (
+            alibi_slopes, cache_write, decode_attention)
+
+        h = _layer_norm(x, p["ln1"]["scale"], p["ln1"]["bias"], c.layer_norm_epsilon)
+        wqkv = p["attn"]["wqkv"].astype(dt)                             # [E,3,H,D]
+        qkv = jnp.einsum("be,ethd->tbhd", h, wqkv) + p["attn"]["bqkv"].astype(dt)[:, None]
+        k_cache = cache_write(k_cache, qkv[1], pos)
+        v_cache = cache_write(v_cache, qkv[2], pos)
+        slopes = alibi_slopes(c.num_heads) if c.position_embedding == "alibi" else None
+        attn = decode_attention(qkv[0], k_cache, v_cache, pos, alibi_slopes=slopes)
+        out = jnp.einsum("bhd,hde->be", attn, p["attn"]["wo"].astype(dt))
+        out = out + p["attn"]["bo"].astype(dt)
+        return x + out, k_cache, v_cache
+
+    def forward_prefill(self, params, tokens: jax.Array, kv_cache,
+                        slot: jax.Array, length: jax.Array):
+        """Prompt pass for ONE request: training-mode block math over
+        tokens [1, T] (T may be padded past the live `length`), writing each
+        layer's K/V into batch slot `slot` of the cache. Returns (next-token
+        logits [V] f32 taken at position length-1, updated cache). Padded
+        positions land in the cache but are never attended: prefill is
+        causal and decode masks k_idx <= pos, and every decode step
+        overwrites its own position before reading it."""
+        x = self.embed(params["embed"], tokens)
+
+        def body(x, bp):
+            x, k, v = self.attention_sublayer(bp, x, return_kv=True)
+            return self.mlp_sublayer(bp, x), (k, v)
+
+        x, (ks, vs) = lax.scan(body, x, params["blocks"])
+        # ks/vs [L, 1, H, T, D]: one slice-write into slot `slot`.
+        k_cache = lax.dynamic_update_slice(
+            kv_cache["k"], ks.astype(kv_cache["k"].dtype), (0, slot, 0, 0, 0))
+        v_cache = lax.dynamic_update_slice(
+            kv_cache["v"], vs.astype(kv_cache["v"].dtype), (0, slot, 0, 0, 0))
+        logits = self.head(params["head"], x)[0, length - 1]
+        return logits, {"k": k_cache, "v": v_cache}
+
+    def forward_decode(self, params, token: jax.Array, kv_cache, pos: jax.Array):
+        """One decode step for a batch of slots: token [B] (each slot's
+        previous token), pos [B] (its position), cache from init_kv_cache.
+        Returns (logits [B, V] f32, updated cache). Inactive slots decode
+        garbage harmlessly — their slot is rewritten by the next prefill."""
+        c = self.config
+        pe = params["embed"]
+        x = pe["wte"][token]
+        if c.position_embedding == "learned":
+            x = x + pe["wpe"][pos]
+        x = x.astype(c.dtype)
+
+        def body(x, sl):
+            bp, kc, vc = sl
+            x, kc, vc = self._decode_attention_sublayer(bp, x, kc, vc, pos)
+            return self.mlp_sublayer(bp, x), (kc, vc)
+
+        x, (k_new, v_new) = lax.scan(
+            body, x, (params["blocks"], kv_cache["k"], kv_cache["v"]))
+        logits = self.head(params["head"], x[:, None, :])[:, 0]
+        return logits, {"k": k_new, "v": v_new}
 
     # ------------------------------------------------------------------ #
     # sharding + gradient-reduction rules                                 #

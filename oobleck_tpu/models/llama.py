@@ -103,6 +103,20 @@ def _rope(x: jax.Array, positions: jax.Array, theta: float) -> jax.Array:
     return jnp.stack([out1, out2], axis=-1).reshape(x.shape).astype(x.dtype)
 
 
+def _rope_one(x: jax.Array, pos: jax.Array, theta: float) -> jax.Array:
+    """Rotary embedding for one token per batch row (decode step).
+    x: [B, H, D]; pos: [B] — the same phases `_rope` applies at these
+    absolute positions, so cache entries and decode queries agree."""
+    d = x.shape[-1]
+    freqs = 1.0 / (theta ** (jnp.arange(0, d, 2, dtype=jnp.float32) / d))
+    angles = pos[:, None].astype(jnp.float32) * freqs[None, :]  # [B, D/2]
+    cos, sin = jnp.cos(angles)[:, None, :], jnp.sin(angles)[:, None, :]
+    x1, x2 = x[..., 0::2], x[..., 1::2]
+    out1 = x1 * cos - x2 * sin
+    out2 = x2 * cos + x1 * sin
+    return jnp.stack([out1, out2], axis=-1).reshape(x.shape).astype(x.dtype)
+
+
 def _maybe(fn, x, axis, *a):
     return fn(x, axis, *a) if axis else x
 
@@ -223,6 +237,14 @@ class LlamaModel:
         return jnp.arange(s_local)
 
     def apply_block(self, p, x, ctx: ShardCtx | None = None):
+        x = self.attention_sublayer(p, x, ctx)
+        return self.mlp_sublayer(p, x, ctx)
+
+    def attention_sublayer(self, p, x, ctx: ShardCtx | None = None, *,
+                           return_kv: bool = False):
+        """ln1 -> RoPE attention (GQA, SP aware) -> residual. `return_kv=True`
+        (prefill) also returns this layer's post-RoPE, pre-repeat K/V
+        [B, KV, S, D] — the form the serving cache stores."""
         c = self.config
         dt = c.dtype
         t = ctx.tensor if ctx else None
@@ -240,6 +262,7 @@ class LlamaModel:
         k, v = kv[0], kv[1]
         q = _rope(q, pos, c.rope_theta)
         k = _rope(k, pos, c.rope_theta)
+        cached_k, cached_v = k, v
         if c.kv_heads != c.num_heads:
             rep = c.num_heads // c.kv_heads
             k = jnp.repeat(k, rep, axis=1)
@@ -252,8 +275,18 @@ class LlamaModel:
             attn = causal_attention(q, k, v, impl=c.attention_impl)
         wo = _maybe(unshard_fsdp, p["attn"]["wo"], f_, 2).astype(dt)      # [Hl,D,E]
         out = jnp.einsum("bhsd,hde->bse", attn, wo)
-        x = x + _maybe(reduce_from_tp, out, t)
+        y = x + _maybe(reduce_from_tp, out, t)
+        if return_kv:
+            return y, cached_k, cached_v
+        return y
 
+    def mlp_sublayer(self, p, x, ctx: ShardCtx | None = None):
+        """ln2 -> SwiGLU -> residual. Shape-agnostic over leading dims: the
+        decode path calls it on [B, E] single-token activations."""
+        c = self.config
+        dt = c.dtype
+        t = ctx.tensor if ctx else None
+        f_ = ctx.fsdp if ctx else None
         h = _rms_norm(x, p["ln2"]["scale"], c.rms_norm_eps)
         wg = _maybe(unshard_fsdp, p["mlp"]["wg"], f_, 0).astype(dt)
         wu = _maybe(unshard_fsdp, p["mlp"]["wu"], f_, 0).astype(dt)
@@ -299,6 +332,68 @@ class LlamaModel:
 
     def loss(self, params, batch):
         return self.loss_from_logits(self.forward(params, batch["input_ids"]), batch)
+
+    # ---- incremental decode (serving) ----
+
+    def init_kv_cache(self, batch_size: int, max_seq: int, dtype=None):
+        """Preallocated KV cache [L, B, KV, S, D] — unrepeated KV heads;
+        decode folds query heads into groups against it (GQA caches 1/rep
+        the bytes of the repeated form)."""
+        c = self.config
+        shape = (c.num_layers, batch_size, c.kv_heads, max_seq, c.head_dim)
+        dt = c.dtype if dtype is None else dtype
+        return {"k": jnp.zeros(shape, dt), "v": jnp.zeros(shape, dt)}
+
+    def _decode_attention_sublayer(self, p, x, k_cache, v_cache, pos):
+        """attention_sublayer for ONE new token per slot against the KV
+        cache. x [B, E]; k_cache/v_cache [B, KV, S, D]; pos [B]."""
+        c = self.config
+        dt = c.dtype
+        from oobleck_tpu.ops.attention import cache_write, decode_attention
+
+        h = _rms_norm(x, p["ln1"]["scale"], c.rms_norm_eps)
+        q = jnp.einsum("be,ehd->bhd", h, p["attn"]["wq"].astype(dt))
+        kv = jnp.einsum("be,ekhd->kbhd", h, p["attn"]["wkv"].astype(dt))
+        q = _rope_one(q, pos, c.rope_theta)
+        k = _rope_one(kv[0], pos, c.rope_theta)
+        k_cache = cache_write(k_cache, k, pos)
+        v_cache = cache_write(v_cache, kv[1], pos)
+        attn = decode_attention(q, k_cache, v_cache, pos)  # GQA folded inside
+        out = jnp.einsum("bhd,hde->be", attn, p["attn"]["wo"].astype(dt))
+        return x + out, k_cache, v_cache
+
+    def forward_prefill(self, params, tokens, kv_cache, slot, length):
+        """Prompt pass for ONE request into batch slot `slot`; same contract
+        as GPTModel.forward_prefill (tokens [1, T] possibly padded past
+        `length`; returns next-token logits [V] f32 + updated cache)."""
+        x = self.embed(params["embed"], tokens)
+
+        def body(x, bp):
+            x, k, v = self.attention_sublayer(bp, x, return_kv=True)
+            return self.mlp_sublayer(bp, x), (k, v)
+
+        x, (ks, vs) = jax.lax.scan(body, x, params["blocks"])
+        k_cache = lax.dynamic_update_slice(
+            kv_cache["k"], ks.astype(kv_cache["k"].dtype), (0, slot, 0, 0, 0))
+        v_cache = lax.dynamic_update_slice(
+            kv_cache["v"], vs.astype(kv_cache["v"].dtype), (0, slot, 0, 0, 0))
+        logits = self.head(params["head"], x)[0, length - 1]
+        return logits, {"k": k_cache, "v": v_cache}
+
+    def forward_decode(self, params, token, kv_cache, pos):
+        """One decode step over all slots; same contract as
+        GPTModel.forward_decode (token [B], pos [B] -> logits [B, V] f32)."""
+        x = params["embed"]["wte"][token].astype(self.config.dtype)
+
+        def body(x, sl):
+            bp, kc, vc = sl
+            x, kc, vc = self._decode_attention_sublayer(bp, x, kc, vc, pos)
+            return self.mlp_sublayer(bp, x), (kc, vc)
+
+        x, (k_new, v_new) = jax.lax.scan(
+            body, x, (params["blocks"], kv_cache["k"], kv_cache["v"]))
+        logits = self.head(params["head"], x[:, None, :])[:, 0]
+        return logits, {"k": k_new, "v": v_new}
 
     # ---- sharding ----
 

@@ -95,6 +95,51 @@ def alibi_bias(num_heads: int, seq_q: int, seq_k: int,
                                   causal=causal)
 
 
+# -- KV-cache decode path (serving) ------------------------------------- #
+
+def cache_write(cache: jax.Array, new: jax.Array, pos: jax.Array) -> jax.Array:
+    """Write one token's K or V into a slot cache at per-slot positions.
+
+    cache [B, H, S, D]; new [B, H, D]; pos [B] int32 (each batch slot in a
+    continuous batch sits at its own sequence position). Returns the updated
+    cache; safe to donate — every write is a dynamic_update_slice."""
+    def one(c, n, p):
+        return jax.lax.dynamic_update_slice(
+            c, n[:, None, :].astype(c.dtype), (0, p, 0))
+
+    return jax.vmap(one)(cache, new, pos)
+
+
+def decode_attention(
+    q: jax.Array, k_cache: jax.Array, v_cache: jax.Array, pos: jax.Array, *,
+    scale: float | None = None, alibi_slopes: jax.Array | None = None,
+) -> jax.Array:
+    """Single-token attention against a preallocated KV cache.
+
+    q [B, Hq, D]; k_cache/v_cache [B, Hkv, S, D]; pos [B] is each slot's
+    current position — keys at indices <= pos are live, later indices hold
+    stale/garbage bytes from freed slots and are masked. Grouped-query
+    caches (Hkv < Hq) fold query heads into [Hkv, G] groups against the
+    unrepeated cache instead of materializing repeated K/V per step.
+    Returns [B, Hq, D]."""
+    b, hq, d = q.shape
+    hkv, s = k_cache.shape[1], k_cache.shape[2]
+    if scale is None:
+        scale = d**-0.5
+    g = hq // hkv
+    qg = q.reshape(b, hkv, g, d)
+    logits = jnp.einsum("bkgd,bksd->bkgs", qg, k_cache) * scale
+    k_idx = jnp.arange(s)
+    if alibi_slopes is not None:
+        dist = (pos[:, None] - k_idx[None, :]).astype(jnp.float32)  # [B, S]
+        slopes = alibi_slopes.reshape(hkv, g)
+        logits = logits - slopes[None, :, :, None] * dist[:, None, None, :]
+    live = k_idx[None, :] <= pos[:, None]                           # [B, S]
+    logits = jnp.where(live[:, None, None, :], logits, NEG_INF)
+    probs = jax.nn.softmax(logits.astype(jnp.float32), axis=-1).astype(q.dtype)
+    return jnp.einsum("bkgs,bksd->bkgd", probs, v_cache).reshape(b, hq, d)
+
+
 @functools.cache
 def select_attention_impl(impl: str = "auto"):
     """Resolve an attention implementation name to a callable.

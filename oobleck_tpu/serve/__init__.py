@@ -1,0 +1,134 @@
+"""Elastic serving plane (L6): continuous-batching inference over the
+durable-state plane's checkpoints.
+
+The training side of this repo survives faults by reconfiguring instead
+of restarting; this package extends the same posture to inference: a
+server bound to a live training job's checkpoint root
+(`OOBLECK_CKPT_DIR`) hot-reloads the newest committed step while
+serving, without dropping in-flight requests.
+
+    engine.py    DecodeEngine — KV cache + jitted prefill/decode
+                 (persistent-compile-cache routed, cache donated)
+    batcher.py   ContinuousBatcher — bounded admission queue, slot
+                 scheduling between decode steps, backpressure
+    reload.py    CheckpointWatcher — poll committed steps, stage off the
+                 decode path, swap at a decode-step barrier
+    server.py    stdlib HTTP: POST /v1/generate, GET /healthz, /metrics
+    bench.py     tokens/sec, TTFT and reload-pause percentiles
+
+`ServingPlane` wires the four together over one checkpoint root.
+"""
+
+from __future__ import annotations
+
+import logging
+import time
+
+from oobleck_tpu.config import ServeArguments
+from oobleck_tpu.serve.batcher import ContinuousBatcher, GenRequest, QueueFull
+from oobleck_tpu.serve.engine import DecodeEngine
+from oobleck_tpu.serve.reload import (
+    CheckpointWatcher,
+    load_latest_params,
+    params_from_payload,
+    publish_params,
+)
+from oobleck_tpu.serve.server import ServeHTTPServer
+
+__all__ = [
+    "CheckpointWatcher", "ContinuousBatcher", "DecodeEngine", "GenRequest",
+    "QueueFull", "ServeArguments", "ServeHTTPServer", "ServingPlane",
+    "load_latest_params", "params_from_payload", "publish_params",
+]
+
+logger = logging.getLogger("oobleck.serve")
+
+
+class ServingPlane:
+    """One process's serving stack over one checkpoint root.
+
+    start() blocks until a committed checkpoint exists (a server may come
+    up before its training job's first save), loads it, warms the decode
+    programs, and starts batcher + reload watcher + HTTP server."""
+
+    def __init__(self, root, *, model=None, model_name: str | None = None,
+                 model_args: dict | None = None,
+                 args: ServeArguments | None = None,
+                 wait_secs: float = 60.0, ip: str | None = None):
+        self.root = root
+        self.model = model
+        self.model_name = model_name
+        self.model_args = model_args
+        self.args = args or ServeArguments()
+        self.args.apply_serve_env_overrides()
+        self.wait_secs = wait_secs
+        self.ip = ip
+        self.engine: DecodeEngine | None = None
+        self.batcher: ContinuousBatcher | None = None
+        self.watcher: CheckpointWatcher | None = None
+        self.server: ServeHTTPServer | None = None
+
+    def _wait_for_checkpoint(self):
+        from oobleck_tpu.ckpt import restore
+
+        deadline = time.monotonic() + self.wait_secs
+        while True:
+            res = restore.load_latest(self.root, quarantine_bad=False)
+            if res is not None:
+                return res
+            if time.monotonic() >= deadline:
+                raise TimeoutError(
+                    f"no committed checkpoint under {self.root} after "
+                    f"{self.wait_secs}s")
+            time.sleep(0.2)
+
+    def _resolve_model(self, payload: dict):
+        if self.model is not None:
+            return self.model
+        meta = payload.get("meta", {})
+        name = self.model_name or meta.get("model_name")
+        if not name:
+            raise ValueError(
+                "no model: pass model/model_name or checkpoint meta must "
+                "carry model_name")
+        margs = dict(meta.get("model_args") or {})
+        margs.update(self.model_args or {})
+        from oobleck_tpu.models import build_model
+
+        return build_model(name, margs)
+
+    def start(self) -> "ServingPlane":
+        step, payload = self._wait_for_checkpoint()
+        model = self._resolve_model(payload)
+        max_seq = min(self.args.max_seq,
+                      model.config.max_position_embeddings)
+        if max_seq != self.args.max_seq:
+            logger.info("clamping max_seq %d -> model max positions %d",
+                        self.args.max_seq, max_seq)
+        self.engine = DecodeEngine(model, slots=self.args.slots,
+                                   max_seq=max_seq)
+        self.engine.set_params(
+            self.engine.stage_params(params_from_payload(model, payload)),
+            step)
+        self.engine.warmup()
+        self.batcher = ContinuousBatcher(
+            self.engine, max_queue=self.args.max_queue,
+            default_max_tokens=self.args.max_tokens_default).start()
+        self.watcher = CheckpointWatcher(
+            self.root, model, self.engine, self.batcher,
+            poll_secs=self.args.reload_secs, current_step=step,
+            ip=self.ip).start()
+        self.server = ServeHTTPServer(self.batcher,
+                                      port=self.args.port).start()
+        logger.info("serving plane up: step %d, %d slots, max_seq %d, "
+                    "port %d", step, self.args.slots, max_seq,
+                    self.server.port)
+        return self
+
+    def stop(self) -> None:
+        if self.server is not None:
+            self.server.close()
+        if self.watcher is not None:
+            self.watcher.stop()
+        if self.batcher is not None:
+            self.batcher.stop()

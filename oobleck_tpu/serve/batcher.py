@@ -1,0 +1,281 @@
+"""Continuous batcher: slot scheduling, admission control, weight swaps.
+
+One scheduler thread owns the DecodeEngine. Each loop iteration:
+
+  1. applies a pending weight swap (the decode-step barrier for
+     zero-downtime hot-reload: in-flight requests keep their slots and
+     KV state, nothing is dropped);
+  2. admits queued requests into free slots (one prefill each);
+  3. runs ONE decode step over all slots and feeds each active slot its
+     sampled token.
+
+Admission is a bounded queue — when it is full `submit` rejects
+immediately (backpressure to the client as HTTP 429) instead of
+buffering unboundedly. Each request carries `max_tokens` and an optional
+wall-clock deadline; deadline-expired requests finish with what they
+have rather than starving the batch.
+"""
+
+from __future__ import annotations
+
+import logging
+import queue
+import threading
+import time
+
+import numpy as np
+
+from oobleck_tpu.utils import metrics
+from oobleck_tpu.utils.metrics import SERVE_LATENCY_BUCKETS
+
+logger = logging.getLogger("oobleck.serve")
+
+
+class QueueFull(Exception):
+    """Admission queue at capacity; the client should back off (429)."""
+
+
+class GenRequest:
+    """One generation request's lifecycle state."""
+
+    _ids = iter(range(1 << 62))
+
+    def __init__(self, tokens: list[int], *, max_tokens: int,
+                 temperature: float = 0.0, deadline_s: float | None = None,
+                 eos_token: int | None = None):
+        self.id = next(self._ids)
+        self.tokens = list(tokens)
+        self.max_tokens = int(max_tokens)
+        self.temperature = float(temperature)
+        self.submitted = time.monotonic()
+        self.deadline = (self.submitted + deadline_s) if deadline_s else None
+        self.eos_token = eos_token
+        self.out_tokens: list[int] = []
+        self.finish_reason: str | None = None
+        self.step = -1          # weights step that served the request
+        self.ttft_s: float | None = None
+        self.total_s: float | None = None
+        self.done = threading.Event()
+
+    def expired(self, now: float) -> bool:
+        return self.deadline is not None and now > self.deadline
+
+    def wait(self, timeout: float | None = None) -> bool:
+        return self.done.wait(timeout)
+
+
+class ContinuousBatcher:
+    """Bounded-queue continuous batching over a DecodeEngine's slots."""
+
+    def __init__(self, engine, *, max_queue: int = 64,
+                 default_max_tokens: int = 64, idle_sleep: float = 0.002,
+                 seed: int = 0):
+        self.engine = engine
+        self.default_max_tokens = default_max_tokens
+        self._queue: queue.Queue[GenRequest] = queue.Queue(maxsize=max_queue)
+        self._rng = np.random.default_rng(seed)
+        self._slots: list[GenRequest | None] = [None] * engine.slots
+        self._token = np.zeros(engine.slots, np.int32)
+        self._pos = np.zeros(engine.slots, np.int32)
+        self._idle_sleep = idle_sleep
+        self._pending_swap: tuple[int, object] | None = None
+        self._swap_lock = threading.Lock()
+        self._stop = threading.Event()
+        self._thread = threading.Thread(
+            target=self._run, name="oobleck-serve-batcher", daemon=True)
+        self._tok_window = (time.monotonic(), 0)
+
+        reg = metrics.registry()
+        self.m_ttft = reg.histogram(
+            "oobleck_serve_ttft_seconds",
+            "Time from request admission queue to first generated token",
+            buckets=SERVE_LATENCY_BUCKETS)
+        self.m_step = reg.histogram(
+            "oobleck_serve_token_latency_seconds",
+            "Per-decode-step latency (one token per active slot)",
+            buckets=SERVE_LATENCY_BUCKETS)
+        self.m_reload_pause = reg.histogram(
+            "oobleck_serve_reload_pause_seconds",
+            "Decode-loop pause taken to swap weights at a hot-reload",
+            buckets=SERVE_LATENCY_BUCKETS)
+        self.m_queue = reg.gauge(
+            "oobleck_serve_queue_depth", "Requests waiting for a slot")
+        self.m_active = reg.gauge(
+            "oobleck_serve_slots_active", "Decode slots currently generating")
+        self.m_tps = reg.gauge(
+            "oobleck_serve_tokens_per_sec", "Generated tokens/sec (rolling)")
+        self.m_tokens = reg.counter(
+            "oobleck_serve_tokens_total", "Generated tokens")
+        self.m_requests = reg.counter(
+            "oobleck_serve_requests_total", "Requests by outcome")
+        self.m_reloads = reg.counter(
+            "oobleck_serve_reloads_total", "Completed weight hot-reloads")
+
+    # -- client side ----------------------------------------------------- #
+
+    def submit(self, req: GenRequest) -> GenRequest:
+        """Enqueue or reject-now (bounded queue = backpressure)."""
+        try:
+            self._queue.put_nowait(req)
+        except queue.Full:
+            self.m_requests.inc(outcome="rejected")
+            raise QueueFull(
+                f"admission queue full ({self._queue.maxsize})") from None
+        self.m_queue.set(self._queue.qsize())
+        return req
+
+    def post_swap(self, step: int, device_params) -> None:
+        """Stage a weight swap; the scheduler applies it between decode
+        steps. A newer pending swap supersedes an unapplied older one."""
+        with self._swap_lock:
+            if self._pending_swap is None or step > self._pending_swap[0]:
+                self._pending_swap = (step, device_params)
+
+    # -- lifecycle ------------------------------------------------------- #
+
+    def start(self) -> "ContinuousBatcher":
+        self._thread.start()
+        return self
+
+    def stop(self, timeout: float = 30.0) -> None:
+        self._stop.set()
+        if self._thread.is_alive():
+            self._thread.join(timeout)
+        for i, req in enumerate(self._slots):
+            if req is not None:
+                self._finish(req, "shutdown")
+                self._slots[i] = None
+        while True:
+            try:
+                self._finish(self._queue.get_nowait(), "shutdown")
+            except queue.Empty:
+                break
+
+    @property
+    def slots_active(self) -> int:
+        return sum(1 for s in self._slots if s is not None)
+
+    @property
+    def queue_depth(self) -> int:
+        return self._queue.qsize()
+
+    # -- scheduler ------------------------------------------------------- #
+
+    def _finish(self, req: GenRequest, reason: str) -> None:
+        req.finish_reason = reason
+        req.step = self.engine.params_step
+        req.total_s = time.monotonic() - req.submitted
+        self.m_requests.inc(outcome=reason)
+        req.done.set()
+
+    def _sample(self, logits_row: np.ndarray, temperature: float) -> int:
+        if temperature <= 0.0:
+            return int(np.argmax(logits_row))
+        z = logits_row.astype(np.float64) / temperature
+        z -= z.max()
+        p = np.exp(z)
+        p /= p.sum()
+        return int(self._rng.choice(len(p), p=p))
+
+    def _emit(self, req: GenRequest, token: int, now: float) -> bool:
+        """Record one generated token; True when the request is finished."""
+        req.out_tokens.append(token)
+        self.m_tokens.inc()
+        if req.ttft_s is None:
+            req.ttft_s = now - req.submitted
+            self.m_ttft.observe(req.ttft_s)
+        if req.eos_token is not None and token == req.eos_token:
+            self._finish(req, "eos")
+            return True
+        if len(req.out_tokens) >= req.max_tokens:
+            self._finish(req, "length")
+            return True
+        if req.expired(now):
+            self._finish(req, "deadline")
+            return True
+        return False
+
+    def _maybe_swap(self) -> None:
+        with self._swap_lock:
+            pending, self._pending_swap = self._pending_swap, None
+        if pending is None:
+            return
+        step, params = pending
+        t0 = time.perf_counter()
+        self.engine.set_params(params, step)
+        pause = time.perf_counter() - t0
+        self.m_reloads.inc()
+        self.m_reload_pause.observe(pause)
+        metrics.flight_recorder().record(
+            "serve_reload", step=step, pause_s=pause,
+            slots_active=self.slots_active)
+        logger.info("hot-reloaded weights to step %d (pause %.6fs, "
+                    "%d requests in flight)", step, pause, self.slots_active)
+
+    def _admit(self) -> None:
+        for i in range(len(self._slots)):
+            if self._slots[i] is not None:
+                continue
+            try:
+                req = self._queue.get_nowait()
+            except queue.Empty:
+                break
+            now = time.monotonic()
+            n = len(req.tokens)
+            if n == 0 or self.engine.bucket_for(n) is None \
+                    or n + req.max_tokens > self.engine.max_seq:
+                self._finish(req, "too_long")
+                continue
+            if req.expired(now):
+                self._finish(req, "deadline")
+                continue
+            logits = self.engine.prefill(req.tokens, i)
+            now = time.monotonic()
+            token = self._sample(logits, req.temperature)
+            if not self._emit(req, token, now):
+                self._slots[i] = req
+                self._token[i] = token
+                self._pos[i] = n
+
+    def _decode_step(self) -> None:
+        t0 = time.perf_counter()
+        logits = self.engine.decode(self._token, self._pos)
+        self.m_step.observe(time.perf_counter() - t0)
+        now = time.monotonic()
+        for i, req in enumerate(self._slots):
+            if req is None:
+                continue
+            token = self._sample(logits[i], req.temperature)
+            self._pos[i] += 1
+            self._token[i] = token
+            if self._emit(req, token, now):
+                self._slots[i] = None
+
+    def _update_gauges(self) -> None:
+        self.m_queue.set(self._queue.qsize())
+        self.m_active.set(self.slots_active)
+        t_last, n_last = self._tok_window
+        now = time.monotonic()
+        if now - t_last >= 1.0:
+            n = self.m_tokens.value()
+            self.m_tps.set((n - n_last) / (now - t_last))
+            self._tok_window = (now, n)
+
+    def _run(self) -> None:
+        while not self._stop.is_set():
+            try:
+                self._maybe_swap()
+                self._admit()
+                if self.slots_active:
+                    self._decode_step()
+                else:
+                    time.sleep(self._idle_sleep)
+                self._update_gauges()
+            except Exception:
+                # A scheduler death would hang every waiting client; fail
+                # the in-flight requests and keep serving.
+                logger.exception("batcher iteration failed")
+                for i, req in enumerate(self._slots):
+                    if req is not None:
+                        self._finish(req, "error")
+                        self._slots[i] = None

@@ -1,0 +1,131 @@
+"""Serving microbenchmark: tokens/sec, TTFT, and hot-reload pause.
+
+Stands up the full serving plane (checkpoint root -> DecodeEngine ->
+batcher -> HTTP) on a tiny model, drives concurrent /v1/generate
+requests, triggers one hot-reload mid-traffic, and reports:
+
+  * tokens/sec and TTFT p50/p99 from the registry histograms,
+  * reload pause p99 (the decode-loop stall taken to swap weights)
+    against a full checkpoint-restore latency — the zero-downtime claim
+    is that the pause is the pointer swap, not the restore.
+
+Standalone:  python -m oobleck_tpu.serve.bench
+Embedded:    bench.py folds the result under its "serve" key.
+"""
+
+from __future__ import annotations
+
+import json
+import shutil
+import tempfile
+import threading
+import time
+
+import jax
+
+from oobleck_tpu.utils import metrics
+
+
+def _percentiles(hist, q50=0.5, q99=0.99) -> dict:
+    series = hist.series()
+    merged = metrics.merge_histogram_series(series)
+    if not merged:
+        return {"p50": None, "p99": None}
+    return {
+        "p50": round(metrics.histogram_percentile(merged, q50) or 0.0, 6),
+        "p99": round(metrics.histogram_percentile(merged, q99) or 0.0, 6),
+    }
+
+
+def measure_serve(root: str | None = None, *, model_name: str = "gpt2-tiny",
+                  slots: int = 2, max_seq: int = 64, requests: int = 8,
+                  gen_tokens: int = 12) -> dict:
+    """End-to-end serve numbers on a tiny model (CPU-friendly)."""
+    import http.client
+
+    from oobleck_tpu.models import build_model
+    from oobleck_tpu.serve import (
+        ServeArguments,
+        ServingPlane,
+        load_latest_params,
+        publish_params,
+    )
+
+    tmp = root or tempfile.mkdtemp(prefix="oobleck_serve_bench_")
+    plane = None
+    try:
+        model = build_model(model_name, {"num_layers": 2})
+        params = model.init_params(jax.random.PRNGKey(0))
+        publish_params(tmp, model, params, step=1, model_name=model_name)
+
+        # The comparison baseline: one full restore (validate + assemble)
+        # of the same checkpoint — what a swap WOULD cost if the server
+        # reloaded synchronously on the decode path.
+        t0 = time.perf_counter()
+        load_latest_params(tmp, model)
+        restore_s = time.perf_counter() - t0
+
+        plane = ServingPlane(
+            tmp, model=model,
+            args=ServeArguments(port=0, slots=slots, max_seq=max_seq,
+                                reload_secs=0.1)).start()
+        port = plane.server.port
+
+        def one_request(prompt_len: int) -> int:
+            conn = http.client.HTTPConnection("127.0.0.1", port, timeout=60)
+            body = json.dumps({
+                "tokens": list(range(1, prompt_len + 1)),
+                "max_tokens": gen_tokens,
+            })
+            conn.request("POST", "/v1/generate", body,
+                         {"Content-Type": "application/json"})
+            resp = conn.getresponse()
+            out = json.loads(resp.read())
+            conn.close()
+            if resp.status != 200:
+                raise RuntimeError(f"generate failed: {resp.status} {out}")
+            return len(out["tokens"])
+
+        t0 = time.perf_counter()
+        counts: list[int] = []
+        threads = [threading.Thread(
+            target=lambda i=i: counts.append(one_request(4 + (i % 5))))
+            for i in range(requests)]
+        for t in threads:
+            t.start()
+        # Trigger a hot-reload mid-traffic.
+        publish_params(tmp, model, params, step=2, model_name=model_name)
+        for t in threads:
+            t.join()
+        wall = time.perf_counter() - t0
+        deadline = time.monotonic() + 30
+        while plane.batcher.m_reloads.value() < 1 \
+                and time.monotonic() < deadline:
+            time.sleep(0.02)
+
+        b = plane.batcher
+        out = {
+            "model": model_name,
+            "slots": slots,
+            "requests": requests,
+            "tokens": int(sum(counts)),
+            "tokens_per_sec": round(sum(counts) / max(wall, 1e-9), 2),
+            "ttft_s": _percentiles(b.m_ttft),
+            "token_latency_s": _percentiles(b.m_step),
+            "reloads": int(b.m_reloads.value()),
+            "reload_pause_s": _percentiles(b.m_reload_pause),
+            "full_restore_s": round(restore_s, 6),
+        }
+        pause_p99 = out["reload_pause_s"]["p99"]
+        if pause_p99 is not None and restore_s > 0:
+            out["reload_pause_vs_restore"] = round(pause_p99 / restore_s, 4)
+        return out
+    finally:
+        if plane is not None:
+            plane.stop()
+        if root is None:
+            shutil.rmtree(tmp, ignore_errors=True)
+
+
+if __name__ == "__main__":
+    print(json.dumps(measure_serve(), indent=2))

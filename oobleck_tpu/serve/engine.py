@@ -1,0 +1,195 @@
+"""Decode engine: jitted prefill/decode over a slot-structured KV cache.
+
+One DecodeEngine owns the device-side serving state for one model: the
+current weights (swappable between decode steps), the preallocated KV
+cache (`[L, slots, H, max_seq, D]`, donated through every jitted call so
+XLA updates it in place), and the compiled prefill/decode executables.
+
+Prompt lengths are padded to a small set of power-of-two buckets so the
+number of distinct prefill programs is O(log max_seq) instead of one per
+prompt length; both program families route through the PR 1 persistent
+compilation cache (`utils/compile_cache.ensure_persistent_cache`) so a
+server cold-start deserializes instead of recompiling.
+
+All engine methods must be called from ONE thread (the batcher's): the
+jitted calls donate the cache buffers, so a concurrent caller would race
+on an invalidated buffer. Weight STAGING (host->device) is the exception
+— `stage_params` is thread-safe and runs on the reload watcher so the
+batcher-side swap is a pointer assignment.
+"""
+
+from __future__ import annotations
+
+import logging
+import os
+import threading
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from oobleck_tpu.utils.compile_cache import (
+    cache_event,
+    ensure_persistent_cache,
+)
+
+logger = logging.getLogger("oobleck.serve")
+
+
+def default_prefill_buckets(max_seq: int, smallest: int = 16) -> tuple[int, ...]:
+    """Power-of-two prompt-length buckets up to max_seq."""
+    out = []
+    b = min(smallest, max_seq)
+    while b < max_seq:
+        out.append(b)
+        b *= 2
+    out.append(max_seq)
+    return tuple(out)
+
+
+class DecodeEngine:
+    """Device-side serving state: weights + KV cache + compiled steps."""
+
+    def __init__(self, model, *, slots: int, max_seq: int,
+                 prefill_buckets: tuple[int, ...] | None = None):
+        self.model = model
+        self.slots = int(slots)
+        self.max_seq = int(max_seq)
+        if max_seq > model.config.max_position_embeddings:
+            raise ValueError(
+                f"max_seq {max_seq} exceeds the model's "
+                f"max_position_embeddings {model.config.max_position_embeddings}")
+        self.prefill_buckets = tuple(sorted(
+            prefill_buckets or default_prefill_buckets(self.max_seq)))
+        if self.prefill_buckets[-1] > self.max_seq:
+            raise ValueError("prefill bucket exceeds max_seq")
+
+        self.compile_cache_dir = ensure_persistent_cache()
+        if self.compile_cache_dir is not None:
+            # JAX creates the dir lazily on first write; hit/miss
+            # classification (entry-count deltas) needs it to exist now.
+            try:
+                os.makedirs(self.compile_cache_dir, exist_ok=True)
+            except OSError:
+                self.compile_cache_dir = None
+        if self.compile_cache_dir is not None:
+            # Decode programs are tiny and compile fast; the default
+            # min-compile-time threshold would skip persisting them, and a
+            # server cold-start wants ALL its programs served from cache.
+            try:
+                jax.config.update(
+                    "jax_persistent_cache_min_compile_time_secs", 0.0)
+            except AttributeError:
+                pass
+
+        self.params = None          # device-resident fused tree
+        self.params_step: int = -1  # checkpoint step the weights came from
+        self.cache = model.init_kv_cache(self.slots, self.max_seq)
+        self._stage_lock = threading.Lock()
+
+        # argnums: 0=params, 1=cache (donated), rest per call.
+        self._decode_fn = jax.jit(
+            lambda p, cache, token, pos:
+                model.forward_decode(p, token, cache, pos),
+            donate_argnums=(1,))
+        self._prefill_fn = jax.jit(
+            lambda p, cache, tokens, slot, length:
+                model.forward_prefill(p, tokens, cache, slot, length),
+            donate_argnums=(1,))
+
+    # -- weights -------------------------------------------------------- #
+
+    def stage_params(self, host_params):
+        """Host checkpoint tree -> device tree, blocking until resident.
+
+        Thread-safe; called by the reload watcher so the expensive
+        host->device copy happens OFF the decode thread and the batcher's
+        swap is a reference assignment."""
+        with self._stage_lock:
+            staged = jax.device_put(
+                jax.tree.map(jnp.asarray, host_params))
+            jax.block_until_ready(staged)
+            return staged
+
+    def set_params(self, device_params, step: int) -> None:
+        """Swap the served weights (decode-step barrier: the batcher calls
+        this between decode steps, never mid-step). In-flight requests
+        keep their KV cache — entries computed under the old weights mix
+        with new-weight queries, the standard continuous-serving
+        tradeoff; the alternative (drop + re-prefill) violates the
+        zero-dropped-requests contract."""
+        self.params = device_params
+        self.params_step = int(step)
+
+    # -- compile accounting --------------------------------------------- #
+
+    def _cache_entries(self) -> int | None:
+        d = self.compile_cache_dir
+        if not d or not os.path.isdir(d):
+            return None
+        try:
+            return sum(1 for n in os.listdir(d) if not n.startswith("."))
+        except OSError:
+            return None
+
+    def _classified(self, fn):
+        """Run one first-compile call, classifying it as a persistent-cache
+        hit (no new entry appeared in the cache dir) or miss."""
+        before = self._cache_entries()
+        out = fn()
+        jax.block_until_ready(out)
+        after = self._cache_entries()
+        if before is not None and after is not None:
+            cache_event("serve_hit" if after == before else "serve_miss")
+        return out
+
+    def warmup(self) -> int:
+        """Compile the decode step and every prefill bucket up front (cold
+        starts pay compiles at startup, not on the first request). Returns
+        the number of programs compiled. Requires weights."""
+        assert self.params is not None, "set_params before warmup"
+        n = 0
+        for b in self.prefill_buckets:
+            tokens = jnp.zeros((1, b), jnp.int32)
+            logits, self.cache = self._classified(
+                lambda t=tokens: self._prefill_fn(
+                    self.params, self.cache, t, jnp.int32(0), jnp.int32(1)))
+            n += 1
+        token = jnp.zeros((self.slots,), jnp.int32)
+        pos = jnp.zeros((self.slots,), jnp.int32)
+        (logits, self.cache) = self._classified(
+            lambda: self._decode_fn(self.params, self.cache, token, pos))
+        n += 1
+        logger.info("serve warmup: %d programs (buckets %s), cache dir %s",
+                    n, self.prefill_buckets, self.compile_cache_dir)
+        return n
+
+    # -- steps (batcher thread only) ------------------------------------ #
+
+    def bucket_for(self, n: int) -> int | None:
+        for b in self.prefill_buckets:
+            if n <= b:
+                return b
+        return None
+
+    def prefill(self, tokens: list[int], slot: int) -> np.ndarray:
+        """Run one request's prompt into `slot`; returns next-token logits
+        [V] as a host array."""
+        n = len(tokens)
+        b = self.bucket_for(n)
+        if b is None:
+            raise ValueError(f"prompt length {n} exceeds max_seq {self.max_seq}")
+        padded = np.zeros((1, b), np.int32)
+        padded[0, :n] = tokens
+        logits, self.cache = self._prefill_fn(
+            self.params, self.cache, jnp.asarray(padded),
+            jnp.int32(slot), jnp.int32(n))
+        return np.asarray(logits)
+
+    def decode(self, token: np.ndarray, pos: np.ndarray) -> np.ndarray:
+        """One decode step over ALL slots (inactive slots compute garbage
+        harmlessly); returns logits [slots, V] on host."""
+        logits, self.cache = self._decode_fn(
+            self.params, self.cache,
+            jnp.asarray(token, jnp.int32), jnp.asarray(pos, jnp.int32))
+        return np.asarray(logits)

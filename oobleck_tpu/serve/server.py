@@ -1,0 +1,202 @@
+"""Stdlib HTTP front end for the serving plane.
+
+Same dependency-free pattern as the master's /metrics endpoint
+(utils/metrics.py): ThreadingHTTPServer on a daemon thread, port 0 binds
+an ephemeral port (read `.port` after start).
+
+Endpoints:
+  POST /v1/generate   {"tokens": [..]} or {"prompt": ".."} (byte-level
+                      stand-in tokenizer), optional "max_tokens",
+                      "temperature", "deadline_ms", "eos_token".
+                      -> {"tokens", "text", "finish_reason", "step",
+                          "ttft_ms", "latency_ms"}
+                      429 when the admission queue is full (backpressure),
+                      400 on malformed input.
+  GET  /healthz       {"ok", "step", "slots_active", "queue_depth"}
+  GET  /metrics       Prometheus text for this process's registry
+                      (TTFT/per-token histograms, queue/slot gauges,
+                      reload counters).
+
+Run standalone against a training job's checkpoint root:
+
+    OOBLECK_CKPT_DIR=/ckpt OOBLECK_SERVE_PORT=8000 \
+        python -m oobleck_tpu.serve.server
+"""
+
+from __future__ import annotations
+
+import json
+import logging
+import threading
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+
+from oobleck_tpu.serve.batcher import GenRequest, QueueFull
+from oobleck_tpu.utils import metrics
+
+logger = logging.getLogger("oobleck.serve")
+
+
+def tokens_from_body(body: dict, vocab_size: int) -> list[int]:
+    """Request tokens: explicit id list, or a byte-level stand-in
+    tokenization of "prompt" (this repo trains on synthetic data — a real
+    deployment drops its tokenizer in here)."""
+    if "tokens" in body:
+        tokens = body["tokens"]
+        if (not isinstance(tokens, list) or not tokens
+                or not all(isinstance(t, int) and not isinstance(t, bool)
+                           and 0 <= t < vocab_size for t in tokens)):
+            raise ValueError(
+                f"tokens must be a non-empty list of ints in [0, {vocab_size})")
+        return tokens
+    if "prompt" in body:
+        raw = str(body["prompt"]).encode("utf-8")
+        if not raw:
+            raise ValueError("empty prompt")
+        return [b % vocab_size for b in raw]
+    raise ValueError("body needs 'tokens' or 'prompt'")
+
+
+def text_from_tokens(tokens: list[int]) -> str:
+    """Inverse of the byte-level stand-in (lossy for ids >= 256)."""
+    return bytes(t for t in tokens if t < 256).decode("utf-8", "replace")
+
+
+class ServeHTTPServer:
+    """HTTP front end over a ContinuousBatcher."""
+
+    def __init__(self, batcher, *, port: int = 0, host: str = "0.0.0.0",
+                 request_timeout: float = 120.0):
+        self.batcher = batcher
+        self.request_timeout = request_timeout
+        outer = self
+
+        class Handler(BaseHTTPRequestHandler):
+            def log_message(self, fmt, *args):  # keep test logs quiet
+                logger.debug("serve http: " + fmt, *args)
+
+            def _reply(self, code: int, payload: dict,
+                       ctype: str = "application/json") -> None:
+                body = json.dumps(payload).encode() \
+                    if ctype == "application/json" else payload
+                self.send_response(code)
+                self.send_header("Content-Type", ctype)
+                self.send_header("Content-Length", str(len(body)))
+                self.end_headers()
+                self.wfile.write(body)
+
+            def do_GET(self):
+                try:
+                    path = self.path.split("?")[0]
+                    if path == "/healthz":
+                        self._reply(200, outer._health())
+                    elif path == "/metrics":
+                        text = metrics.render_prometheus(
+                            [metrics.registry().snapshot()]).encode()
+                        self._reply(
+                            200, text,
+                            "text/plain; version=0.0.4; charset=utf-8")
+                    else:
+                        self.send_error(404)
+                except Exception:  # the endpoint must never kill the server
+                    logger.exception("serve GET failed")
+                    self.send_error(500)
+
+            def do_POST(self):
+                try:
+                    if self.path.split("?")[0] != "/v1/generate":
+                        self.send_error(404)
+                        return
+                    length = int(self.headers.get("Content-Length") or 0)
+                    try:
+                        body = json.loads(self.rfile.read(length) or b"{}")
+                        if not isinstance(body, dict):
+                            raise ValueError("body must be a JSON object")
+                        code, payload = outer._generate(body)
+                    except ValueError as e:
+                        code, payload = 400, {"error": str(e)}
+                    self._reply(code, payload)
+                except Exception:
+                    logger.exception("serve POST failed")
+                    self.send_error(500)
+
+        self._server = ThreadingHTTPServer((host, port), Handler)
+        self._server.daemon_threads = True
+        self.port = self._server.server_address[1]
+        self._thread = threading.Thread(
+            target=self._server.serve_forever, name="oobleck-serve-http",
+            daemon=True)
+
+    def _health(self) -> dict:
+        eng = self.batcher.engine
+        return {"ok": eng.params is not None,
+                "step": eng.params_step,
+                "slots_active": self.batcher.slots_active,
+                "queue_depth": self.batcher.queue_depth}
+
+    def _generate(self, body: dict) -> tuple[int, dict]:
+        vocab = self.batcher.engine.model.config.vocab_size
+        tokens = tokens_from_body(body, vocab)
+        max_tokens = int(body.get("max_tokens",
+                                  self.batcher.default_max_tokens))
+        if max_tokens < 1:
+            raise ValueError("max_tokens must be >= 1")
+        deadline_ms = body.get("deadline_ms")
+        eos = body.get("eos_token")
+        if eos is not None and not isinstance(eos, int):
+            raise ValueError("eos_token must be an int")
+        req = GenRequest(
+            tokens, max_tokens=max_tokens,
+            temperature=float(body.get("temperature", 0.0)),
+            deadline_s=(float(deadline_ms) / 1e3) if deadline_ms else None,
+            eos_token=eos)
+        try:
+            self.batcher.submit(req)
+        except QueueFull as e:
+            return 429, {"error": str(e)}
+        if not req.wait(self.request_timeout):
+            return 504, {"error": "generation timed out"}
+        if req.finish_reason in ("error", "shutdown"):
+            return 500, {"error": req.finish_reason}
+        if req.finish_reason == "too_long":
+            return 400, {"error": "prompt + max_tokens exceed max_seq"}
+        return 200, {
+            "tokens": req.out_tokens,
+            "text": text_from_tokens(req.out_tokens),
+            "finish_reason": req.finish_reason,
+            "step": req.step,
+            "ttft_ms": round((req.ttft_s or 0.0) * 1e3, 3),
+            "latency_ms": round((req.total_s or 0.0) * 1e3, 3),
+        }
+
+    def start(self) -> "ServeHTTPServer":
+        self._thread.start()
+        logger.info("serve http listening on :%d", self.port)
+        return self
+
+    def close(self) -> None:
+        self._server.shutdown()
+        self._server.server_close()
+
+
+def main() -> None:  # pragma: no cover - exercised via ServingPlane in tests
+    import os
+
+    from oobleck_tpu.serve import ServingPlane
+
+    logging.basicConfig(level=logging.INFO)
+    root = os.environ.get("OOBLECK_CKPT_DIR")
+    if not root:
+        raise SystemExit("set OOBLECK_CKPT_DIR to the checkpoint root")
+    plane = ServingPlane(
+        root, model_name=os.environ.get("OOBLECK_SERVE_MODEL"),
+        model_args=json.loads(os.environ.get("OOBLECK_SERVE_MODEL_ARGS", "{}")))
+    plane.start()
+    print(f"serving on :{plane.server.port} from {root}")
+    try:
+        threading.Event().wait()
+    except KeyboardInterrupt:
+        plane.stop()
+
+
+if __name__ == "__main__":  # pragma: no cover
+    main()

@@ -19,6 +19,9 @@ directives; each directive is ``action=arg[:qual][@ip]``:
                                 case TCP disconnect detection cannot see)
     kill_at=step_end:3@10.0.0.1 SIGKILL the process at the 3rd hit of the
                                 named barrier, on that host only
+    delay_at=serve_reload:0.5   sleep 0.5 s at every hit of the named
+                                barrier (slow-I/O injection: a reload
+                                crawling on cold storage, an NFS stall)
 
 Barriers are explicit calls (``chaos().barrier("step_end", ip=...)``)
 placed at recovery-relevant points: worker start, step start/end, and
@@ -44,7 +47,8 @@ logger = logging.getLogger("oobleck.chaos")
 
 ENV_VAR = "OOBLECK_CHAOS"
 
-_KNOWN_ACTIONS = ("delay_send", "drop_send", "stall_heartbeat", "kill_at")
+_KNOWN_ACTIONS = ("delay_send", "drop_send", "stall_heartbeat", "kill_at",
+                  "delay_at")
 
 
 @dataclass
@@ -81,6 +85,8 @@ def parse_spec(spec: str) -> list[Rule]:
         # at parse time, not silently inject nothing.
         if action == "delay_send":
             float(rule.arg)
+        elif action == "delay_at":
+            float(rule.qual or 0)  # delay_at=<barrier>:<seconds>
         elif action == "stall_heartbeat":
             int(rule.arg or 0)
         elif rule.qual is not None:
@@ -142,9 +148,29 @@ class Chaos:
 
     # -- named barriers ---------------------------------------------------- #
 
+    def barrier_delay(self, name: str, ip: str | None = None) -> float:
+        """Seconds a matching delay_at rule injects at this barrier (the
+        caller sleeps — slow-reload / slow-I/O fault). Counts events."""
+        total = 0.0
+        for r in self.rules:
+            if r.action == "delay_at" and r.arg == name and r.matches_ip(ip):
+                total += float(r.qual or 0)
+        if total > 0:
+            logger.warning("chaos: delaying %.3fs at barrier %s", total, name)
+            from oobleck_tpu.utils import metrics
+
+            metrics.flight_recorder().record(
+                "chaos_injection", action="delay_at", barrier=name,
+                seconds=total)
+        return total
+
     def barrier(self, name: str, ip: str | None = None) -> None:
         """Hit a named barrier; a matching kill_at rule SIGKILLs the process
-        (no cleanup, no atexit — the honest worker-crash fault)."""
+        (no cleanup, no atexit — the honest worker-crash fault). Matching
+        delay_at rules sleep here before any kill check."""
+        delay = self.barrier_delay(name, ip)
+        if delay > 0:
+            time.sleep(delay)
         for r in self.rules:
             if r.action != "kill_at" or r.arg != name or not r.matches_ip(ip):
                 continue

@@ -51,6 +51,14 @@ RECOVERY_BUCKETS = (0.1, 0.25, 0.5, 1.0, 2.5, 5.0, 10.0, 15.0, 30.0,
 CKPT_STALL_BUCKETS = (0.0001, 0.00025, 0.0005, 0.001, 0.0025, 0.005,
                       0.01, 0.025, 0.05, 0.1, 0.25, 0.5, 1.0, 2.5, 5.0,
                       10.0, 30.0, 60.0)
+# Serving latencies (TTFT, per-token decode, hot-reload pause): per-token
+# times are sub-millisecond-to-tens-of-ms on warm caches, TTFT includes a
+# prefill (up to seconds when it triggers a compile), and the reload-pause
+# claim ("well below one checkpoint restore") needs sub-millisecond
+# resolution at the bottom end.
+SERVE_LATENCY_BUCKETS = (0.0001, 0.00025, 0.0005, 0.001, 0.0025, 0.005,
+                         0.01, 0.025, 0.05, 0.1, 0.25, 0.5, 1.0, 2.5,
+                         5.0, 10.0, 30.0)
 
 
 def _label_key(labels: dict[str, str]) -> tuple[tuple[str, str], ...]:

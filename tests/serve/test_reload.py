@@ -1,0 +1,176 @@
+"""Hot-reload safety under checkpoint-writer faults.
+
+Satellite (d): a writer SIGKILLed mid-commit (the existing
+`ckpt_mid_write` chaos barrier — between the shard-data rename and the
+manifest write) leaves a torn step dir; a polling watcher must keep
+serving the old step, never load the torn dir, and never quarantine it
+(the trainer owns the root). A committed-but-corrupt dir is skipped the
+same way. `delay_at=serve_reload` injects a slow reload.
+
+Uses a fake engine/batcher pair so the module tests exactly the watcher:
+step selection, staging, swap posting."""
+
+import os
+import signal
+import subprocess
+import sys
+from pathlib import Path
+
+import jax
+import numpy as np
+import pytest
+
+from oobleck_tpu.ckpt import restore
+from oobleck_tpu.models import build_model
+from oobleck_tpu.serve.reload import CheckpointWatcher, publish_params
+from oobleck_tpu.utils import chaos as chaos_mod
+
+REPO = Path(__file__).resolve().parents[2]
+
+
+class _StageOnlyEngine:
+    def stage_params(self, host_params):
+        return host_params  # identity: no device in this module's scope
+
+
+class _RecordingBatcher:
+    def __init__(self):
+        self.swaps: list[int] = []
+
+    def post_swap(self, step, device_params):
+        self.swaps.append(int(step))
+
+
+@pytest.fixture(scope="module")
+def model():
+    return build_model("gpt2-tiny", {"num_layers": 1})
+
+
+@pytest.fixture(scope="module")
+def params(model):
+    return model.init_params(jax.random.PRNGKey(0))
+
+
+def _watcher(root, model) -> tuple[CheckpointWatcher, _RecordingBatcher]:
+    bat = _RecordingBatcher()
+    # poll_secs irrelevant: tests drive poll_once() directly.
+    return CheckpointWatcher(root, model, _StageOnlyEngine(), bat,
+                             poll_secs=3600, current_step=1), bat
+
+
+def _kill_writer_mid_commit(root, step: int) -> None:
+    """Subprocess writer SIGKILLed between shard rename and manifest
+    write: the on-disk result is data without MANIFEST.json."""
+    script = f"""
+import numpy as np
+from oobleck_tpu import ckpt
+plane = ckpt.DurableStatePlane({str(root)!r}, asynchronous=False)
+plane.save(step={step}, params={{0: {{"w": np.zeros(4)}}}}, opt_state={{0: ()}})
+print("UNREACHABLE")
+"""
+    env = {**os.environ, "PYTHONPATH": str(REPO), "JAX_PLATFORMS": "cpu",
+           "OOBLECK_METRICS_DIR": "",
+           "OOBLECK_CHAOS": "kill_at=ckpt_mid_write:1"}
+    proc = subprocess.run([sys.executable, "-c", script], env=env,
+                          capture_output=True, text=True, timeout=120)
+    assert proc.returncode == -signal.SIGKILL, proc.stderr
+    assert "UNREACHABLE" not in proc.stdout
+    torn = Path(root) / f"step_{step}"
+    assert torn.exists() and not (torn / "MANIFEST.json").exists()
+
+
+def test_torn_checkpoint_is_invisible_and_never_quarantined(
+        tmp_path, model, params):
+    publish_params(tmp_path, model, params, step=1)
+    watcher, bat = _watcher(tmp_path, model)
+
+    _kill_writer_mid_commit(tmp_path, 3)
+
+    # The torn dir has no commit marker: the poll sees nothing newer.
+    assert watcher.poll_once() is None
+    assert bat.swaps == [] and watcher.current_step == 1
+    # READ-ONLY consumer: the torn dir is still there for the trainer's
+    # own restart to quarantine — the watcher renamed nothing.
+    assert (tmp_path / "step_3").exists()
+    assert not (tmp_path / "quarantine").exists()
+
+    # A later valid commit wins immediately, torn dir still untouched.
+    publish_params(tmp_path, model, params, step=5)
+    assert watcher.poll_once() == 5
+    assert bat.swaps == [5] and watcher.current_step == 5
+    assert (tmp_path / "step_3").exists()
+
+
+def test_committed_but_corrupt_dir_is_skipped_not_loaded(
+        tmp_path, model, params):
+    publish_params(tmp_path, model, params, step=1)
+    publish_params(tmp_path, model, params, step=4)
+    # Corrupt the committed step 4 AFTER its manifest landed (bit rot /
+    # partial disk loss): complete_step_dirs still lists it, validation
+    # must reject it, and the watcher must keep step 1 and not rename.
+    shard = next((tmp_path / "step_4").glob("shards-*.npz"))
+    shard.write_bytes(shard.read_bytes()[: shard.stat().st_size // 2])
+
+    watcher, bat = _watcher(tmp_path, model)
+    fail0 = watcher.m_failures.value()
+    assert any(s == 4 for s, _ in restore.complete_step_dirs(tmp_path))
+    assert watcher.poll_once() is None
+    assert bat.swaps == [] and watcher.current_step == 1
+    assert watcher.m_failures.value() - fail0 == 1
+    assert (tmp_path / "step_4").exists()  # skipped, not quarantined
+
+    # Newest valid step still wins over the corrupt newer one next poll.
+    publish_params(tmp_path, model, params, step=2)
+    assert watcher.poll_once() == 2
+    assert bat.swaps == [2]
+
+
+def test_delay_at_chaos_injects_slow_reload(tmp_path, model, params):
+    import time
+
+    publish_params(tmp_path, model, params, step=1)
+    publish_params(tmp_path, model, params, step=2)
+    watcher, bat = _watcher(tmp_path, model)
+    chaos_mod.reset("delay_at=serve_reload:0.3")
+    try:
+        t0 = time.perf_counter()
+        assert watcher.poll_once() == 2
+        assert time.perf_counter() - t0 >= 0.3
+        assert bat.swaps == [2]
+    finally:
+        chaos_mod.reset("")
+
+
+def test_watcher_thread_polls_and_swaps(tmp_path, model, params):
+    """The threaded path (not poll_once): a new commit is picked up
+    within a few poll periods and the weights-step gauge follows."""
+    import time
+
+    publish_params(tmp_path, model, params, step=1)
+    bat = _RecordingBatcher()
+    watcher = CheckpointWatcher(tmp_path, model, _StageOnlyEngine(), bat,
+                                poll_secs=0.05, current_step=1).start()
+    try:
+        publish_params(tmp_path, model, params, step=6)
+        deadline = time.monotonic() + 20
+        while not bat.swaps and time.monotonic() < deadline:
+            time.sleep(0.02)
+        assert bat.swaps == [6]
+        assert watcher.m_step.value() == 6
+    finally:
+        watcher.stop()
+
+
+def test_published_payload_roundtrips_params(tmp_path, model, params):
+    """publish_params -> load_latest_params is identity on the fused
+    tree (the trainer->server handoff loses nothing)."""
+    from oobleck_tpu.serve.reload import load_latest_params
+
+    publish_params(tmp_path, model, params, step=9)
+    step, loaded = load_latest_params(tmp_path, model)
+    assert step == 9
+    ref = jax.tree.leaves(jax.tree.map(np.asarray, params))
+    got = jax.tree.leaves(jax.tree.map(np.asarray, loaded))
+    assert len(ref) == len(got)
+    for a, b in zip(ref, got):
+        np.testing.assert_array_equal(a, b)
