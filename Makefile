@@ -2,7 +2,7 @@
 
 PY ?= python
 
-.PHONY: lint test test-slow tier1 bench ckpt-bench serve-bench
+.PHONY: lint test test-slow tier1 bench ckpt-bench serve-bench pipeline-bench
 
 # Lint via ruff (config in pyproject.toml). Degrades to a skip when ruff
 # is not installed — the hermetic CI image does not ship it, and the gate
@@ -41,3 +41,11 @@ ckpt-bench:
 # key).
 serve-bench:
 	JAX_PLATFORMS=cpu $(PY) -m oobleck_tpu.serve.bench
+
+# Pipeline-schedule microbench: 1F1B vs interleaved tokens/sec and
+# schedule-replay bubble on 2 virtual CPU devices (also under bench.py's
+# "pipeline" key). Pure CPU — runs the same with or without a TPU.
+pipeline-bench:
+	JAX_PLATFORMS=cpu _OOBLECK_BENCH_PIPELINE=1 \
+		XLA_FLAGS="--xla_force_host_platform_device_count=2" \
+		$(PY) bench.py

@@ -13,6 +13,10 @@ Budget layout (wall-clock caps, enforced with subprocess timeouts):
   serve   : 75 s CPU subprocess        -> serving microbench under "serve"
                                           (never on the TPU relay: its
                                           multi-threaded dispatch wedges it)
+  pipeline: 120 s CPU subprocess       -> 1F1B vs interleaved schedule
+                                          comparison under "pipeline" (2
+                                          virtual CPU devices; same
+                                          never-on-the-relay rule)
 When the TPU is unreachable the emitted value is the last good TPU
 measurement from BENCH_BASELINE.json (clearly noted), with the CPU proxy's
 number in the note; if even that file is missing, the CPU proxy value is
@@ -30,6 +34,7 @@ import sys
 import time
 
 _INNER_ENV = "_OOBLECK_BENCH_INNER"
+_PIPELINE_ENV = "_OOBLECK_BENCH_PIPELINE"
 
 PROBE_TIMEOUT_S = 60
 PROBE_RETRY_BACKOFF_S = 10
@@ -285,6 +290,133 @@ def _validate_flash_on_device() -> bool:
         return False
 
 
+PIPELINE_BENCH_TIMEOUT_S = 120
+
+
+def _measure_pipeline() -> dict:
+    """1F1B vs interleaved 1F1B on the MPMD interpreter (gpt2-tiny scaled
+    to hidden 256 / 6 blocks so block compute dominates embed/head, 2
+    stages, 8 microbatches, 2 virtual CPU devices): tokens/s plus the
+    schedule-replay bubble (execution/schedule.simulate_bubble — the same
+    estimator behind the engine's measured
+    oobleck_engine_pipeline_bubble_fraction gauge). Per-chunk durations
+    come from a calibration pass with sync_op_timing (block on each
+    compute inside the timed region): async-dispatch enqueue times would
+    misattribute the step's whole drain to whichever op blocks. The
+    acceptance bar is interleaved's measured bubble landing strictly
+    below the 1F1B closed form (S-1)/(M+S-1)."""
+    import jax
+
+    from oobleck_tpu.execution.pipeline import PipelineInstance
+    from oobleck_tpu.execution.schedule import (
+        Op,
+        bubble_fraction,
+        simulate_bubble,
+    )
+    from oobleck_tpu.models import build_model
+    from oobleck_tpu.planning.templates import PipelineTemplate, StageSpec
+
+    S, M = 2, 8
+    batch_mb, seq = 2, 128
+    steps = int(os.environ.get("BENCH_PIPELINE_STEPS", "3"))
+    model = build_model("gpt2-tiny", {"hidden_size": 256, "num_layers": 6,
+                                      "max_position_embeddings": 256})
+    nl = model.num_pipeline_layers
+    split = nl // S
+    stages = tuple(
+        StageSpec(
+            layer_indices=tuple(
+                range(i * split, nl if i == S - 1 else (i + 1) * split)),
+            num_chips=1, forward=1.0, backward=3.0, mem_required=1 << 20,
+        )
+        for i in range(S)
+    )
+    tmpl = PipelineTemplate(stages=stages, iteration_time=4.0, num_layers=nl,
+                            num_hosts=S, chips_per_host=1)
+    out: dict = {
+        "num_stages": S, "num_microbatches": M,
+        "bubble_1f1b_closed_form": round(bubble_fraction(S, M), 4),
+    }
+    tokens = model.sample_batch(batch_mb * M, seq)["input_ids"].reshape(
+        M, batch_mb, seq)
+    for label, v in (("1f1b", 1), ("interleaved", 2)):
+        pipe = PipelineInstance(
+            pipeline_id=0, template=tmpl, ranks=list(range(S)), model=model,
+            devices=jax.devices()[:S], num_microbatches=M,
+            total_num_microbatches=M, microbatch_size=batch_mb, seq_len=seq,
+            exec_cache={}, virtual_stages=v,
+        )
+        for _ in range(2):  # warmup: compile both phases
+            loss = pipe.train_step(tokens)
+        float(loss)
+        pipe.sync_op_timing = True  # calibration: true per-op durations
+        durs: dict = {}
+        for _ in range(2):
+            loss = pipe.train_step(tokens)
+            for k, (tot, cnt) in pipe.last_op_times.items():
+                a, b = durs.get(k, (0.0, 0))
+                durs[k] = (a + tot, b + cnt)
+        float(loss)
+        pipe.sync_op_timing = False  # throughput: the real async hot path
+        t0 = time.perf_counter()
+        for _ in range(steps):
+            loss = pipe.train_step(tokens)
+        float(loss)
+        dt = time.perf_counter() - t0
+
+        def dur_fn(inst, _d=durs):
+            kind = "b" if inst.op is Op.BACKWARD else "f"
+            tot, cnt = _d.get((inst.stage, inst.chunk, kind), (0.0, 0))
+            if not cnt:  # chunk never timed: any same-kind average
+                same = [tc for (s, c, k), tc in _d.items() if k == kind]
+                tot, cnt = (sum(t for t, _ in same),
+                            sum(c for _, c in same))
+            return tot / cnt if cnt else 1.0
+
+        out[label] = {
+            "virtual_stages": v,
+            "tokens_per_sec": round(batch_mb * M * seq * steps / dt, 1),
+            "bubble_closed_form": round(bubble_fraction(S, M, v), 4),
+            "bubble_measured": round(simulate_bubble(S, M, v, dur_fn), 4),
+        }
+    out["interleaved_beats_1f1b_closed_form"] = (
+        out["interleaved"]["bubble_measured"] < out["bubble_1f1b_closed_form"]
+    )
+    return out
+
+
+def _pipeline_summary() -> dict:
+    """Schedule-comparison microbench in a throwaway CPU subprocess with 2
+    virtual devices — never on the TPU relay (same wedge hazard as the
+    serving bench), and forcing the device count requires a fresh
+    process anyway."""
+    env = dict(os.environ)
+    env.update({
+        "JAX_PLATFORMS": "cpu", "PALLAS_AXON_POOL_IPS": "",
+        "OOBLECK_METRICS_DIR": "",
+        "XLA_FLAGS": (env.get("XLA_FLAGS", "")
+                      + " --xla_force_host_platform_device_count=2").strip(),
+    })
+    env.pop(_INNER_ENV, None)
+    env[_PIPELINE_ENV] = "1"
+    proc = subprocess.Popen(
+        [sys.executable, os.path.abspath(__file__)],
+        stdout=subprocess.PIPE, stderr=subprocess.PIPE, text=True, env=env)
+    try:
+        out, err = proc.communicate(timeout=PIPELINE_BENCH_TIMEOUT_S)
+    except subprocess.TimeoutExpired:
+        proc.kill()
+        return {"error": f"pipeline bench hung >{PIPELINE_BENCH_TIMEOUT_S}s"}
+    if proc.returncode != 0:
+        tail = (err or "").strip().splitlines()[-1:] or ["no stderr"]
+        return {"error":
+                f"pipeline bench exit {proc.returncode}: {tail[0][:160]}"}
+    try:
+        return json.loads(out.strip().splitlines()[-1])
+    except Exception as exc:  # noqa: BLE001
+        return {"error": f"unparseable pipeline bench output: {exc}"}
+
+
 SERVE_BENCH_TIMEOUT_S = 75
 
 
@@ -379,10 +511,19 @@ def _emit(result: dict) -> None:
         result["serve"] = _serve_summary()
     except Exception as exc:  # noqa: BLE001 — emit must never fail
         result["serve"] = {"error": f"{type(exc).__name__}: {exc}"}
+    # Schedule comparison (1F1B vs interleaved bubble + throughput): CPU
+    # subprocess, bounded, best-effort — see _pipeline_summary.
+    try:
+        result["pipeline"] = _pipeline_summary()
+    except Exception as exc:  # noqa: BLE001 — emit must never fail
+        result["pipeline"] = {"error": f"{type(exc).__name__}: {exc}"}
     print(json.dumps(result))
 
 
 def main() -> None:
+    if os.environ.get(_PIPELINE_ENV) == "1":
+        print(json.dumps(_measure_pipeline()))
+        return
     if os.environ.get(_INNER_ENV) == "1":
         print(json.dumps(_measure()))
         return
@@ -424,8 +565,10 @@ def main() -> None:
             "vs_baseline": 1.0,
             # Machine-readable staleness: consumers parsing only
             # value/vs_baseline must not mistake a replayed number for a
-            # fresh measurement (round-3 advisor finding).
+            # fresh measurement (round-3 advisor finding); stale_from names
+            # the round the replayed number was actually measured in.
             "stale": True,
+            "stale_from": base.get("recorded", "unknown"),
             "note": (
                 "TPU unreachable this run ("
                 + "; ".join(reasons)
@@ -459,6 +602,7 @@ if __name__ == "__main__":
             "unit": "tokens/s/chip",
             "vs_baseline": 1.0 if base else 0,
             "stale": True,
+            "stale_from": base.get("recorded", "unknown"),
             "note": f"bench harness crashed ({type(exc).__name__}: {exc}); "
                     "value is the last good TPU measurement" if base else
                     f"bench harness crashed ({type(exc).__name__}: {exc})",

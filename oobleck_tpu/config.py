@@ -130,6 +130,21 @@ class ExecutionArguments:
     # honest out of the box; 0 opts out explicitly (train on everything,
     # the reference behavior — its eval data is never actually driven).
     eval_fraction: float = 0.02
+    # Pipeline schedule for the MPMD path: "1f1b" (canonical) or
+    # "interleaved" (Megatron-style virtual pipeline — each stage holds
+    # virtual_stages model chunks, shrinking the bubble from
+    # (S-1)/(M+S-1) to (S-1)/(v*M+S-1)). Interleaving requires the
+    # per-pipeline microbatch count to be a multiple of num_stages and at
+    # least num_stages*virtual_stages pipeline layers; when a
+    # reconfiguration leaves a plan that cannot honor it, the engine falls
+    # back to 1f1b and records a flight-recorder event.
+    pipeline_schedule: str = "1f1b"
+    virtual_stages: int = 1
+    # Host loss-readback period (steps). 1 = read every step (the classic
+    # contract: per-step log lines, loss gauge per step). N > 1 keeps the
+    # loss on-device and resolves N steps at a time, removing the only
+    # blocking host sync from the steady-state train loop.
+    loss_readback_every: int = 1
     # Bounded-time recovery: how many host losses ahead to AOT-precompile
     # re-planned stage executables for (execution/precompile.py). Depth d
     # walks the plans the instantiator would match after losing 1..d hosts
@@ -150,6 +165,28 @@ class ExecutionArguments:
                 "attention_impl must be auto|xla|pallas|ring|ulysses, got "
                 f"{self.attention_impl!r}"
             )
+        if self.pipeline_schedule not in ("1f1b", "interleaved"):
+            raise ValueError(
+                "pipeline_schedule must be 1f1b|interleaved, got "
+                f"{self.pipeline_schedule!r}"
+            )
+        if self.virtual_stages < 1:
+            raise ValueError(
+                f"virtual_stages must be >= 1, got {self.virtual_stages}"
+            )
+        if self.pipeline_schedule == "1f1b" and self.virtual_stages > 1:
+            raise ValueError(
+                "virtual_stages > 1 requires pipeline_schedule: interleaved"
+            )
+        if self.loss_readback_every < 1:
+            raise ValueError(
+                f"loss_readback_every must be >= 1, got "
+                f"{self.loss_readback_every}"
+            )
+
+    @property
+    def resolved_virtual_stages(self) -> int:
+        return self.virtual_stages if self.pipeline_schedule == "interleaved" else 1
 
     def apply_durable_env_overrides(self) -> None:
         """Runtime overrides for the durable-state plane — preemption

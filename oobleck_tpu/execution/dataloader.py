@@ -226,3 +226,86 @@ class PrefetchingLoader:
 
     def close(self) -> None:
         self._pool.shutdown(wait=False, cancel_futures=True)
+
+
+class DeviceStager:
+    """Double-buffers host batch assembly AND device placement.
+
+    While the engine computes step N, a single background worker assembles
+    step N+1's host batch and runs `place_fn` on it — for the MPMD
+    interpreter that is PipelineInstance._place_batch (per-microbatch
+    device_put onto every batch-reading stage's sharding), for the fused
+    path it pre-places the global token arrays — so by the time the train
+    step starts, its inputs are already on (or in flight to) the devices
+    and the critical path never blocks on a host->device transfer.
+
+    Same consumed-position contract as PrefetchingLoader: the exposed
+    (num_iterations_done, epoch) is the CONSUMED position, so
+    reconfiguration / checkpoint resume replays the staged-but-unconsumed
+    iteration instead of skipping it. `last_wait_s` is the blocking time
+    the last next_placed() call spent waiting for staging to finish
+    (~0 when staging kept up) — the engine feeds it to the
+    oobleck_input_wait_seconds histogram."""
+
+    def __init__(self, loader, place_fn):
+        from concurrent.futures import ThreadPoolExecutor
+
+        # Accept a bare OobleckDataLoader or an existing PrefetchingLoader
+        # (staging subsumes its host-side double buffering).
+        self.loader = getattr(loader, "loader", loader)
+        self._place_fn = place_fn
+        self._pool = ThreadPoolExecutor(
+            max_workers=1, thread_name_prefix="oobleck-stager"
+        )
+        self._consumed_pos = (self.loader.num_iterations_done,
+                              self.loader.epoch)
+        self._fut = None
+        self.last_wait_s = 0.0
+
+    @property
+    def num_iterations_done(self) -> int:
+        return self._consumed_pos[0]
+
+    @property
+    def epoch(self) -> int:
+        return self._consumed_pos[1]
+
+    @property
+    def sampler(self) -> OobleckSampler:
+        return self.loader.sampler
+
+    def _grab(self):
+        batch = self.loader.next_batch()
+        placed = self._place_fn(batch)
+        return batch, placed, (self.loader.num_iterations_done,
+                               self.loader.epoch)
+
+    def next_placed(self):
+        """(host_batch, placed) for the next iteration; kicks off staging
+        of the one after."""
+        import time
+
+        t0 = time.perf_counter()
+        if self._fut is None:
+            self._fut = self._pool.submit(self._grab)
+        batch, placed, pos = self._fut.result()
+        self.last_wait_s = time.perf_counter() - t0
+        self._consumed_pos = pos
+        self._fut = self._pool.submit(self._grab)
+        return batch, placed
+
+    def next_batch(self) -> dict[str, np.ndarray]:
+        return self.next_placed()[0]
+
+    def advance(self) -> None:
+        if self._fut is not None:
+            _, _, pos = self._fut.result()
+            self._consumed_pos = pos
+            self._fut = None
+        else:
+            self.loader.advance()
+            self._consumed_pos = (self.loader.num_iterations_done,
+                                  self.loader.epoch)
+
+    def close(self) -> None:
+        self._pool.shutdown(wait=False, cancel_futures=True)

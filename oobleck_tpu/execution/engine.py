@@ -44,6 +44,7 @@ from jax.sharding import NamedSharding
 
 from oobleck_tpu.config import OobleckArguments
 from oobleck_tpu.execution.dataloader import (
+    DeviceStager,
     OobleckDataLoader,
     OobleckSampler,
     PrefetchingLoader,
@@ -67,6 +68,41 @@ from oobleck_tpu.utils.timer import measure_time, sync_timers
 logger = logging.getLogger("oobleck.engine")
 
 DEFAULT_HBM_BYTES = 16 * 2**30  # v5e/v4 chip HBM, used when stats are absent
+
+
+class HostSyncCounter:
+    """Counts host-blocking device readbacks the engine performs (the
+    `float(loss)` family). Test hook for the async-dispatch guarantee:
+    with input prefetch on and loss_readback_every > 1, steady-state steps
+    must not bump this at all."""
+
+    def __init__(self) -> None:
+        self.count = 0
+
+
+host_sync_counter = HostSyncCounter()
+
+
+def _host_sync(value) -> float:
+    """The engine's ONLY device->host readback funnel (counted)."""
+    host_sync_counter.count += 1
+    return float(value)
+
+
+class DeferredLoss:
+    """Weighted on-device loss scalars whose host readback is postponed
+    (execution.loss_readback_every > 1). Holding the jax arrays keeps them
+    alive without forcing a sync; resolve() is the single point where the
+    host finally blocks."""
+
+    def __init__(self, parts: list[tuple[Any, int]]) -> None:
+        self._parts = parts
+
+    def resolve(self) -> float:
+        total = sum(w for _, w in self._parts)
+        return sum(
+            _host_sync(l) * w for l, w in self._parts
+        ) / max(1, total)
 
 
 def _jax_distributed_active() -> bool:
@@ -571,6 +607,12 @@ class OobleckEngine:
         self.dp_engine: DataParallelEngine | None = None
         self.step = 0
         self._exec_cache: dict = {}
+        # Async-dispatch state: device-resident losses awaiting readback
+        # (loss_readback_every > 1) and the resolved (step, loss) history —
+        # identical in content between deferred and per-step readback, which
+        # the parity tests pin down.
+        self._pending_losses: list[tuple[int, DeferredLoss]] = []
+        self.loss_history: list[tuple[int, float]] = []
         # Warm-recovery precompiler (execution/precompile.py); armed by
         # start_recovery_precompile and re-armed after each reconfigure.
         self._precompiler = None
@@ -616,8 +658,18 @@ class OobleckEngine:
             "Model FLOPs utilization estimate of the last step")
         self._m_bubble = reg.gauge(
             "oobleck_engine_pipeline_bubble_fraction",
-            "Pipeline bubble fraction (kind=schedule: 1F1B closed form; "
-            "kind=measured: 1 - stage dispatch busy time / step time)")
+            "Pipeline bubble fraction (kind=schedule: closed form "
+            "(S-1)/(vM+S-1); kind=measured: dependency replay of measured "
+            "per-chunk dispatch times through the schedule graph, falling "
+            "back to 1 - busy/(S*step) when no per-op times exist)")
+        self._m_input_wait = reg.histogram(
+            "oobleck_input_wait_seconds",
+            "Blocking time per step waiting on the device-side input "
+            "stager (~0 when staging keeps ahead of compute)")
+        self._m_dispatch_stall = reg.histogram(
+            "oobleck_dispatch_stall_seconds",
+            "Time per step spent dispatching batched cross-stage "
+            "activation/gradient transfers")
         self._m_reconfigs = reg.counter(
             "oobleck_engine_reconfigurations_total",
             "In-place reconfigurations completed")
@@ -718,6 +770,10 @@ class OobleckEngine:
 
         min_hosts = self.compute_min_hosts()
         gen = TemplateGenerator()
+        # Interleaving changes the cost model (warmup ramp / v), so the
+        # planner must rank stage partitions under the schedule that will
+        # actually run them.
+        vstages = self.args.execution.resolved_virtual_stages
         tp = self.args.execution.tensor_parallel
         sp = max(1, self.args.execution.sequence_parallel)
         unit = tp * sp
@@ -733,12 +789,13 @@ class OobleckEngine:
                 )
             base = gen.create_pipeline_templates(
                 self.profiles, (min_hosts, n_hosts),
-                self.chips_per_host // unit
+                self.chips_per_host // unit, virtual_stages=vstages,
             )
             self.templates = [_scale_template_chips(t, unit) for t in base]
         else:
             self.templates = gen.create_pipeline_templates(
-                self.profiles, (min_hosts, n_hosts), self.chips_per_host
+                self.profiles, (min_hosts, n_hosts), self.chips_per_host,
+                virtual_stages=vstages,
             )
         if not self.templates:
             raise RuntimeError(
@@ -1185,6 +1242,54 @@ class OobleckEngine:
                           seq=ex.sequence_parallel, tensor=ex.tensor_parallel)
         return make_mesh(shape, devices[:used])
 
+    def _prefetch_enabled(self) -> bool:
+        """Device-side input staging (execution/dataloader.DeviceStager):
+        a background thread shapes AND device_puts iteration N+1's
+        microbatches while step N computes. Default ON single-controller,
+        OFF under jax.distributed (a staging thread issuing device_puts
+        next to collectives is a hang risk not worth the default);
+        OOBLECK_PREFETCH=0/1 overrides either way."""
+        import os
+
+        v = os.environ.get("OOBLECK_PREFETCH")
+        if v is not None:
+            return v.lower() not in ("0", "false", "no")
+        return not self.multihost
+
+    def _effective_virtual_stages(self, num_stages: int,
+                                  num_microbatches: int,
+                                  pipeline_index: int,
+                                  record: bool = True) -> int:
+        """The virtual-stage degree a pipeline can actually run: the
+        configured one when its constraints hold (microbatches divisible by
+        stages, enough layers), else 1 — with a flight-recorder event so a
+        silent fallback after reconfiguration is diagnosable. The recovery
+        precompiler calls this with record=False for PREDICTED plans (same
+        decision, hence same exec-cache keys, without logging a fallback
+        that has not happened)."""
+        v = self.args.execution.resolved_virtual_stages
+        if v <= 1 or num_stages <= 1:
+            return 1
+        reason = None
+        if num_microbatches % num_stages != 0:
+            reason = (f"num_microbatches {num_microbatches} not divisible "
+                      f"by num_stages {num_stages}")
+        elif self.model.num_pipeline_layers < num_stages * v:
+            reason = (f"{self.model.num_pipeline_layers} pipeline layers < "
+                      f"num_stages*virtual_stages {num_stages * v}")
+        if reason is None:
+            return v
+        if record:
+            logger.warning(
+                "pipeline %d: interleaved schedule unavailable (%s); "
+                "falling back to 1f1b", pipeline_index, reason,
+            )
+            metrics.flight_recorder().record(
+                "interleave_fallback", pipeline=pipeline_index,
+                requested=v, reason=reason, step=self.step,
+            )
+        return 1
+
     def _materialize_fused(self, global_num_microbatch: int,
                            num_iterations_done: int, epoch: int,
                            restored: dict | None) -> None:
@@ -1198,20 +1303,28 @@ class OobleckEngine:
             seq_len=self.seq_len, optimizer=self.optimizer,
             restored=restored,
         )
-        train_samples = len(self.dataset) - self._eval_reserve()
+        self.dataloaders = [self._fused_dataloader(
+            global_num_microbatch, num_iterations_done, epoch)]
+        self.pipelines = []
+        self.dp_engine = None
+
+    def _fused_dataloader(self, global_num_microbatch: int,
+                          num_iterations_done: int, epoch: int):
+        """A loader for the CURRENT self.fused — the stager's place_fn is
+        bound to the fused pipeline's mesh, so reconfiguration must rebuild
+        it (a batch staged for the old mesh carries the old sharding)."""
         sampler = OobleckSampler(
-            num_samples=train_samples,
+            num_samples=len(self.dataset) - self._eval_reserve(),
             microbatch_size=self.args.job.microbatch_size,
             pipeline_index=0,
             num_microbatches=[global_num_microbatch],
             num_iterations_done=num_iterations_done,
             epoch=epoch,
         )
-        self.dataloaders = [
-            PrefetchingLoader(OobleckDataLoader(self.dataset, sampler))
-        ]
-        self.pipelines = []
-        self.dp_engine = None
+        loader = OobleckDataLoader(self.dataset, sampler)
+        if self._prefetch_enabled():
+            return DeviceStager(loader, self.fused.place_batch)
+        return PrefetchingLoader(loader)
 
     def _materialize_plan(self, plan: HeterogeneousPlan, num_iterations_done,
                           epoch, old_params, old_opt,
@@ -1253,6 +1366,10 @@ class OobleckEngine:
                 fsdp=self.args.execution.fsdp,
                 process_of_rank=process_of_rank,
                 comm=self.comm,
+                virtual_stages=self._effective_virtual_stages(
+                    a.template.num_stages, a.num_microbatches,
+                    a.pipeline_index,
+                ),
             )
             self.pipelines.append(pipe)
             # Train over the head split only; the tail is evaluate()'s
@@ -1269,7 +1386,14 @@ class OobleckEngine:
             # Double-buffering only pays where batches are consumed;
             # non-participating pipelines only track position (advance()).
             if not self.multihost or pipe.participates_locally:
-                loader = PrefetchingLoader(loader)
+                if self._prefetch_enabled():
+                    loader = DeviceStager(
+                        loader,
+                        lambda b, _p=pipe: _p._place_batch(
+                            _p._as_batch_dict(b))[0],
+                    )
+                else:
+                    loader = PrefetchingLoader(loader)
             self.dataloaders.append(loader)
             if old_opt is not None:
                 # Optimizer state mirrors params: re-place each layer's state
@@ -1292,27 +1416,49 @@ class OobleckEngine:
 
     # ------------------------------------------------------------------ #
 
+    def _defer_losses(self) -> bool:
+        """Whether steady-state steps keep losses on-device. The multihost
+        MPMD step cannot defer: its loss rides the gradient allreduce as a
+        host-side collective value (_train_step_multihost)."""
+        return (self.args.execution.loss_readback_every > 1
+                and not self.multihost)
+
+    def _staged_batch(self, dl):
+        """(host_batch, placed_or_None) from a loader, observing the input
+        wait when a DeviceStager fronted it."""
+        if isinstance(dl, DeviceStager):
+            batch, placed = dl.next_placed()
+            self._m_input_wait.observe(dl.last_wait_s)
+            return batch, placed
+        return dl.next_batch(), None
+
     @measure_time("step")
-    def _train_step(self) -> float:
+    def _train_step(self) -> "float | DeferredLoss":
         from oobleck_tpu.utils.tracing import annotate
 
         if self.fused is not None:
+            with annotate("staging"):
+                batch, placed = self._staged_batch(self.dataloaders[0])
             with annotate("fused_step"):
-                loss = self.fused.train_step(self.dataloaders[0].next_batch())
+                loss = self.fused.train_step(batch, placed=placed)
             self.step += 1
-            return float(loss)
+            if self._defer_losses():
+                return DeferredLoss([(loss, 1)])
+            return _host_sync(loss)
 
         if self.multihost:
             return self._train_step_multihost()
 
         losses = []
         weights = []
+        stall_s = 0.0
         with annotate("pipelines"):
             for pipe, dl in zip(self.pipelines, self.dataloaders):
                 with annotate("staging"):
-                    batch = dl.next_batch()
-                losses.append(pipe.train_step(batch))
+                    batch, placed = self._staged_batch(dl)
+                losses.append(pipe.train_step(batch, placed=placed))
                 weights.append(pipe.num_microbatches)
+                stall_s += pipe.last_dispatch_stall_s
         with annotate("dp_allreduce"):
             synced = self.dp_engine.do_allreduce()
         with annotate("optimizer"):
@@ -1321,9 +1467,13 @@ class OobleckEngine:
                     self.optimizer, self.opt_states[pipe.pipeline_id],
                     synced[pipe.pipeline_id],
                 )
-        total = sum(w for w in weights)
-        loss = sum(float(l) * w for l, w in zip(losses, weights)) / total
+        self._m_dispatch_stall.observe(stall_s)
         self.step += 1
+        if self._defer_losses():
+            return DeferredLoss(list(zip(losses, weights)))
+        total = sum(w for w in weights)
+        loss = sum(
+            _host_sync(l) * w for l, w in zip(losses, weights)) / total
         return loss
 
     def _train_step_multihost(self) -> float:
@@ -1350,7 +1500,7 @@ class OobleckEngine:
                 loss = pipe.train_step(batch)
                 if loss is not None:
                     local_losses[pipe.pipeline_id] = (
-                        float(loss), pipe.num_microbatches
+                        _host_sync(loss), pipe.num_microbatches
                     )
         with annotate("dp_allreduce"):
             synced, global_loss = self.dp_engine.allreduce(local_losses)
@@ -1409,31 +1559,68 @@ class OobleckEngine:
         return self._flops_cache
 
     def _bubble_fractions(self, step_s: float) -> dict[str, float]:
-        """Schedule-derived 1F1B bubble (S-1)/(M+S-1) plus, when per-stage
-        dispatch times exist, a measured 1 - busy/(S*step) variant."""
+        """kind=schedule: the closed form (S-1)/(vM+S-1), microbatch-
+        weighted over pipelines. kind=measured: replay of the measured
+        per-(stage, chunk) fwd/bwd dispatch durations through the
+        schedule's dependency graph (schedule.simulate_bubble) — this
+        isolates the schedule-shape bubble from host serialization, which
+        a raw busy/step wall-clock ratio cannot do when one process
+        dispatches every stage. Falls back to 1 - busy/(S*step) when no
+        per-op times exist."""
+        from oobleck_tpu.execution.schedule import (
+            Op,
+            bubble_fraction,
+            simulate_bubble,
+        )
+
         out: dict[str, float] = {}
         sched_num = sched_den = 0.0
+        sim_num = sim_den = 0.0
         busy_s = 0.0
         busy_slots = 0
         for pipe in self.pipelines:
             s = pipe.num_stages
             m = pipe.num_microbatches
+            v = getattr(pipe, "virtual_stages", 1)
             if m + s > 1:
-                sched_num += m * (s - 1) / (m + s - 1)
+                sched_num += m * bubble_fraction(s, m, v)
                 sched_den += m
+            op_times = getattr(pipe, "last_op_times", None)
+            if op_times:
+                def dur(inst, _t=op_times):
+                    kind = "f" if inst.op is Op.FORWARD else "b"
+                    tot, n = _t.get((inst.stage, inst.chunk, kind),
+                                    (0.0, 0))
+                    if n:
+                        return tot / n
+                    vals = [t / c for (_, _, k), (t, c) in _t.items()
+                            if k == kind and c]
+                    return sum(vals) / len(vals) if vals else 1.0
+
+                try:
+                    sim_num += m * simulate_bubble(s, m, v, dur)
+                    sim_den += m
+                except RuntimeError:  # replay deadlock: fall through
+                    pass
             if pipe.last_stage_busy_s:
                 busy_s += sum(pipe.last_stage_busy_s.values())
                 busy_slots += s
         if sched_den:
             out["schedule"] = sched_num / sched_den
-        if busy_slots and step_s > 0:
+        if sim_den:
+            out["measured"] = sim_num / sim_den
+        elif busy_slots and step_s > 0:
             out["measured"] = max(0.0, 1.0 - busy_s / (busy_slots * step_s))
         return out
 
-    def _record_step_metrics(self, loss: float, step_s: float) -> None:
+    def _record_step_metrics(self, loss: "float | None",
+                             step_s: float) -> None:
+        """Per-step timing/throughput metrics; loss is None while its
+        readback is deferred (the gauge updates at drain time)."""
         self._m_steps.inc()
         self._m_step_seconds.observe(step_s)
-        self._m_loss.set(loss)
+        if loss is not None:
+            self._m_loss.set(loss)
         if step_s > 0:
             tokens = self.args.job.global_microbatch_size * self.seq_len
             tps = tokens / step_s
@@ -1445,6 +1632,31 @@ class OobleckEngine:
                     self._m_mfu.set(fpt * tps / n_chips / peak)
         for kind, frac in self._bubble_fractions(step_s).items():
             self._m_bubble.set(frac, kind=kind)
+
+    def _drain_pending_losses(self, max_steps: int | None = None) -> None:
+        """Resolve every deferred loss (one readback per step, but off the
+        steady-state critical path): log each step's line in the classic
+        format, update the loss gauge to the newest value, and append to
+        loss_history. Resolution can fail after a reconfiguration freed
+        the backing devices; those steps report as unavailable rather than
+        killing the loop."""
+        if not self._pending_losses:
+            return
+        if max_steps is None:
+            max_steps = self.args.job.steps
+        for step_i, pending in self._pending_losses:
+            try:
+                val = pending.resolve()
+            except Exception as e:  # backing buffers gone (reconfig)
+                logger.warning(
+                    "step %d loss unavailable (deferred readback: %s)",
+                    step_i, e,
+                )
+                continue
+            self.loss_history.append((step_i, val))
+            self._m_loss.set(val)
+            logger.info("step %d/%d loss %.4f", step_i, max_steps, val)
+        self._pending_losses.clear()
 
     def _publish_metrics(self) -> None:
         """Ship the registry snapshot up the agent pipe (relayed to the
@@ -1492,13 +1704,25 @@ class OobleckEngine:
                         elapsed=None if self._recovered_at is None else round(
                             time.monotonic() - self._recovered_at, 3),
                     )
-                self._record_step_metrics(loss, step_s)
+                deferred = isinstance(loss, DeferredLoss)
+                if deferred:
+                    self._pending_losses.append((self.step, loss))
+                self._record_step_metrics(
+                    None if deferred else loss, step_s)
                 if first_after_recovery:
                     # Push at once: the master resolves the in-flight
                     # recovery in /status on the first worker snapshot, and
                     # must not wait out the periodic publish interval.
                     self._publish_metrics()
-                logger.info("step %d/%d loss %.4f", self.step, max_steps, loss)
+                if deferred:
+                    every = self.args.execution.loss_readback_every
+                    if (self.step % every == 0 or self.step >= max_steps
+                            or first_after_recovery):
+                        self._drain_pending_losses(max_steps)
+                else:
+                    self.loss_history.append((self.step, loss))
+                    logger.info("step %d/%d loss %.4f",
+                                self.step, max_steps, loss)
                 if self.step % 10 == 0:
                     timers = sync_timers()
                     wire = (
@@ -1524,6 +1748,7 @@ class OobleckEngine:
             if interval and self.step % interval != 0:
                 self.save_checkpoint()
         finally:
+            self._drain_pending_losses(max_steps)
             self._mirror_flush()
             if self._durable is not None:
                 self._durable.flush()
@@ -2128,11 +2353,15 @@ class OobleckEngine:
             for i in range(len(mb_counts))
         ]
         loaders = [OobleckDataLoader(pool, s) for s in samplers]
-        loss_sum = 0.0
+        # Losses stay on-device through the whole eval sweep (each float()
+        # readback would serialize dispatch); the single drain below
+        # resolves them after every batch's compute is in flight.
+        device_losses: list[tuple[Any, int]] = []
         weight_sum = 0
         for _ in range(max(1, num_batches // len(mb_counts))):
             if self.fused is not None:
-                loss_sum += float(self.fused.eval_step(loaders[0].next_batch()))
+                device_losses.append(
+                    (self.fused.eval_step(loaders[0].next_batch()), 1))
                 weight_sum += 1
             else:
                 for pipe, dl in zip(self.pipelines, loaders):
@@ -2149,8 +2378,9 @@ class OobleckEngine:
                         count_sum += pipe.last_eval_metrics[1]
                     if loss is None:
                         continue  # last stage lives on another process
-                    loss_sum += float(loss) * pipe.num_microbatches
+                    device_losses.append((loss, pipe.num_microbatches))
                     weight_sum += pipe.num_microbatches
+        loss_sum = sum(_host_sync(l) * w for l, w in device_losses)
         self._eval_state = (samplers[0].num_iterations_done, samplers[0].epoch)
         if self.multihost:
             total = self.comm.group_sum(
@@ -2280,6 +2510,9 @@ class OobleckEngine:
         re-instantiate reusing surviving weights + optimizer state and the
         data position."""
         t0 = time.perf_counter()
+        # Deferred losses reference arrays on the pre-failure meshes; read
+        # them back now, while (most of) the backing buffers still exist.
+        self._drain_pending_losses()
         if self.multihost:
             # A lost peer breaks the shared jax.distributed world; the agent
             # respawns the worker over the survivors (live mirrors make the
@@ -2362,6 +2595,15 @@ class OobleckEngine:
         self._fused_hosts = survivors
         self.host_ips.remove(lost_ip)
         self.fused = new_fused
+        # Rebuild the loader from the CONSUMED position: any staged batch
+        # was placed with the dead mesh's sharding, and the stager's
+        # place_fn is bound to the old FusedPipeline.
+        old_dl = self.dataloaders[0]
+        it_done, ep = old_dl.num_iterations_done, old_dl.epoch
+        if hasattr(old_dl, "close"):
+            old_dl.close()
+        self.dataloaders = [self._fused_dataloader(
+            new_fused.num_microbatches, it_done, ep)]
         elapsed = time.perf_counter() - t0
         self.recovery_times.append(elapsed)
         self._m_reconfigs.inc(path="fused")

@@ -261,8 +261,20 @@ class FusedPipeline:
             batch = batch["input_ids"]
         return np.asarray(batch)
 
-    def train_step(self, batch):
-        """batch: {input_ids: [num_microbatches, microbatch, seq]} int32."""
+    def place_batch(self, batch):
+        """Shape + shift + device_put one step's batch ahead of time
+        (DeviceStager runs this on its background thread); the result
+        feeds train_step(placed=...)."""
+        batch = self._tokens_of(batch)
+        assert batch.shape[0] == self.num_microbatches, batch.shape
+        return self._step_fn.prepare(batch.reshape(-1, batch.shape[-1]))
+
+    def train_step(self, batch, placed=None):
+        """batch: {input_ids: [num_microbatches, microbatch, seq]} int32.
+        `placed` (from place_batch) skips host-side input prep entirely."""
+        if placed is not None:
+            self.state, metrics = self._step_fn(self.state, prepared=placed)
+            return metrics.loss
         batch = self._tokens_of(batch)
         assert batch.shape[0] == self.num_microbatches, batch.shape
         tokens = batch.reshape(-1, batch.shape[-1])

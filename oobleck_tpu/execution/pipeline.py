@@ -36,41 +36,49 @@ import numpy as np
 import optax
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
-from oobleck_tpu.execution.schedule import Instruction, Op, all_instructions
+from oobleck_tpu.execution.schedule import (
+    Instruction,
+    Op,
+    all_instructions,
+    send_activation_dest,
+    send_grad_dest,
+    validate_interleaving,
+)
 from oobleck_tpu.planning.templates import PipelineTemplate
 
 logger = logging.getLogger("oobleck.pipeline")
 
 
-_ORDER_CACHE: dict[tuple[int, int], list[Instruction]] = {}
+_ORDER_CACHE: dict[tuple[int, int, int], list[Instruction]] = {}
 
 
-def canonical_order(S: int, M: int) -> list[Instruction]:
+def canonical_order(S: int, M: int, v: int = 1) -> list[Instruction]:
     """The total execution order the dependency-driven greedy interpreter
-    produces for the 1F1B streams — a pure function of (stages,
-    microbatches), so every jax.distributed process derives the IDENTICAL
-    order without communicating. This is what makes cross-process edge
-    collectives deadlock-free: any two processes issue their shared
-    transfers in the same relative order."""
-    key = (S, M)
+    produces for the 1F1B (v=1) or interleaved (v>1) streams — a pure
+    function of (stages, microbatches, virtual stages), so every
+    jax.distributed process derives the IDENTICAL order without
+    communicating. This is what makes cross-process edge collectives
+    deadlock-free: any two processes issue their shared transfers in the
+    same relative order."""
+    key = (S, M, v)
     if key in _ORDER_CACHE:
         return _ORDER_CACHE[key]
-    streams = [deque(s) for s in all_instructions(S, M)]
-    acts: set[tuple[int, int]] = set()
-    gacts: set[tuple[int, int]] = set()
+    streams = [deque(s) for s in all_instructions(S, M, v)]
+    acts: set[tuple[int, int, int]] = set()    # (stage, chunk, mb)
+    gacts: set[tuple[int, int, int]] = set()
     order: list[Instruction] = []
 
     def ready(ins: Instruction) -> bool:
         if ins.op == Op.RECV_ACTIVATION:
-            return (ins.stage, ins.microbatch) in acts
+            return (ins.stage, ins.chunk, ins.microbatch) in acts
         if ins.op == Op.RECV_GRAD:
-            return (ins.stage, ins.microbatch) in gacts
+            return (ins.stage, ins.chunk, ins.microbatch) in gacts
         return True
 
     progress = True
     while any(streams):
         if not progress:
-            pending = [(s[0].op, s[0].stage, s[0].microbatch)
+            pending = [(s[0].op, s[0].stage, s[0].chunk, s[0].microbatch)
                        for s in streams if s]
             raise RuntimeError(f"pipeline schedule deadlock: {pending}")
         progress = False
@@ -79,9 +87,11 @@ def canonical_order(S: int, M: int) -> list[Instruction]:
                 ins = q.popleft()
                 order.append(ins)
                 if ins.op == Op.SEND_ACTIVATION:
-                    acts.add((ins.stage + 1, ins.microbatch))
+                    ds, dc = send_activation_dest(ins.stage, ins.chunk, S)
+                    acts.add((ds, dc, ins.microbatch))
                 elif ins.op == Op.SEND_GRAD:
-                    gacts.add((ins.stage - 1, ins.microbatch))
+                    ds, dc = send_grad_dest(ins.stage, ins.chunk, S)
+                    gacts.add((ds, dc, ins.microbatch))
                 progress = True
     _ORDER_CACHE[key] = order
     return order
@@ -119,12 +129,16 @@ def _project_spec(spec: P, keep: frozenset) -> P:
 @dataclass
 class StageRuntime:
     stage_index: int
-    layer_ids: tuple[int, ...]
+    layer_ids: tuple[int, ...]             # ALL layers on this stage (chunks flattened)
     ranks: tuple[int, ...]
     mesh: Mesh
     batch_sharding: NamedSharding          # [mb, ...] layouts (dim 0 = sample)
     param_shardings: dict[int, Any]        # layer -> NamedSharding tree
     param_pspecs: dict[int, Any]           # layer -> PartitionSpec tree
+    # Contiguous layer ranges per virtual-stage chunk held here. One entry
+    # (== layer_ids) under canonical 1F1B; v entries interleaved, chunk c
+    # being virtual stage c*S + stage_index.
+    chunks: tuple[tuple[int, ...], ...] = ()
     tp: int = 1                            # tensor-parallel degree in-stage
     sp: int = 1                            # sequence-parallel degree in-stage
     use_fsdp: bool = False                 # params + batch sharded over fsdp
@@ -132,9 +146,9 @@ class StageRuntime:
     needs_batch: bool = True               # any layer here reads the batch
     process: int | None = None             # owning process (multi-host MPMD)
     is_local: bool = True                  # this process owns the stage
-    fwd: Callable | None = None
-    bwd: Callable | None = None
-    efwd: Callable | None = None           # eval fwd with task metrics
+    fwd: list[Callable | None] = field(default_factory=list)   # per chunk
+    bwd: list[Callable | None] = field(default_factory=list)   # per chunk
+    efwd: list[Callable | None] = field(default_factory=list)  # eval fwd w/ metrics
 
     @property
     def ctx(self):
@@ -182,6 +196,7 @@ class PipelineInstance:
         process_of_rank: list[int] | None = None,
         comm=None,
         materialize_params: bool = True,
+        virtual_stages: int = 1,
     ):
         """`process_of_rank` + `comm` switch on multi-host MPMD execution:
         stages owned by other jax.distributed processes are skipped locally
@@ -193,7 +208,14 @@ class PipelineInstance:
         `materialize_params=False` builds the full stage layout (meshes,
         shardings, stage fns) without allocating parameter arrays — the
         recovery precompiler instantiates predicted post-failure layouts
-        this way purely to AOT-compile their executables."""
+        this way purely to AOT-compile their executables.
+
+        `virtual_stages` > 1 runs the interleaved-1F1B schedule: the model
+        is split into num_stages * v contiguous chunks, physical stage i
+        holding chunks {c*S + i} — the template's chip assignment per
+        physical stage is kept, its layer partition is superseded by the
+        even v-way split (the template profiled a contiguous S-way cut; an
+        interleaved layout needs S*v cuts)."""
         assert len(ranks) == template.num_chips, (len(ranks), template.num_chips)
         self.pipeline_id = pipeline_id
         self.template = template
@@ -207,9 +229,49 @@ class PipelineInstance:
         self.comm = comm
         self._process_of_rank = process_of_rank
         # Filled by each train_step: per-stage dispatch busy seconds, read
-        # by the engine's measured pipeline-bubble gauge.
+        # by the engine's measured pipeline-bubble gauge; per-op dispatch
+        # durations feed the schedule-replay bubble simulation; dispatch
+        # stall = time spent flushing batched cross-stage device_puts.
         self.last_stage_busy_s: dict[int, float] = {}
+        self.last_op_times: dict[tuple[int, int, str], tuple[float, int]] = {}
+        self.last_dispatch_stall_s: float = 0.0
+        # Opt-in calibration mode: block on each compute's result inside the
+        # timed region so last_op_times records true per-op durations
+        # instead of async-dispatch enqueue times (which absorb upstream
+        # backpressure and misattribute the whole step's drain to whichever
+        # op happens to block). Serializes execution — bench/tests only,
+        # never the training hot path.
+        self.sync_op_timing = False
         my_process = comm.process_index if comm is not None else None
+
+        S = len(template.stages)
+        v = max(1, int(virtual_stages))
+        L = model.num_pipeline_layers
+        if v > 1:
+            validate_interleaving(S, num_microbatches, v)
+            if L < S * v:
+                raise ValueError(
+                    f"interleaved schedule needs at least num_stages * "
+                    f"virtual_stages = {S * v} pipeline layers, model has {L}"
+                )
+        self.virtual_stages = v
+        # chunks_of_stage[i][c] = layer range of virtual stage c*S + i. The
+        # template's layer cut stands when v == 1; interleaving re-cuts the
+        # model into S*v even contiguous ranges (the template only profiled
+        # an S-way cut) while keeping the template's chip assignment.
+        if v == 1:
+            chunks_of_stage = [
+                (tuple(stage.layer_indices),) for stage in template.stages
+            ]
+        else:
+            ranges = np.array_split(np.arange(L), S * v)
+            chunks_of_stage = [
+                tuple(
+                    tuple(int(x) for x in ranges[c * S + i])
+                    for c in range(v)
+                )
+                for i in range(S)
+            ]
 
         tp = max(1, tensor_parallel)
         sp = max(1, sequence_parallel)
@@ -295,6 +357,9 @@ class PipelineInstance:
         self.stages: list[StageRuntime] = []
         cursor = 0
         for si, stage in enumerate(template.stages):
+            stage_layers = tuple(
+                li for ch in chunks_of_stage[si] for li in ch
+            )
             stage_ranks = tuple(self.ranks[cursor:cursor + stage.num_chips])
             cursor += stage.num_chips
             stage_devices = np.array([devices[r] for r in stage_ranks])
@@ -354,7 +419,7 @@ class PipelineInstance:
             )
             param_shardings: dict[int, Any] = {}
             param_pspecs: dict[int, Any] = {}
-            for li in stage.layer_indices:
+            for li in stage_layers:
                 pspecs = jax.tree.map(
                     lambda s: _project_spec(s, keep),
                     spec_tree(li),
@@ -395,17 +460,18 @@ class PipelineInstance:
                 stage_process, stage_local = None, True
             self.stages.append(StageRuntime(
                 stage_index=si,
-                layer_ids=tuple(stage.layer_indices),
+                layer_ids=stage_layers,
                 ranks=stage_ranks,
                 mesh=mesh,
                 batch_sharding=NamedSharding(mesh, batch_spec),
                 param_shardings=param_shardings,
                 param_pspecs=param_pspecs,
+                chunks=chunks_of_stage[si],
                 tp=tp,
                 sp=sp,
                 use_fsdp=use_fsdp,
                 manual=manual,
-                needs_batch=bool(batch_layers & set(stage.layer_indices)),
+                needs_batch=bool(batch_layers & set(stage_layers)),
                 process=stage_process,
                 is_local=stage_local,
             ))
@@ -451,7 +517,9 @@ class PipelineInstance:
 
     # ------------------------------------------------------------------ #
 
-    def _stage_apply(self, st: StageRuntime):
+    def _stage_apply(self, st: StageRuntime, layers: tuple[int, ...]):
+        """Stage program over one chunk's contiguous `layers` (== the whole
+        stage under canonical 1F1B; one of v chunks interleaved)."""
         model = self.model
         last_layer = model.num_pipeline_layers - 1
         remat = bool(getattr(model.config, "remat", False))
@@ -471,7 +539,7 @@ class PipelineInstance:
 
             def apply(params_tuple, x, batch, with_metrics=False):
                 carry = x
-                for li, p in zip(st.layer_ids, params_tuple):
+                for li, p in zip(layers, params_tuple):
                     if li == last_layer:
                         logits = model.apply_layer(li, p, carry, batch)
                         loss = model.loss_from_logits(logits, batch)
@@ -492,8 +560,8 @@ class PipelineInstance:
         # shard_map — the same Megatron f/g + fsdp-gather machinery as the
         # fused SPMD step (parallel/train.py), per stage. Gradient reductions
         # fall out of the shard_map in_spec transposes.
-        is_first = st.layer_ids[0] == 0
-        is_last = st.layer_ids[-1] == last_layer
+        is_first = layers[0] == 0
+        is_last = layers[-1] == last_layer
         batch_axes = (
             (("fsdp",) if ctx.fsdp else ())
             + (("seq",) if ctx.seq else ())
@@ -513,7 +581,7 @@ class PipelineInstance:
             targets = next(it) if is_last else None
             mask = next(it) if is_last else None
             carry = x
-            for li, p in zip(st.layer_ids, params_tuple):
+            for li, p in zip(layers, params_tuple):
                 if li == 0:
                     carry = model.embed(p, tokens, ctx)
                 elif li == last_layer:
@@ -525,7 +593,7 @@ class PipelineInstance:
                     carry = block(p, carry)
             return carry
 
-        in_specs: list[Any] = [tuple(st.param_pspecs[li] for li in st.layer_ids)]
+        in_specs: list[Any] = [tuple(st.param_pspecs[li] for li in layers)]
         if not is_first:
             in_specs.append(x_spec)
         if is_first:
@@ -562,62 +630,73 @@ class PipelineInstance:
         return apply
 
     def _build_stage_fns(self) -> None:
-        """jit each stage's forward and (recomputing) backward, with caching
-        keyed by the stage signature so reconfiguration reuses executables."""
-        S = self.num_stages
+        """jit each chunk's forward and (recomputing) backward, with caching
+        keyed by the chunk signature so reconfiguration reuses executables.
+        Under canonical 1F1B each stage has exactly one chunk and the cache
+        key is the stage signature as before."""
+        S, v = self.num_stages, self.virtual_stages
+        last_vs = S * v - 1
         scale = 1.0 / self.total_num_microbatches
         for st in self.stages:
+            st.fwd = [None] * len(st.chunks)
+            st.bwd = [None] * len(st.chunks)
+            st.efwd = [None] * len(st.chunks)
             if not st.is_local:
                 continue
-            is_first = st.stage_index == 0
-            is_last = st.stage_index == S - 1
-            key = (
-                st.layer_ids, len(st.ranks), tuple(st.ranks),
-                self.microbatch_size, self.seq_len, is_first, is_last,
-                self.total_num_microbatches, st.tp, st.sp, st.use_fsdp,
-            )
-            if key in self._exec_cache:
-                st.fwd, st.bwd, st.efwd = self._exec_cache[key]
-                continue
-            apply = self._stage_apply(st)
-            shardings = tuple(st.param_shardings[li] for li in st.layer_ids)
-
-            def fwd(params_tuple, x, tokens, _apply=apply):
-                return _apply(params_tuple, x, tokens)
-
-            if is_last:
-                # Backward from the loss: d(loss·scale)/d(params, x).
-                def bwd(params_tuple, x, tokens, _apply=apply):
-                    def loss_fn(pt, x_):
-                        return _apply(pt, x_, tokens) * scale
-
-                    if x is None:
-                        grads = jax.grad(lambda pt: loss_fn(pt, None))(params_tuple)
-                        return grads, None
-                    grads, dx = jax.grad(loss_fn, argnums=(0, 1))(params_tuple, x)
-                    return grads, dx
-            else:
-                def bwd(params_tuple, x, tokens, dy, _apply=apply):
-                    if x is None:
-                        # First stage: differentiate wrt params only.
-                        _, vjp = jax.vjp(lambda pt: _apply(pt, None, tokens),
-                                         params_tuple)
-                        (grads,) = vjp(dy)
-                        return grads, None
-                    _, vjp = jax.vjp(lambda pt, x_: _apply(pt, x_, tokens),
-                                     params_tuple, x)
-                    grads, dx = vjp(dy)
-                    return grads, dx
-
-            st.fwd = jax.jit(fwd)
-            st.bwd = jax.jit(bwd)
-            if (is_last and st.ctx is None
-                    and hasattr(self.model, "accuracy_from_logits")):
-                st.efwd = jax.jit(
-                    lambda params_tuple, x, tokens, _apply=apply:
-                    _apply(params_tuple, x, tokens, with_metrics=True)
+            for c, chunk_layers in enumerate(st.chunks):
+                vs = c * S + st.stage_index
+                is_first = vs == 0
+                is_last = vs == last_vs
+                key = (
+                    chunk_layers, len(st.ranks), tuple(st.ranks),
+                    self.microbatch_size, self.seq_len, is_first, is_last,
+                    self.total_num_microbatches, st.tp, st.sp, st.use_fsdp,
                 )
-            self._exec_cache[key] = (st.fwd, st.bwd, st.efwd)
+                if key in self._exec_cache:
+                    st.fwd[c], st.bwd[c], st.efwd[c] = self._exec_cache[key]
+                    continue
+                apply = self._stage_apply(st, chunk_layers)
+
+                def fwd(params_tuple, x, tokens, _apply=apply):
+                    return _apply(params_tuple, x, tokens)
+
+                if is_last:
+                    # Backward from the loss: d(loss·scale)/d(params, x).
+                    def bwd(params_tuple, x, tokens, _apply=apply):
+                        def loss_fn(pt, x_):
+                            return _apply(pt, x_, tokens) * scale
+
+                        if x is None:
+                            grads = jax.grad(
+                                lambda pt: loss_fn(pt, None))(params_tuple)
+                            return grads, None
+                        grads, dx = jax.grad(
+                            loss_fn, argnums=(0, 1))(params_tuple, x)
+                        return grads, dx
+                else:
+                    def bwd(params_tuple, x, tokens, dy, _apply=apply):
+                        if x is None:
+                            # First chunk: differentiate wrt params only.
+                            _, vjp = jax.vjp(
+                                lambda pt: _apply(pt, None, tokens),
+                                params_tuple)
+                            (grads,) = vjp(dy)
+                            return grads, None
+                        _, vjp = jax.vjp(
+                            lambda pt, x_: _apply(pt, x_, tokens),
+                            params_tuple, x)
+                        grads, dx = vjp(dy)
+                        return grads, dx
+
+                st.fwd[c] = jax.jit(fwd)
+                st.bwd[c] = jax.jit(bwd)
+                if (is_last and st.ctx is None
+                        and hasattr(self.model, "accuracy_from_logits")):
+                    st.efwd[c] = jax.jit(
+                        lambda params_tuple, x, tokens, _apply=apply:
+                        _apply(params_tuple, x, tokens, with_metrics=True)
+                    )
+                self._exec_cache[key] = (st.fwd[c], st.bwd[c], st.efwd[c])
 
     # ------------------------------------------------------------------ #
 
@@ -654,60 +733,103 @@ class PipelineInstance:
         """Whether this process owns any stage of this pipeline."""
         return any(st.is_local for st in self.stages)
 
-    def _edge_aval(self, src_stage: int):
-        """Static aval of the activation flowing from src_stage to
-        src_stage+1 (gradients mirror it)."""
+    def _edge_aval(self, src_last_layer: int):
+        """Static aval of the activation flowing out of the chunk whose last
+        layer is src_last_layer (gradients mirror it)."""
         if self._act_avals is None:
             from oobleck_tpu.parallel.cross_host import activation_avals
 
             self._act_avals = activation_avals(
                 self.model, self.microbatch_size, self.seq_len
             )
-        return self._act_avals[self.stages[src_stage].layer_ids[-1]]
+        return self._act_avals[src_last_layer]
 
     def _move_edge(self, value, src: StageRuntime, dst: StageRuntime,
-                   aval_stage: int):
-        """Move an activation/gradient across a stage edge. Same-process:
-        a device_put between sub-meshes (ICI path). Cross-process: a
-        2-process collective (parallel/cross_host.ProcessComm.send).
-        Returns the value placed on dst's batch sharding, or None when this
-        process does not own dst."""
+                   aval_layer: int):
+        """Move an activation/gradient across a virtual-stage edge.
+        Same-process: a device_put between sub-meshes (ICI path).
+        Cross-process: a 2-process collective
+        (parallel/cross_host.ProcessComm.send). Returns the value placed on
+        dst's batch sharding, or None when this process does not own dst.
+        aval_layer is the last layer of the chunk PRODUCING the value (the
+        gradient for a chunk's input has the shape of the previous chunk's
+        output)."""
         if src.is_local and dst.is_local:
             return jax.device_put(value, dst.batch_sharding)
         received = self.comm.send(
             value if src.is_local else None,
-            src.process, dst.process, self._edge_aval(aval_stage),
+            src.process, dst.process, self._edge_aval(aval_layer),
         )
         if dst.is_local:
             return jax.device_put(received, dst.batch_sharding)
         return None
 
-    def train_step(self, batch):
+    def train_step(self, batch, placed=None):
         """One iteration over this pipeline's microbatches.
 
         batch: {field: [num_microbatches, microbatch_size, ...]} (or a bare
-        token array for causal LM). Fills self.grads (sum over microbatches,
-        scaled by 1/total global microbatches) and returns the mean loss
-        over this pipeline's microbatches as a device scalar.
+        token array for causal LM). `placed` optionally carries the batch
+        already staged on-device by a DeviceStager (the per-stage dict
+        _place_batch returns), taking the device_put off the critical path.
+        Fills self.grads (sum over microbatches, scaled by 1/total global
+        microbatches) and returns the mean loss over this pipeline's
+        microbatches as a device scalar.
         """
         batch = self._as_batch_dict(batch)
         S, M = self.num_stages, self.num_microbatches
+        v = self.virtual_stages
+        last_vs = S * v - 1
         assert next(iter(batch.values())).shape[0] == M
-        placed, _ = self._place_batch(batch)
+        if placed is None:
+            # No DeviceStager staged this batch ahead of time
+            # (execution/dataloader.py) — place on the critical path.
+            placed, _ = self._place_batch(batch)
 
-        acts: dict[tuple[int, int], Any] = {}    # (stage, mb) -> input act
-        gacts: dict[tuple[int, int], Any] = {}   # (stage, mb) -> output grad
-        stash: dict[tuple[int, int], Any] = {}   # forward input stash for bwd
+        # All transient state keyed (stage, chunk, mb).
+        acts: dict[tuple, Any] = {}    # chunk input activations
+        gacts: dict[tuple, Any] = {}   # chunk output gradients
+        stash: dict[tuple, Any] = {}   # forward input stash for bwd
         losses: list[Any] = []
         grads: dict[int, Any] = {}
         # Per-stage dispatch busy time this step, for the engine's measured
-        # pipeline-bubble gauge. Wall-clock around the fwd/bwd dispatch:
-        # exact on CPU (synchronous), a dispatch-cost floor under async
-        # device execution.
+        # pipeline-bubble gauge, plus per-(stage, chunk, op) durations for
+        # the schedule-replay simulation. Wall-clock around the fwd/bwd
+        # dispatch: exact on CPU (synchronous), a dispatch-cost floor under
+        # async device execution.
         stage_busy: dict[int, float] = {}
+        op_times: dict[tuple[int, int, str], tuple[float, int]] = {}
+        dispatch_stall = 0.0
 
-        def params_of(st):
-            return tuple(self.params[li] for li in st.layer_ids)
+        def record_op(stage, chunk, kind, dt):
+            tot, n = op_times.get((stage, chunk, kind), (0.0, 0))
+            op_times[(stage, chunk, kind)] = (tot + dt, n + 1)
+            stage_busy[stage] = stage_busy.get(stage, 0.0) + dt
+
+        def chunk_params(st, c):
+            return tuple(self.params[li] for li in st.chunks[c])
+
+        # Same-process cross-stage transfers are batched: consecutive SEND
+        # instructions in the canonical order accumulate here and flush as
+        # ONE jax.device_put(list, list) right before the next compute
+        # dispatch needs them — one transfer program per tick instead of a
+        # put per edge (the DataParallelEngine's pack trick, applied to the
+        # pipeline hot path). The device_put itself is async; nothing
+        # blocks on transfer completion.
+        pending_sends: list[tuple[Any, Any, dict, tuple]] = []
+
+        def flush_sends():
+            nonlocal dispatch_stall
+            if not pending_sends:
+                return
+            t0 = time.perf_counter()
+            moved = jax.device_put(
+                [p[0] for p in pending_sends],
+                [p[1] for p in pending_sends],
+            )
+            for (_, _, store, key), mv in zip(pending_sends, moved):
+                store[key] = mv
+            pending_sends.clear()
+            dispatch_stall += time.perf_counter() - t0
 
         # Microbatch gradient accumulation as ONE jitted add per stage per
         # microbatch (jit specializes per treedef/shape/sharding): eager
@@ -719,22 +841,23 @@ class PipelineInstance:
             add_fn = jax.jit(lambda a, b: jax.tree.map(jnp.add, a, b))
             self._exec_cache["grad_add"] = add_fn
 
-        def accumulate(st, stage_grads):
-            if st.layer_ids[0] in grads:
-                prev = tuple(grads[li] for li in st.layer_ids)
+        def accumulate(chunk_layers, stage_grads):
+            if chunk_layers[0] in grads:
+                prev = tuple(grads[li] for li in chunk_layers)
                 summed = add_fn(prev, tuple(stage_grads))
-                for li, g in zip(st.layer_ids, summed):
+                for li, g in zip(chunk_layers, summed):
                     grads[li] = g
             else:
-                for li, g in zip(st.layer_ids, stage_grads):
+                for li, g in zip(chunk_layers, stage_grads):
                     grads[li] = g
 
         def execute(ins: Instruction) -> None:
             st = self.stages[ins.stage]
-            m = ins.microbatch
-            key = (ins.stage, m)
-            is_first = ins.stage == 0
-            is_last = ins.stage == S - 1
+            m, c = ins.microbatch, ins.chunk
+            key = (ins.stage, c, m)
+            vs = c * S + ins.stage
+            is_first = vs == 0
+            is_last = vs == last_vs
             stage_batch = placed[ins.stage]
             if ins.op in (Op.LOAD_MICROBATCH, Op.RECV_ACTIVATION,
                           Op.RECV_GRAD):
@@ -742,59 +865,85 @@ class PipelineInstance:
             elif ins.op == Op.FORWARD:
                 if not st.is_local:
                     return
+                flush_sends()
                 x = None if is_first else acts[key]
                 mb = stage_batch[m] if stage_batch is not None else None
+                if self.sync_op_timing and x is not None:
+                    jax.block_until_ready(x)  # exclude upstream wait
                 t0 = time.perf_counter()
-                out = st.fwd(params_of(st), x, mb)
-                stage_busy[ins.stage] = (stage_busy.get(ins.stage, 0.0)
-                                         + time.perf_counter() - t0)
+                out = st.fwd[c](chunk_params(st, c), x, mb)
+                if self.sync_op_timing:
+                    jax.block_until_ready(out)
+                record_op(ins.stage, c, "f", time.perf_counter() - t0)
                 stash[key] = x
                 if is_last:
                     losses.append(out)
                 else:
-                    stash[(ins.stage, m, "out")] = out
+                    stash[(ins.stage, c, m, "out")] = out
             elif ins.op == Op.SEND_ACTIVATION:
-                nxt = self.stages[ins.stage + 1]
+                ds, dc = send_activation_dest(ins.stage, c, S)
+                nxt = self.stages[ds]
                 if not (st.is_local or nxt.is_local):
                     return
-                y = stash.pop((ins.stage, m, "out"), None)
-                moved = self._move_edge(y, st, nxt, aval_stage=ins.stage)
+                y = stash.pop((ins.stage, c, m, "out"), None)
+                aval_layer = st.chunks[c][-1]
+                if st.is_local and nxt.is_local:
+                    pending_sends.append(
+                        (y, nxt.batch_sharding, acts, (ds, dc, m)))
+                    return
+                moved = self._move_edge(y, st, nxt, aval_layer=aval_layer)
                 if moved is not None:
-                    acts[(ins.stage + 1, m)] = moved
+                    acts[(ds, dc, m)] = moved
             elif ins.op == Op.BACKWARD:
                 if not st.is_local:
                     return
+                flush_sends()
                 x = stash.pop(key)
                 mb = stage_batch[m] if stage_batch is not None else None
+                if self.sync_op_timing:
+                    dy_wait = gacts.get(key)
+                    if dy_wait is not None:
+                        jax.block_until_ready(dy_wait)
                 t0 = time.perf_counter()
                 if is_last:
-                    stage_grads, dx = st.bwd(params_of(st), x, mb)
+                    stage_grads, dx = st.bwd[c](chunk_params(st, c), x, mb)
                 else:
                     dy = gacts.pop(key)
-                    stage_grads, dx = st.bwd(params_of(st), x, mb, dy)
-                stage_busy[ins.stage] = (stage_busy.get(ins.stage, 0.0)
-                                         + time.perf_counter() - t0)
-                accumulate(st, stage_grads)
+                    stage_grads, dx = st.bwd[c](chunk_params(st, c), x, mb, dy)
+                if self.sync_op_timing:
+                    jax.block_until_ready(stage_grads)
+                record_op(ins.stage, c, "b", time.perf_counter() - t0)
+                accumulate(st.chunks[c], stage_grads)
                 if dx is not None:
-                    stash[(ins.stage, m, "dx")] = dx
+                    stash[(ins.stage, c, m, "dx")] = dx
                 acts.pop(key, None)
             elif ins.op == Op.SEND_GRAD:
-                prev = self.stages[ins.stage - 1]
+                ds, dc = send_grad_dest(ins.stage, c, S)
+                prev = self.stages[ds]
                 if not (st.is_local or prev.is_local):
                     return
-                dx = stash.pop((ins.stage, m, "dx"), None)
-                moved = self._move_edge(dx, st, prev,
-                                        aval_stage=ins.stage - 1)
+                dx = stash.pop((ins.stage, c, m, "dx"), None)
+                # The gradient entering chunk (ins.stage, c) has the shape
+                # of the PRODUCING chunk's output activation.
+                aval_layer = prev.chunks[dc][-1]
+                if st.is_local and prev.is_local:
+                    pending_sends.append(
+                        (dx, prev.batch_sharding, gacts, (ds, dc, m)))
+                    return
+                moved = self._move_edge(dx, st, prev, aval_layer=aval_layer)
                 if moved is not None:
-                    gacts[(ins.stage - 1, m)] = moved
+                    gacts[(ds, dc, m)] = moved
 
         # Execute the canonical total order (identical on every process;
         # dependency-valid by construction — see canonical_order).
-        for ins in canonical_order(S, M):
+        for ins in canonical_order(S, M, v):
             execute(ins)
+        flush_sends()
 
         self.grads = grads
         self.last_stage_busy_s = stage_busy
+        self.last_op_times = op_times
+        self.last_dispatch_stall_s = dispatch_stall
         if not losses:
             return None  # last stage lives on another process
         loss = sum(losses[1:], start=losses[0]) / len(losses)
@@ -806,34 +955,37 @@ class PipelineInstance:
         """Forward-only loss over this pipeline's microbatches (no backward
         instructions, no gradient memory); returns the mean loss."""
         batch = self._as_batch_dict(batch)
-        S = self.num_stages
+        S, v = self.num_stages, self.virtual_stages
+        last_vs = S * v - 1
         placed, M = self._place_batch(batch)
         losses = []
         correct = count = None
         for m in range(M):
             x = None
-            for st in self.stages:
-                is_last = st.stage_index == S - 1
+            for vs in range(S * v):
+                st = self.stages[vs % S]
+                c = vs // S
+                is_last = vs == last_vs
                 out = None
                 if st.is_local:
                     stage_batch = placed[st.stage_index]
                     mb = stage_batch[m] if stage_batch is not None else None
-                    params = tuple(self.params[li] for li in st.layer_ids)
-                    if is_last and st.efwd is not None:
-                        loss, c, n = st.efwd(params, x, mb)
-                        correct = c if correct is None else correct + c
-                        count = n if count is None else count + n
+                    params = tuple(self.params[li] for li in st.chunks[c])
+                    if is_last and st.efwd[c] is not None:
+                        loss, cc, nn = st.efwd[c](params, x, mb)
+                        correct = cc if correct is None else correct + cc
+                        count = nn if count is None else count + nn
                         out = loss
                     else:
-                        out = st.fwd(params, x, mb)
+                        out = st.fwd[c](params, x, mb)
                 if is_last:
                     if st.is_local:
                         losses.append(out)
                 else:
-                    nxt = self.stages[st.stage_index + 1]
+                    nxt = self.stages[(vs + 1) % S]
                     if st.is_local or nxt.is_local:
                         x = self._move_edge(out, st, nxt,
-                                            aval_stage=st.stage_index)
+                                            aval_layer=st.chunks[c][-1])
                     else:
                         x = None
         self.last_eval_metrics = (

@@ -216,6 +216,14 @@ class RecoveryPrecompiler:
                     pipeline_id=a.pipeline_index,
                     template=a.template,
                     ranks=list(a.ranks),
+                    # Same interleave-or-fallback decision reconfigure()
+                    # will make for this plan (record=False: a predicted
+                    # fallback is not an event) — required for the chunked
+                    # exec-cache keys to match at failure time.
+                    virtual_stages=engine._effective_virtual_stages(
+                        a.template.num_stages, a.num_microbatches,
+                        a.pipeline_index, record=False,
+                    ),
                     model=engine.model,
                     devices=engine.devices,
                     num_microbatches=a.num_microbatches,
@@ -242,33 +250,43 @@ class RecoveryPrecompiler:
     # -- per-stage AOT -------------------------------------------------- #
 
     def _aot_pipeline(self, pipe) -> None:
-        S = pipe.num_stages
+        S, v = pipe.num_stages, pipe.virtual_stages
+        last_vs = S * v - 1
         for st in pipe.stages:
             if self._cancel.is_set():
                 return
-            if not st.is_local or st.fwd is None:
+            if not st.is_local or not st.fwd:
                 continue
-            is_first = st.stage_index == 0
-            is_last = st.stage_index == S - 1
-            key = (
-                st.layer_ids, len(st.ranks), tuple(st.ranks),
-                pipe.microbatch_size, pipe.seq_len, is_first, is_last,
-                pipe.total_num_microbatches, st.tp, st.sp, st.use_fsdp,
-            )
-            if key in self._done_keys:
-                self.stats["stages_cached"] += 1
-                continue
-            try:
-                self._aot_stage(pipe, st, is_last)
-                self._done_keys.add(key)
-            except Exception:
-                self.stats["errors"] += 1
-                logger.exception(
-                    "AOT compile failed for stage %d (layers %s, ranks %s)",
-                    st.stage_index, list(st.layer_ids), list(st.ranks),
+            for c, chunk_layers in enumerate(st.chunks):
+                if self._cancel.is_set():
+                    return
+                vs = c * S + st.stage_index
+                is_first = vs == 0
+                is_last = vs == last_vs
+                # Byte-identical to the chunk signature _build_stage_fns
+                # keys the shared exec cache with.
+                key = (
+                    chunk_layers, len(st.ranks), tuple(st.ranks),
+                    pipe.microbatch_size, pipe.seq_len, is_first, is_last,
+                    pipe.total_num_microbatches, st.tp, st.sp, st.use_fsdp,
                 )
+                if key in self._done_keys:
+                    self.stats["stages_cached"] += 1
+                    continue
+                try:
+                    self._aot_chunk(pipe, st, c, chunk_layers,
+                                    is_first, is_last)
+                    self._done_keys.add(key)
+                except Exception:
+                    self.stats["errors"] += 1
+                    logger.exception(
+                        "AOT compile failed for stage %d chunk %d "
+                        "(layers %s, ranks %s)",
+                        st.stage_index, c, list(chunk_layers), list(st.ranks),
+                    )
 
-    def _aot_stage(self, pipe, st, is_last: bool) -> None:
+    def _aot_chunk(self, pipe, st, c: int, chunk_layers,
+                   is_first: bool, is_last: bool) -> None:
         rng = jax.random.PRNGKey(0)
         params_avals = tuple(
             jax.tree.map(
@@ -279,32 +297,34 @@ class RecoveryPrecompiler:
                                rng),
                 st.param_shardings[li],
             )
-            for li in st.layer_ids
+            for li in chunk_layers
         )
         x_aval = None
-        if st.stage_index > 0:
+        if not is_first:
+            # Chunks are globally contiguous in virtual-stage order, so the
+            # producing chunk's last layer is chunk_layers[0] - 1.
             x_aval = jax.tree.map(
                 lambda a: _sds(a, st.batch_sharding),
-                pipe._edge_aval(st.stage_index - 1),
+                pipe._edge_aval(chunk_layers[0] - 1),
             )
         mb_aval = None
         if st.needs_batch:
             sample = pipe.model.sample_batch(pipe.microbatch_size, pipe.seq_len)
             mb_aval = {k: _sds(v, st.batch_sharding) for k, v in sample.items()}
 
-        st.fwd.lower(params_avals, x_aval, mb_aval).compile()
+        st.fwd[c].lower(params_avals, x_aval, mb_aval).compile()
         self.stats["stages_compiled"] += 1
         if is_last:
-            st.bwd.lower(params_avals, x_aval, mb_aval).compile()
+            st.bwd[c].lower(params_avals, x_aval, mb_aval).compile()
         else:
             dy_aval = jax.tree.map(
                 lambda a: _sds(a, st.batch_sharding),
-                pipe._edge_aval(st.stage_index),
+                pipe._edge_aval(chunk_layers[-1]),
             )
-            st.bwd.lower(params_avals, x_aval, mb_aval, dy_aval).compile()
+            st.bwd[c].lower(params_avals, x_aval, mb_aval, dy_aval).compile()
         self.stats["stages_compiled"] += 1
-        if st.efwd is not None:
-            st.efwd.lower(params_avals, x_aval, mb_aval).compile()
+        if st.efwd[c] is not None:
+            st.efwd[c].lower(params_avals, x_aval, mb_aval).compile()
             self.stats["stages_compiled"] += 1
 
         # Aux programs, best-effort (small next to a stage fwd+bwd, but the
@@ -312,11 +332,11 @@ class RecoveryPrecompiler:
         # microbatch grad accumulation and the per-layer optimizer update.
         try:
             self._aot_grad_add(params_avals)
-            self._aot_opt_update(st, params_avals)
+            self._aot_opt_update(chunk_layers, st, params_avals)
         except Exception:
             self.stats["errors"] += 1
-            logger.debug("aux AOT warm failed for stage %d", st.stage_index,
-                         exc_info=True)
+            logger.debug("aux AOT warm failed for stage %d chunk %d",
+                         st.stage_index, c, exc_info=True)
 
     def _aot_grad_add(self, params_avals) -> None:
         cache = self.engine._exec_cache
@@ -333,7 +353,7 @@ class RecoveryPrecompiler:
         self._done_keys.add(key)
         self.stats["aux_compiled"] += 1
 
-    def _aot_opt_update(self, st, params_avals) -> None:
+    def _aot_opt_update(self, layer_ids, st, params_avals) -> None:
         import optax
 
         from jax.sharding import NamedSharding, PartitionSpec
@@ -349,7 +369,7 @@ class RecoveryPrecompiler:
             fn = jax.jit(upd)
             cache[("opt_update", id(optimizer))] = fn
         replicated_of = {}
-        for li, p_aval in zip(st.layer_ids, params_avals):
+        for li, p_aval in zip(layer_ids, params_avals):
             key = ("opt_update",
                    tuple(str(a) for a in jax.tree.leaves(p_aval)))
             if key in self._done_keys:

@@ -309,18 +309,39 @@ def build_train_step(model, mesh, *, num_microbatches: int, optimizer=None,
             )
         return host_arrays
 
-    def wrapped_step(state, tokens):
+    def prepare_tokens(tokens):
+        """Everything wrapped_step does before dispatching the compiled
+        program: reshape, host-side target shift, globalize, and (single
+        process) an async device_put onto the token sharding. Safe to run
+        on a background thread (the DeviceStager), so by the time the
+        train loop calls the step the inputs are already in flight to the
+        devices."""
+        tokens = np.asarray(tokens)
         b, seq = tokens.shape
         assert b % num_mb == 0, f"batch {b} not divisible by {num_mb} microbatches"
         assert seq % sp == 0, f"seq {seq} not divisible by seq-parallel {sp}"
-        tokens_mb = np.asarray(tokens).reshape(num_mb, b // num_mb, seq)
+        tokens_mb = tokens.reshape(num_mb, b // num_mb, seq)
         tokens_mb, targets_mb = _global_arrays(tokens_mb,
                                                shift_targets(tokens_mb))
+        if jax.process_count() == 1:
+            # numpy inputs would otherwise be copied host->device inside
+            # the jit dispatch; device_put here starts the transfer early
+            # and does not block on its completion.
+            tokens_mb, targets_mb = jax.device_put(
+                [tokens_mb, targets_mb], [token_sharding, token_sharding]
+            )
+        return tokens_mb, targets_mb
+
+    def wrapped_step(state, tokens=None, prepared=None):
+        if prepared is None:
+            prepared = prepare_tokens(tokens)
+        tokens_mb, targets_mb = prepared
         return jit_step(state, tokens_mb, targets_mb)
 
     wrapped_step.jitted = jit_step
     wrapped_step.loss_fn = loss_fn
     wrapped_step.globalize = _global_arrays
+    wrapped_step.prepare = prepare_tokens
     wrapped_step.state_shardings = state_shardings
     wrapped_step.token_sharding = token_sharding
     return jit_init, wrapped_step

@@ -177,19 +177,24 @@ class _DCResult:
         return self.stages[self.kstar].latency
 
     @classmethod
-    def base(cls, stage: StageSpec) -> "_DCResult":
+    def base(cls, stage: StageSpec, virtual_stages: int = 1) -> "_DCResult":
         lat = stage.latency
-        return cls(t1=lat, t2=2 * lat, t3=lat, kstar=0, stages=(stage,))
+        return cls(t1=lat, t2=(2 / virtual_stages) * lat, t3=lat, kstar=0,
+                   stages=(stage,))
 
     @classmethod
-    def combine(cls, left: "_DCResult", right: "_DCResult") -> "_DCResult":
+    def combine(cls, left: "_DCResult", right: "_DCResult",
+                virtual_stages: int = 1) -> "_DCResult":
         if left.kstar_latency > right.kstar_latency:
             kstar = left.kstar
         else:
             kstar = right.kstar + len(left.stages)
         t1 = left.t1 + right.t1
         num_stages = len(left.stages) + len(right.stages)
-        mb_factor = 2 * num_stages + kstar + 1
+        # The 2·S ramp term is the schedule's warmup+drain bubble; the
+        # interleaved schedule runs it on 1/v-sized model chunks, so it
+        # shrinks by the virtual-stage degree (bubble (S-1)/(v·M+S-1)).
+        mb_factor = 2 * num_stages / virtual_stages + kstar + 1
         if kstar == left.kstar:
             t2 = mb_factor * left.kstar_latency
             t3 = sum(s.latency for s in left.stages[left.kstar:]) + \
@@ -217,11 +222,16 @@ class TemplateGenerator:
         profiles: list[LayerProfile],
         num_hosts: tuple[int, int],
         chips_per_host: int,
+        virtual_stages: int = 1,
     ) -> list[PipelineTemplate]:
         """One min-cost template per feasible host count in
         [num_hosts[0], num_hosts[1]] (reference pipeline_template.cpp:82-161).
+
+        virtual_stages > 1 evaluates the cost model under the interleaved
+        schedule (warmup/drain ramp divided by v) — python engine only,
+        since the C++ planner predates the interleaved schedule.
         """
-        if self.engine in ("auto", "native"):
+        if self.engine in ("auto", "native") and virtual_stages == 1:
             try:
                 from oobleck_tpu.planning import _native
 
@@ -231,25 +241,29 @@ class TemplateGenerator:
             except Exception:
                 if self.engine == "native":
                     raise
-        return _python_create_templates(profiles, num_hosts, chips_per_host)
+        return _python_create_templates(profiles, num_hosts, chips_per_host,
+                                        virtual_stages)
 
 
 def _python_create_templates(
     profiles: list[LayerProfile],
     num_hosts: tuple[int, int],
     chips_per_host: int,
+    virtual_stages: int = 1,
 ) -> list[PipelineTemplate]:
     lo, hi = num_hosts
     num_layers = len(profiles)
     templates = []
     # One memo across every host count: keys include num_hosts, and multi-host
     # splits recurse into smaller host counts, so sharing is both safe and a
-    # large win (the reference shares one dc_cache_ the same way).
+    # large win (the reference shares one dc_cache_ the same way). The
+    # virtual-stage degree is fixed per call, so it stays out of the key.
     memo: dict = {}
     for n in range(lo, hi + 1):
         best: _DCResult | None = None
         for num_stages in range(n, num_layers + 1):
-            r = _dc(profiles, 0, num_layers, num_stages, n, chips_per_host, memo)
+            r = _dc(profiles, 0, num_layers, num_stages, n, chips_per_host,
+                    memo, virtual_stages)
             if r is not None and (best is None or r.t < best.t):
                 best = r
         if best is None:
@@ -260,7 +274,8 @@ def _python_create_templates(
     return templates
 
 
-def _dc(profiles, start, end, num_stages, num_hosts, chips_per_host, memo):
+def _dc(profiles, start, end, num_stages, num_hosts, chips_per_host, memo,
+        virtual_stages: int = 1):
     """Reference divide_and_conquer (pipeline_template.cpp:166-339)."""
     key = (num_stages, start, end, num_hosts, chips_per_host)
     if key in memo:
@@ -284,7 +299,7 @@ def _dc(profiles, start, end, num_stages, num_hosts, chips_per_host, memo):
     # Base case
     if num_stages == 1:
         stage = StageSpec.build(profiles, start, end, chips_per_host)
-        result = _DCResult.base(stage)
+        result = _DCResult.base(stage, virtual_stages)
         memo[key] = result
         return result
 
@@ -296,24 +311,26 @@ def _dc(profiles, start, end, num_stages, num_hosts, chips_per_host, memo):
             if half * 2 != chips_per_host or half == 0:
                 continue
             for s_left in range(1, num_stages):
-                left = _dc(profiles, start, k, s_left, 1, half, memo)
+                left = _dc(profiles, start, k, s_left, 1, half, memo,
+                           virtual_stages)
                 right = _dc(profiles, k, end, num_stages - s_left, 1,
-                            chips_per_host - half, memo)
+                            chips_per_host - half, memo, virtual_stages)
                 if left is None or right is None:
                     continue
-                cand = _DCResult.combine(left, right)
+                cand = _DCResult.combine(left, right, virtual_stages)
                 if best is None or cand.t < best.t:
                     best = cand
         else:
             for h_left in range(1, num_hosts):
                 for s_left in range(1, num_stages):
                     left = _dc(profiles, start, k, s_left, h_left,
-                               chips_per_host, memo)
+                               chips_per_host, memo, virtual_stages)
                     right = _dc(profiles, k, end, num_stages - s_left,
-                                num_hosts - h_left, chips_per_host, memo)
+                                num_hosts - h_left, chips_per_host, memo,
+                                virtual_stages)
                     if left is None or right is None:
                         continue
-                    cand = _DCResult.combine(left, right)
+                    cand = _DCResult.combine(left, right, virtual_stages)
                     if best is None or cand.t < best.t:
                         best = cand
 
