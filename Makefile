@@ -2,7 +2,7 @@
 
 PY ?= python
 
-.PHONY: lint test test-slow tier1 bench ckpt-bench serve-bench pipeline-bench
+.PHONY: lint test test-slow tier1 bench ckpt-bench serve-bench pipeline-bench degrade-bench
 
 # Lint via ruff (config in pyproject.toml). Degrades to a skip when ruff
 # is not installed — the hermetic CI image does not ship it, and the gate
@@ -49,3 +49,11 @@ pipeline-bench:
 	JAX_PLATFORMS=cpu _OOBLECK_BENCH_PIPELINE=1 \
 		XLA_FLAGS="--xla_force_host_platform_device_count=2" \
 		$(PY) bench.py
+
+# Degraded-mode recovery microbench: reroute vs template re-instantiation
+# recovery-to-next-step latency + throughput retention on 4 virtual CPU
+# devices (2 hosts x 2 chips; also under bench.py's "degrade" key).
+degrade-bench:
+	JAX_PLATFORMS=cpu OOBLECK_METRICS_DIR= \
+		XLA_FLAGS="--xla_force_host_platform_device_count=4" \
+		$(PY) -m oobleck_tpu.degrade.bench

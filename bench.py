@@ -17,10 +17,18 @@ Budget layout (wall-clock caps, enforced with subprocess timeouts):
                                           comparison under "pipeline" (2
                                           virtual CPU devices; same
                                           never-on-the-relay rule)
+  degrade : 300 s CPU subprocess       -> degraded-mode recovery microbench
+                                          under "degrade" (reroute vs
+                                          re-instantiation, 4 virtual CPU
+                                          devices; ~2 min measured, the cap
+                                          covers a loaded machine)
 When the TPU is unreachable the emitted value is the last good TPU
 measurement from BENCH_BASELINE.json (clearly noted), with the CPU proxy's
 number in the note; if even that file is missing, the CPU proxy value is
-emitted. Every path ends in one JSON line on stdout.
+emitted. Every path ends in one JSON line on stdout, and every section of
+that line carries explicit staleness provenance: `stale` is always present
+(never implied by absence), and `stale_from` names the run a replayed
+number was measured in (null for fresh measurements).
 
 A wedged axon TPU relay hangs every dispatch inside native PJRT code
 (uninterruptible from Python), so all device contact happens in throwaway
@@ -417,6 +425,42 @@ def _pipeline_summary() -> dict:
         return {"error": f"unparseable pipeline bench output: {exc}"}
 
 
+DEGRADE_BENCH_TIMEOUT_S = 300
+
+
+def _degrade_summary() -> dict:
+    """Degraded-mode recovery microbench (oobleck_tpu/degrade/bench.py) in
+    a throwaway CPU subprocess with 4 virtual devices (2 hosts x 2 chips:
+    the smallest rig with a DP peer to reroute onto). Never on the TPU
+    relay — it deliberately kills and rebuilds engines, and its respawn
+    arm forks a second JAX process."""
+    env = dict(os.environ)
+    env.update({
+        "JAX_PLATFORMS": "cpu", "PALLAS_AXON_POOL_IPS": "",
+        "OOBLECK_METRICS_DIR": "",
+        "XLA_FLAGS": (env.get("XLA_FLAGS", "")
+                      + " --xla_force_host_platform_device_count=4").strip(),
+    })
+    env.pop(_INNER_ENV, None)
+    env.pop(_PIPELINE_ENV, None)
+    proc = subprocess.Popen(
+        [sys.executable, "-m", "oobleck_tpu.degrade.bench"],
+        stdout=subprocess.PIPE, stderr=subprocess.PIPE, text=True, env=env)
+    try:
+        out, err = proc.communicate(timeout=DEGRADE_BENCH_TIMEOUT_S)
+    except subprocess.TimeoutExpired:
+        proc.kill()
+        return {"error": f"degrade bench hung >{DEGRADE_BENCH_TIMEOUT_S}s"}
+    if proc.returncode != 0:
+        tail = (err or "").strip().splitlines()[-1:] or ["no stderr"]
+        return {"error":
+                f"degrade bench exit {proc.returncode}: {tail[0][:160]}"}
+    try:
+        return json.loads(out.strip().splitlines()[-1])
+    except Exception as exc:  # noqa: BLE001
+        return {"error": f"unparseable degrade bench output: {exc}"}
+
+
 SERVE_BENCH_TIMEOUT_S = 75
 
 
@@ -517,7 +561,32 @@ def _emit(result: dict) -> None:
         result["pipeline"] = _pipeline_summary()
     except Exception as exc:  # noqa: BLE001 — emit must never fail
         result["pipeline"] = {"error": f"{type(exc).__name__}: {exc}"}
+    # Degraded-mode recovery (reroute vs re-instantiation latency,
+    # throughput retention): CPU subprocess, bounded, best-effort — see
+    # _degrade_summary.
+    try:
+        result["degrade"] = _degrade_summary()
+    except Exception as exc:  # noqa: BLE001 — emit must never fail
+        result["degrade"] = {"error": f"{type(exc).__name__}: {exc}"}
+    _stamp_provenance(result)
     print(json.dumps(result))
+
+
+def _stamp_provenance(result: dict) -> None:
+    """Explicit staleness provenance on EVERY section of the emitted line:
+    consumers must never have to infer freshness from a key's absence. The
+    headline and each dict-valued section get `stale` (False unless a
+    replay path already marked it True) and `stale_from` (the run a
+    replayed number was measured in; None when fresh — all subprocess
+    microbenches are measured in-run, so they are fresh by construction
+    unless they errored, in which case the error string is the signal and
+    the section is still stamped)."""
+    result.setdefault("stale", False)
+    result.setdefault("stale_from", None)
+    for section in result.values():
+        if isinstance(section, dict):
+            section.setdefault("stale", False)
+            section.setdefault("stale_from", None)
 
 
 def main() -> None:

@@ -153,6 +153,16 @@ class ExecutionArguments:
     # reconfigure()/respawn deserializes instead of cold-compiling.
     # 0 disables. OOBLECK_PRECOMPILE overrides at runtime.
     precompile_recovery_depth: int = 2
+    # Degraded-mode execution plane (oobleck_tpu/degrade): on failure, try
+    # rerouting the dead DP replica's microbatches into the survivors'
+    # pipeline bubbles BEFORE template re-instantiation — same topology,
+    # no re-plan, no recompile (ReCycle, arxiv 2405.14009). A reroute is
+    # only taken when the planner projects step-time slowdown <=
+    # degrade_max_slowdown; otherwise (or when no DP peer survives) the
+    # engine falls back to re-instantiation. OOBLECK_DEGRADE (0/1) and
+    # OOBLECK_DEGRADE_MAX_SLOWDOWN override at runtime.
+    degrade_enabled: bool = True
+    degrade_max_slowdown: float = 4.0
 
     def __post_init__(self) -> None:
         if self.engine_path not in ("auto", "mpmd", "fused"):
@@ -183,6 +193,11 @@ class ExecutionArguments:
                 f"loss_readback_every must be >= 1, got "
                 f"{self.loss_readback_every}"
             )
+        if self.degrade_max_slowdown <= 1.0:
+            raise ValueError(
+                "degrade_max_slowdown must be > 1 (a reroute always costs "
+                f"some step time), got {self.degrade_max_slowdown}"
+            )
 
     @property
     def resolved_virtual_stages(self) -> int:
@@ -208,6 +223,12 @@ class ExecutionArguments:
         v = os.environ.get("OOBLECK_CKPT_ASYNC")
         if v:
             self.checkpoint_async = v.lower() not in ("0", "false", "no")
+        v = os.environ.get("OOBLECK_DEGRADE")
+        if v:
+            self.degrade_enabled = v.lower() not in ("0", "false", "no")
+        v = os.environ.get("OOBLECK_DEGRADE_MAX_SLOWDOWN")
+        if v:
+            self.degrade_max_slowdown = float(v)
 
     def resolved_path(self) -> str:
         # auto: fused is still the default home for sequence parallelism

@@ -1,0 +1,51 @@
+"""Degraded-mode execution plane: zero-reconfiguration failure recovery.
+
+First line of defense when a host dies (ReCycle, arxiv 2405.14009):
+classify the failure against the live DP topology (classify),
+check the dead replica's microbatches fit the survivors' pipeline
+bubbles and project the cost (planner), emit and validate the rerouted
+instruction streams (emitter), and apply the reroute to the live engine
+with no re-plan and no recompile (apply) — falling back to template
+re-instantiation when infeasible. Every outcome is one DegradeDecision
+(decision) in the flight recorder and the oobleck_degrade_* metrics
+family. The decision seam (classify -> plan -> apply) is what the
+future adaptive policy engine (ROADMAP item 2) will own.
+"""
+
+from oobleck_tpu.degrade.apply import specs_from_pipelines, try_degrade
+from oobleck_tpu.degrade.classify import FailureReport, classify_failure
+from oobleck_tpu.degrade.decision import (
+    MECH_DISABLED,
+    MECH_REINSTANTIATE,
+    MECH_REROUTE,
+    DegradeDecision,
+)
+from oobleck_tpu.degrade.emitter import (
+    ReroutedSchedule,
+    dataflow_edges,
+    emit_rerouted,
+    validate_reroute,
+)
+from oobleck_tpu.degrade.planner import (
+    PipelineSpec,
+    ReroutePlan,
+    plan_reroute,
+)
+
+__all__ = [
+    "DegradeDecision",
+    "FailureReport",
+    "MECH_DISABLED",
+    "MECH_REINSTANTIATE",
+    "MECH_REROUTE",
+    "PipelineSpec",
+    "ReroutePlan",
+    "ReroutedSchedule",
+    "classify_failure",
+    "dataflow_edges",
+    "emit_rerouted",
+    "plan_reroute",
+    "specs_from_pipelines",
+    "try_degrade",
+    "validate_reroute",
+]

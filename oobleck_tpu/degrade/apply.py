@@ -1,0 +1,195 @@
+"""Degraded-mode fast path: mutate the live engine onto a rerouted plan.
+
+try_degrade() is the one entry point engine.reconfigure() calls before
+its template-re-instantiation fallback. On success the engine keeps its
+EXACT topology — same pipelines, same stage executables, same compiled
+programs — and only four things change: the dead replica's pipelines are
+dropped, survivors adopt larger microbatch counts (bubble-absorbed, see
+planner.py), dataloaders are rebuilt from the consumed position for the
+new per-pipeline bucket slices, and the DP engine re-derives its owner
+map over the survivors. No re-plan, no recompile: recovery is bounded by
+~one step of bookkeeping (ReCycle, arxiv 2405.14009).
+
+Data/grad exactness through the reroute: the sampler bucket size is
+microbatch_size * sum(num_microbatches) and the reroute preserves that
+sum, so the surviving pipelines collectively read the SAME shuffled
+index bucket per iteration the full fleet would have — only the slice
+boundaries move. Gradients stay exact because every stage pre-scales by
+1/total_num_microbatches (unchanged) and the DP allreduce sums over
+whichever owners remain, so the summed update is identical to the
+no-failure run given identical data order (the parity test pins this).
+"""
+
+from __future__ import annotations
+
+import logging
+import time
+
+from oobleck_tpu.degrade.classify import classify_failure
+from oobleck_tpu.degrade.decision import (
+    MECH_REINSTANTIATE,
+    MECH_REROUTE,
+    DegradeDecision,
+)
+from oobleck_tpu.degrade.emitter import emit_rerouted, validate_reroute
+from oobleck_tpu.degrade.planner import PipelineSpec, plan_reroute
+from oobleck_tpu.utils import metrics, recovery
+
+logger = logging.getLogger(__name__)
+
+
+def specs_from_pipelines(pipelines) -> list[PipelineSpec]:
+    """Planner view of the engine's live pipeline list (calibrated op
+    durations included when the interpreter has recorded any)."""
+    return [
+        PipelineSpec(
+            num_stages=p.num_stages,
+            num_microbatches=p.num_microbatches,
+            virtual_stages=p.virtual_stages,
+            op_times=dict(p.last_op_times),
+        )
+        for p in pipelines
+    ]
+
+
+def try_degrade(engine, lost_ip: str, lost_host: int,
+                t0: float) -> DegradeDecision:
+    """Classify, plan, and — when feasible — apply the reroute in place.
+
+    Returns the DegradeDecision. mechanism == MECH_REROUTE means the
+    engine was mutated and recovery is COMPLETE (decision already
+    recorded, with measured latency). mechanism == MECH_REINSTANTIATE
+    means nothing was mutated and the caller must run the fallback; it
+    owns stamping measured_recovery_s and calling decision.record() once
+    the fallback finishes, so one decision covers the whole failure.
+    """
+    report = classify_failure(
+        lost_host, [p.ranks for p in engine.pipelines],
+        engine.chips_per_host)
+    specs = specs_from_pipelines(engine.pipelines)
+    plan = plan_reroute(
+        report, specs,
+        max_slowdown=engine.args.execution.degrade_max_slowdown)
+    decision = DegradeDecision(
+        lost_ip=lost_ip,
+        lost_host=lost_host,
+        mechanism=MECH_REROUTE if plan.feasible else MECH_REINSTANTIATE,
+        reason=plan.reason,
+        plan_record=plan.as_record(),
+        estimated_slowdown=(plan.slowdown
+                            if plan.makespan_before > 0 else None),
+        estimated_retention=plan.throughput_retention,
+        extra_microbatches=plan.extra_microbatches,
+    )
+    if not plan.feasible:
+        return decision
+
+    # Structural safety net before touching engine state: emit + validate
+    # the rerouted streams for every survivor. A violation here means a
+    # scheduler regression, not a planning outcome — log it, then take the
+    # always-correct fallback.
+    try:
+        for i in report.surviving:
+            validate_reroute(emit_rerouted(
+                specs[i].num_stages, specs[i].num_microbatches,
+                plan.new_microbatches[i] - specs[i].num_microbatches,
+                specs[i].virtual_stages))
+    except (AssertionError, ValueError) as e:
+        logger.error("rerouted schedule failed validation, falling back "
+                     "to re-instantiation: %s", e)
+        decision.mechanism = MECH_REINSTANTIATE
+        decision.reason = "reroute_apply_failed"
+        return decision
+
+    _apply_reroute(engine, lost_ip, report, plan)
+
+    elapsed = time.perf_counter() - t0
+    engine.recovery_times.append(elapsed)
+    engine._recovering = True
+    engine._recovered_at = time.monotonic()
+    engine._m_reconfigs.inc(path="degrade")
+    engine._set_template_gauge()
+    recovery.observe_latency(elapsed, stage="degrade")
+    decision.measured_recovery_s = elapsed
+    decision.record()
+    metrics.flight_recorder().record(
+        "engine_degraded", lost_ip=lost_ip, path="degrade",
+        elapsed_s=round(elapsed, 3), step=engine.step,
+        extra_microbatches=plan.extra_microbatches,
+        projected_retention=plan.throughput_retention)
+    logger.warning(
+        "degraded after losing %s in %.3fs: rerouted %d microbatches onto "
+        "%d survivor(s), projected retention %.2f",
+        lost_ip, elapsed, plan.extra_microbatches, len(report.surviving),
+        plan.throughput_retention)
+    if engine._precompiler is not None:
+        # The NEXT failure predicts from the degraded topology.
+        engine.start_recovery_precompile()
+    return decision
+
+
+def _apply_reroute(engine, lost_ip: str, report, plan) -> None:
+    """The in-place mutation. Same bookkeeping order as
+    engine._materialize_plan, minus everything that makes
+    re-instantiation slow: no weight collection/re-placement, no stage
+    rebuild, no optimizer-state re-placement — survivors keep their
+    arrays and compiled programs untouched."""
+    from oobleck_tpu.execution.engine import DataParallelEngine
+    from oobleck_tpu.execution.dataloader import (
+        DeviceStager,
+        OobleckDataLoader,
+        OobleckSampler,
+        PrefetchingLoader,
+    )
+    from oobleck_tpu.planning.instantiator import HeterogeneousPlan
+
+    # Data position carries over — taken from the CONSUMED position, so a
+    # prefetched-but-unconsumed iteration is replayed, not skipped.
+    it_done = engine.dataloaders[0].num_iterations_done
+    epoch = engine.dataloaders[0].epoch
+    for dl in engine.dataloaders:
+        if hasattr(dl, "close"):
+            dl.close()
+
+    survivors = [engine.pipelines[i] for i in report.surviving]
+    for i in report.dead:
+        engine.opt_states.pop(engine.pipelines[i].pipeline_id, None)
+    engine.pipelines = survivors
+    new_mb_list = [plan.new_microbatches[i] for i in report.surviving]
+    for pipe, new_mb in zip(survivors, new_mb_list):
+        pipe.adopt_microbatches(new_mb)
+
+    # Every sampler changes (the bucket slice boundaries moved), so every
+    # loader is rebuilt — positional pipeline_index over the survivor
+    # list, same bucket total, same (iterations_done, epoch).
+    train_samples = len(engine.dataset) - engine._eval_reserve()
+    engine.dataloaders = []
+    for pos, pipe in enumerate(survivors):
+        sampler = OobleckSampler(
+            num_samples=train_samples,
+            microbatch_size=engine.args.job.microbatch_size,
+            pipeline_index=pos,
+            num_microbatches=new_mb_list,
+            num_iterations_done=it_done,
+            epoch=epoch,
+        )
+        loader = OobleckDataLoader(engine.dataset, sampler)
+        if engine._prefetch_enabled():
+            loader = DeviceStager(
+                loader,
+                lambda b, _p=pipe: _p._place_batch(_p._as_batch_dict(b))[0],
+            )
+        else:
+            loader = PrefetchingLoader(loader)
+        engine.dataloaders.append(loader)
+
+    engine.dp_engine = DataParallelEngine(survivors)
+    engine.host_ips.remove(lost_ip)
+    if engine.plan is not None:
+        # Rebuild the plan descriptor so /status and the precompile
+        # predictor describe the degraded layout honestly.
+        engine.plan = HeterogeneousPlan(
+            instances=[p.template for p in survivors],
+            num_microbatches=list(new_mb_list),
+            allreduce_across_hosts=engine.plan.allreduce_across_hosts,
+        )

@@ -1,0 +1,228 @@
+"""Degraded-mode microbench: reroute vs re-instantiation on the CPU rig.
+
+Two identical 2-stage, 2-replica engines (4 virtual CPU devices, one
+single-host pipeline per host) lose the same host at the same step; the
+recovery-to-next-step latency (failure injection until the NEXT train
+step completes — the paper's recovery metric: how long until the job is
+learning again) is measured for three mechanisms:
+
+  * reroute — the degrade plane's fast path: the survivor absorbs the
+    dead replica's microbatches on the same topology. No re-plan, no
+    state movement, no recompile; the dominant cost is the next train
+    step itself.
+  * reinstantiate_respawn — the production template-re-instantiation
+    path. On a real multi-host deployment a lost peer breaks the shared
+    jax.distributed world, so the agent RESPAWNS the worker over the
+    survivors (engine.reconfigure documents this; the degrade verb
+    exists precisely so agents can skip it). Measured honestly as a
+    fresh process that builds the survivor-topology engine and runs one
+    step: interpreter + jax import, engine build, cold XLA compile,
+    first step — each broken out in the output.
+  * reinstantiate_inplace — the single-controller in-place replan
+    (degrade disabled): re-plan + full parameter/optimizer readback and
+    re-placement + pipeline rebuild. Reported transparently even though
+    it is the fallback's BEST case — sharing the failed engine's
+    process, its executables can hit a warm compile cache that a
+    respawned worker never sees.
+
+Also reported (reroute only): steady-state throughput retention and
+survivor slowdown, measured next to the planner's dependency-replay
+projection, so the simulate_bubble-calibrated estimate is accountable
+to a measurement.
+
+Run as `python -m oobleck_tpu.degrade.bench` under JAX_PLATFORMS=cpu
+with XLA_FLAGS=--xla_force_host_platform_device_count=4 (bench.py and
+`make degrade-bench` set this up). Prints ONE JSON line on stdout.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import sys
+import time
+
+RESPAWN_TIMEOUT_S = 300
+
+_MODEL_ARGS = {"hidden_size": 128, "num_layers": 8,
+               "max_position_embeddings": 64}
+
+
+def _make_engine(degrade_enabled: bool, hosts: list[str] | None = None):
+    import jax
+
+    from oobleck_tpu.config import (
+        DistributedArguments,
+        JobArguments,
+        ModelArguments,
+        OobleckArguments,
+    )
+    from oobleck_tpu.execution.engine import OobleckEngine
+
+    hosts = hosts or ["10.0.0.0", "10.0.0.1"]
+    args = OobleckArguments(
+        dist=DistributedArguments(node_ips=hosts),
+        job=JobArguments(
+            microbatch_size=1,
+            global_microbatch_size=8,
+            steps=64,
+            learning_rate=1e-3,
+            warmup_steps=2,
+        ),
+        # Shaped compile-heavy / step-light (deep, narrow, short
+        # sequences) so the respawn path's cold XLA compile is visible
+        # against the step time — the compile is the cost the reroute
+        # path avoids by keeping the live topology.
+        model=ModelArguments(
+            model_name="gpt2-tiny", dataset_path="synthetic",
+            model_tag="degrade-bench",  # own profile cache: non-default args
+            model_args=dict(_MODEL_ARGS),
+        ),
+    )
+    args.execution.degrade_enabled = degrade_enabled
+    args.execution.precompile_recovery_depth = 0  # mechanism cost, not warmth
+    args.execution.eval_fraction = 0.0
+    engine = OobleckEngine(args, devices=jax.devices()[:2 * len(hosts)])
+    engine.initialize_distributed()
+    engine.instantiate_pipelines(args.job.global_num_microbatch)
+    return engine
+
+
+def _steps(engine, n: int) -> float:
+    """Mean wall-clock seconds per step over n steps."""
+    t0 = time.perf_counter()
+    for _ in range(n):
+        engine._train_step()
+    return (time.perf_counter() - t0) / n
+
+
+def _recover_and_step(engine, lost_ip: str) -> float:
+    """Failure-to-next-step latency: reconfigure (whichever path the
+    engine takes) + the first post-recovery train step."""
+    t0 = time.perf_counter()
+    engine.reconfigure(lost_ip)
+    engine._train_step()
+    return time.perf_counter() - t0
+
+
+def _respawn_arm() -> dict:
+    """Time the production fallback: a fresh worker process built over
+    the survivor topology, through its first completed train step. The
+    parent's wall-clock from spawn to exit is the recovery latency; the
+    child reports its internal phase split (see `--respawn`)."""
+    import subprocess
+
+    t0 = time.perf_counter()
+    proc = subprocess.run(
+        [sys.executable, "-m", "oobleck_tpu.degrade.bench", "--respawn"],
+        capture_output=True, text=True, timeout=RESPAWN_TIMEOUT_S,
+        env={**os.environ, "JAX_PLATFORMS": "cpu"},
+    )
+    total = time.perf_counter() - t0
+    if proc.returncode != 0:
+        return {"error": f"respawn worker exited {proc.returncode}",
+                "stderr_tail": proc.stderr[-500:]}
+    child = json.loads(proc.stdout.strip().splitlines()[-1])
+    out = {"recovery_to_next_step_s": round(total, 3)}
+    out["spawn_and_import_s"] = round(
+        total - child["engine_build_s"] - child["first_step_s"], 3)
+    out.update(child)
+    return out
+
+
+def _respawn_main() -> None:
+    """Child side of the respawn arm: build the post-failure engine
+    (survivor host only — checkpoint-free, as live mirrors make the
+    production restart) and run ONE step. First step includes the cold
+    compile a respawned worker always pays."""
+    t0 = time.perf_counter()
+    engine = _make_engine(degrade_enabled=False, hosts=["10.0.0.0"])
+    t1 = time.perf_counter()
+    engine._train_step()
+    t2 = time.perf_counter()
+    print(json.dumps({"engine_build_s": round(t1 - t0, 3),
+                      "first_step_s": round(t2 - t1, 3)}))
+
+
+def measure(warmup_steps: int = 2, measure_steps: int = 3) -> dict:
+    out: dict = {
+        "rig": "2 hosts x (2-stage pipeline on 2 virtual CPU chips), "
+               "DP replicas, gpt2-tiny h128/L8/seq64",
+        # The single-controller rig dispatches DP replicas sequentially, so
+        # pre-failure wall-clock already includes both replicas' work and
+        # measured retention can reach ~1.0; the projected figure models
+        # replicas running concurrently (the real-cluster view). The
+        # apples-to-apples check of the simulate_bubble fit is
+        # survivor_slowdown: measured vs replay-projected cost of the
+        # surviving pipeline absorbing the borrowed microbatches.
+        "retention_note": "measured=wall-clock on serialized-replica rig; "
+                          "projected=concurrent-replica model",
+    }
+
+    # -- reroute path -------------------------------------------------- #
+    eng = _make_engine(degrade_enabled=True)
+    assert len(eng.pipelines) == 2, [p.ranks for p in eng.pipelines]
+    _steps(eng, warmup_steps)
+    pre_step_s = _steps(eng, measure_steps)
+    reroute_s = _recover_and_step(eng, "10.0.0.1")
+    assert len(eng.pipelines) == 1 and eng.pipelines[0].num_microbatches == 8
+    post_step_s = _steps(eng, measure_steps)
+    from oobleck_tpu.utils import metrics
+
+    retention_projected = metrics.registry().gauge(
+        "oobleck_degrade_throughput_retention", "").value()
+    out["reroute"] = {
+        "recovery_to_next_step_s": round(reroute_s, 3),
+        "reconfigure_s": round(eng.recovery_times[-1], 3),
+        "pre_failure_step_s": round(pre_step_s, 3),
+        "post_reroute_step_s": round(post_step_s, 3),
+        "throughput_retention_measured": round(pre_step_s / post_step_s, 3)
+        if post_step_s > 0 else None,
+        "throughput_retention_projected": round(retention_projected, 3),
+        # Survivor slowdown: the surviving pipeline's step cost after
+        # absorbing the dead replica's microbatches vs its own pre-failure
+        # share (half the serialized two-replica step on this homogeneous
+        # rig), against the planner's replay projection (1/retention).
+        "survivor_slowdown_measured": round(post_step_s / (pre_step_s / 2), 3)
+        if pre_step_s > 0 else None,
+        "survivor_slowdown_projected": round(1.0 / retention_projected, 3)
+        if retention_projected > 0 else None,
+        "extra_microbatches": int(metrics.registry().gauge(
+            "oobleck_degrade_extra_microbatches", "").value()),
+    }
+
+    # -- re-instantiation: production respawn path ----------------------- #
+    out["reinstantiate_respawn"] = _respawn_arm()
+
+    # -- re-instantiation: single-controller in-place replan ------------- #
+    eng2 = _make_engine(degrade_enabled=False)
+    _steps(eng2, warmup_steps)
+    _steps(eng2, measure_steps)  # same step history as the reroute engine
+    reinst_s = _recover_and_step(eng2, "10.0.0.1")
+    out["reinstantiate_inplace"] = {
+        "recovery_to_next_step_s": round(reinst_s, 3),
+        "reconfigure_s": round(eng2.recovery_times[-1], 3),
+        "note": "best case: shares the failed engine's process, so the "
+                "replanned layout can hit a warm compile cache",
+    }
+
+    respawn_s = out["reinstantiate_respawn"].get("recovery_to_next_step_s")
+    out["reroute_speedup"] = (round(respawn_s / reroute_s, 2)
+                              if respawn_s and reroute_s > 0 else None)
+    out["reroute_speedup_vs_inplace"] = (round(reinst_s / reroute_s, 2)
+                                         if reroute_s > 0 else None)
+    out["reroute_at_least_5x_faster"] = bool(
+        respawn_s is not None and respawn_s >= 5 * reroute_s)
+    return out
+
+
+def main() -> None:
+    os.environ.setdefault("JAX_PLATFORMS", "cpu")
+    if "--respawn" in sys.argv:
+        _respawn_main()
+        return
+    print(json.dumps(measure()))
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,85 @@
+"""Failure classifier: lost host vs live pipeline/DP topology.
+
+The degraded-mode plane treats each PipelineInstance as one data-parallel
+replica (its stages' layer ranges partition the whole model), so "does a
+surviving DP peer stage exist?" reduces to: does at least one pipeline
+survive with NO stage on the lost host? Every stage of a surviving
+pipeline is a DP peer of the corresponding dead stage — same layer
+ranges, same weights (modulo bounded replica drift) — which is what lets
+the reroute planner hand the dead replica's microbatches to the
+survivors' stages without touching topology (ReCycle, arxiv 2405.14009,
+applied at the granularity our DP actually exists at).
+
+The classifier is pure: it never reads engine state beyond what is passed
+in, so the precompile predictor can run it ahead of failure on predicted
+topologies and tests can table-drive it.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+
+from oobleck_tpu.execution.reconfigure import split_pipelines_by_host
+
+
+@dataclass
+class FailureReport:
+    """Which pipelines a lost host kills, and whether reroute is possible.
+
+    `stranded_hosts` are LIVE hosts whose only pipeline died with the lost
+    host (a dead pipeline spanning the victim plus healthy hosts): reroute
+    would leave their chips idle, so the classifier reports them and the
+    planner treats any stranding as infeasible — template re-instantiation
+    re-folds those hosts into the new plan instead of wasting them.
+    """
+
+    lost_host: int
+    dead: list[int] = field(default_factory=list)        # pipeline list indices
+    surviving: list[int] = field(default_factory=list)   # pipeline list indices
+    stranded_hosts: list[int] = field(default_factory=list)
+    reason: str = ""
+
+    @property
+    def feasible(self) -> bool:
+        return not self.reason
+
+    def as_record(self) -> dict:
+        """Flight-recorder-safe payload (plain JSON types only)."""
+        return {
+            "lost_host": self.lost_host,
+            "dead_pipelines": list(self.dead),
+            "surviving_pipelines": list(self.surviving),
+            "stranded_hosts": list(self.stranded_hosts),
+            "reason": self.reason or "peer_available",
+        }
+
+
+def classify_failure(lost_host: int, pipeline_ranks: list[list[int]],
+                     chips_per_host: int) -> FailureReport:
+    """Classify losing `lost_host` against the live pipeline set.
+
+    pipeline_ranks[i] is pipeline i's global chip ranks (rank encodes the
+    ORIGINAL host index: host = rank // chips_per_host — the engine's
+    immutable mapping, never an index into the shrinking host_ips list).
+    """
+    dead, surviving = split_pipelines_by_host(
+        pipeline_ranks, lost_host, chips_per_host)
+    report = FailureReport(lost_host=lost_host, dead=dead, surviving=surviving)
+    if not dead:
+        report.reason = "lost_host_runs_no_pipeline"
+        return report
+    if not surviving:
+        report.reason = "no_surviving_dp_peer"
+        return report
+    # Live hosts stranded by whole-replica reroute: every host of a dead
+    # pipeline other than the victim itself.
+    stranded = sorted({
+        r // chips_per_host
+        for i in dead
+        for r in pipeline_ranks[i]
+        if r // chips_per_host != lost_host
+    })
+    report.stranded_hosts = stranded
+    if stranded:
+        report.reason = "reroute_would_strand_hosts"
+    return report

@@ -1,0 +1,87 @@
+"""DegradeDecision: one auditable record per failure-handling choice.
+
+Every time the engine handles a lost host it produces exactly one
+DegradeDecision — whether it rerouted, fell back to template
+re-instantiation, or was configured off — carrying the classifier
+verdict, the planner's projected cost, and (once known) the measured
+recovery latency. record() writes it to the flight recorder and the
+oobleck_degrade_* metrics family in one call so the two views can never
+disagree about what happened.
+"""
+
+from __future__ import annotations
+
+import time
+from dataclasses import dataclass, field
+
+from oobleck_tpu.utils import metrics
+
+
+MECH_REROUTE = "reroute"
+MECH_REINSTANTIATE = "reinstantiate"
+MECH_DISABLED = "disabled"
+
+
+@dataclass
+class DegradeDecision:
+    """What the degraded-mode plane decided for one failure, and why.
+
+    `mechanism` is one of MECH_*; `reason` is "" for a successful reroute
+    and otherwise names why the fast path was not taken (classifier or
+    planner reason strings, or "degrade_disabled"/"reroute_apply_failed").
+    Estimated fields come from the ReroutePlan; measured fields are filled
+    in by whoever applied the mechanism.
+    """
+
+    lost_ip: str
+    lost_host: int
+    mechanism: str
+    reason: str = ""
+    plan_record: dict = field(default_factory=dict)
+    estimated_slowdown: float | None = None
+    estimated_retention: float | None = None
+    extra_microbatches: int = 0
+    measured_recovery_s: float | None = None
+    decided_at: float = field(default_factory=time.time)
+
+    def as_record(self) -> dict:
+        rec = {
+            "lost_ip": self.lost_ip,
+            "lost_host": self.lost_host,
+            "mechanism": self.mechanism,
+            "reason": self.reason or "ok",
+            "estimated_slowdown": self.estimated_slowdown,
+            "estimated_retention": self.estimated_retention,
+            "extra_microbatches": self.extra_microbatches,
+            "measured_recovery_s": self.measured_recovery_s,
+            "decided_at": self.decided_at,
+        }
+        if self.plan_record:
+            rec["plan"] = self.plan_record
+        return rec
+
+    def record(self) -> None:
+        """Flight-record the decision and bump the oobleck_degrade_*
+        family. Safe to call from the engine thread mid-recovery."""
+        metrics.flight_recorder().record("degrade_decision",
+                                         **self.as_record())
+        reg = metrics.registry()
+        reg.counter(
+            "oobleck_degrade_decisions_total",
+            "Degraded-mode decisions by mechanism and reason",
+        ).inc(mechanism=self.mechanism, reason=self.reason or "ok")
+        if self.extra_microbatches:
+            reg.gauge(
+                "oobleck_degrade_extra_microbatches",
+                "Microbatches rerouted onto survivors by the last degrade",
+            ).set(self.extra_microbatches)
+        if self.estimated_retention is not None:
+            reg.gauge(
+                "oobleck_degrade_throughput_retention",
+                "Planner-projected throughput retention of the last reroute",
+            ).set(self.estimated_retention)
+        if self.measured_recovery_s is not None:
+            reg.histogram(
+                "oobleck_degrade_recovery_seconds",
+                "Measured failure-to-resume latency by mechanism",
+            ).observe(self.measured_recovery_s, mechanism=self.mechanism)

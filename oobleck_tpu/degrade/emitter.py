@@ -1,0 +1,187 @@
+"""Rerouted-schedule emitter: survivor streams carrying borrowed work.
+
+A rerouted pipeline runs the SAME schedule family it already runs —
+canonical 1F1B or interleaved 1F1B from execution/schedule.py — at a
+larger microbatch count: its own base microbatches keep ids
+[0, base_M) and the dead replica's borrowed microbatches take
+[base_M, base_M + extra). Emitting through stage_instructions (rather
+than splicing borrowed units into a frozen base stream) is what makes
+send/recv matching and fwd-before-bwd correct BY CONSTRUCTION: the
+borrowed units ride the exact dependency structure the interpreter
+already honors, and the extra forwards land in the bubble slots the
+1F1B steady state leaves open. validate_reroute() pins the invariants
+down anyway — the tests drive it over every (S<=4, M<=8, v<=2)
+drop-one-peer config so a schedule refactor cannot silently break the
+degraded path.
+
+Gradient accumulation across borrowed microbatches needs no emitter
+support: the interpreter sums grads over whatever microbatch ids flow
+through a stage, each pre-scaled by 1/total_num_microbatches — and the
+global total is unchanged by rerouting (the borrowed microbatches exist
+either way; only the pipeline running them changed).
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass
+
+from oobleck_tpu.execution.schedule import (
+    Instruction,
+    Op,
+    all_instructions,
+    send_activation_dest,
+    send_grad_dest,
+    validate_interleaving,
+)
+
+
+@dataclass(frozen=True)
+class ReroutedSchedule:
+    """Per-stage instruction streams for one survivor absorbing `extra`
+    borrowed microbatches on top of its `base_microbatches`."""
+
+    num_stages: int
+    base_microbatches: int
+    extra_microbatches: int
+    virtual_stages: int
+    streams: tuple[tuple[Instruction, ...], ...]
+
+    @property
+    def num_microbatches(self) -> int:
+        return self.base_microbatches + self.extra_microbatches
+
+    def borrowed_units(self) -> list[Instruction]:
+        """Every (chunk, microbatch) compute unit run on behalf of the dead
+        replica, in stream order."""
+        return [
+            ins for stream in self.streams for ins in stream
+            if ins.op in (Op.FORWARD, Op.BACKWARD)
+            and ins.microbatch >= self.base_microbatches
+        ]
+
+
+def emit_rerouted(num_stages: int, base_microbatches: int,
+                  extra_microbatches: int,
+                  virtual_stages: int = 1) -> ReroutedSchedule:
+    """Survivor streams at base+extra microbatches; raises ValueError when
+    the rerouted count cannot run this survivor's schedule (interleaved
+    survivors need (base+extra) % S == 0 — changing v instead would change
+    chunk layouts and force a recompile, which the degraded path forbids)."""
+    M = base_microbatches + extra_microbatches
+    validate_interleaving(num_stages, M, virtual_stages)
+    streams = tuple(
+        tuple(stream)
+        for stream in all_instructions(num_stages, M, virtual_stages)
+    )
+    return ReroutedSchedule(
+        num_stages=num_stages,
+        base_microbatches=base_microbatches,
+        extra_microbatches=extra_microbatches,
+        virtual_stages=virtual_stages,
+        streams=streams,
+    )
+
+
+def dataflow_edges(streams) -> set[tuple[int, int]]:
+    """The (src virtual stage, dst virtual stage) activation edges a stream
+    set exercises — the pipeline's dataflow graph, microbatches erased."""
+    edges: set[tuple[int, int]] = set()
+    for stream in streams:
+        for ins in stream:
+            if ins.op is Op.SEND_ACTIVATION:
+                S = len(streams)
+                ds, dc = send_activation_dest(ins.stage, ins.chunk, S)
+                edges.add((ins.chunk * S + ins.stage, dc * S + ds))
+    return edges
+
+
+def validate_reroute(sched: ReroutedSchedule) -> None:
+    """Assert the rerouted streams' structural invariants; raises
+    AssertionError with the offending unit on any violation.
+
+    1. fwd-before-bwd per (virtual stage, microbatch) unit;
+    2. send/recv matching: every RECV_ACTIVATION/RECV_GRAD has exactly one
+       matching SEND on the producing stage, and vice versa;
+    3. unchanged survivor dataflow: the virtual-stage edge set equals the
+       base schedule's (borrowed microbatches add traffic on existing
+       edges, never new edges), and every microbatch — base and borrowed —
+       traverses all S*v virtual stages in order;
+    4. completeness: every microbatch gets exactly one FORWARD and one
+       BACKWARD per virtual stage.
+    """
+    S, v = sched.num_stages, sched.virtual_stages
+    M = sched.num_microbatches
+    last_vs = S * v - 1
+
+    fwd_seen: dict[tuple[int, int], int] = {}
+    bwd_seen: dict[tuple[int, int], int] = {}
+    sends_a: dict[tuple[int, int, int], int] = {}
+    recvs_a: dict[tuple[int, int, int], int] = {}
+    sends_g: dict[tuple[int, int, int], int] = {}
+    recvs_g: dict[tuple[int, int, int], int] = {}
+
+    for stream in sched.streams:
+        pos = {id(ins): k for k, ins in enumerate(stream)}
+        for k, ins in enumerate(stream):
+            vs = ins.chunk * S + ins.stage
+            unit = (vs, ins.microbatch)
+            if ins.op is Op.FORWARD:
+                fwd_seen[unit] = fwd_seen.get(unit, 0) + 1
+            elif ins.op is Op.BACKWARD:
+                bwd_seen[unit] = bwd_seen.get(unit, 0) + 1
+                # (1) the same physical stage must have run this unit's
+                # forward EARLIER in its own stream.
+                fwd_at = [j for j, other in enumerate(stream)
+                          if other.op is Op.FORWARD
+                          and other.microbatch == ins.microbatch
+                          and other.chunk == ins.chunk]
+                assert fwd_at and fwd_at[0] < k, (
+                    f"backward before forward for unit {unit}")
+            elif ins.op is Op.SEND_ACTIVATION:
+                ds, dc = send_activation_dest(ins.stage, ins.chunk, S)
+                key = (dc * S + ds, ins.microbatch, 0)
+                sends_a[key] = sends_a.get(key, 0) + 1
+            elif ins.op is Op.RECV_ACTIVATION:
+                key = (vs, ins.microbatch, 0)
+                recvs_a[key] = recvs_a.get(key, 0) + 1
+            elif ins.op is Op.SEND_GRAD:
+                ds, dc = send_grad_dest(ins.stage, ins.chunk, S)
+                key = (dc * S + ds, ins.microbatch, 1)
+                sends_g[key] = sends_g.get(key, 0) + 1
+            elif ins.op is Op.RECV_GRAD:
+                key = (vs, ins.microbatch, 1)
+                recvs_g[key] = recvs_g.get(key, 0) + 1
+        del pos
+
+    # (4) completeness, base and borrowed alike.
+    for m in range(M):
+        for vs in range(S * v):
+            assert fwd_seen.get((vs, m)) == 1, (
+                f"unit (vs={vs}, mb={m}) forward count "
+                f"{fwd_seen.get((vs, m), 0)} != 1")
+            assert bwd_seen.get((vs, m)) == 1, (
+                f"unit (vs={vs}, mb={m}) backward count "
+                f"{bwd_seen.get((vs, m), 0)} != 1")
+
+    # (2) send/recv matching, both directions.
+    assert sends_a == recvs_a, (
+        f"activation send/recv mismatch: "
+        f"{set(sends_a.items()) ^ set(recvs_a.items())}")
+    assert sends_g == recvs_g, (
+        f"gradient send/recv mismatch: "
+        f"{set(sends_g.items()) ^ set(recvs_g.items())}")
+    # Every non-first virtual stage receives each microbatch's activation
+    # exactly once; every non-last receives its gradient exactly once.
+    for m in range(M):
+        for vs in range(1, S * v):
+            assert recvs_a.get((vs, m, 0)) == 1
+        for vs in range(last_vs):
+            assert recvs_g.get((vs, m, 1)) == 1
+
+    # (3) dataflow graph unchanged vs the survivor's base schedule.
+    if sched.base_microbatches > 0 and sched.extra_microbatches > 0:
+        base_streams = all_instructions(S, sched.base_microbatches, v) \
+            if (v == 1 or sched.base_microbatches % S == 0) else None
+        if base_streams is not None:
+            assert dataflow_edges(sched.streams) == dataflow_edges(
+                base_streams), "reroute changed the dataflow graph"

@@ -367,6 +367,8 @@ class OobleckAgent:
                 continue
             if kind == ResponseType.RECONFIGURATION.value:
                 await self.on_reconfiguration(msg["lost_ip"])
+            elif kind == ResponseType.DEGRADE.value:
+                await self.on_reconfiguration(msg["lost_ip"], degrade=True)
             elif kind == ResponseType.FORWARD_COORDINATOR.value:
                 payload = {"kind": "coordinator", "address": msg["address"]}
                 if msg.get("world") is not None:
@@ -380,12 +382,23 @@ class OobleckAgent:
                         {"kind": "dist_info", "dist_info": msg["dist_info"]}
                     )
 
-    async def on_reconfiguration(self, lost_ip: str) -> None:
-        """Reference on_receive_reconfiguration (agent.py:217-232)."""
-        logger.warning("host %s lost", lost_ip)
+    async def on_reconfiguration(self, lost_ip: str,
+                                 degrade: bool = False) -> None:
+        """Reference on_receive_reconfiguration (agent.py:217-232).
+
+        `degrade` carries the master's DEGRADE verb through to the worker:
+        the engine should try the zero-reconfiguration reroute fast path
+        (oobleck_tpu/degrade) before template re-instantiation. Victim
+        self-termination and multihost respawn are verb-independent — a
+        dead host is dead either way; the verb only matters to a surviving
+        single-host engine that can recover in place."""
+        logger.warning("host %s lost%s", lost_ip,
+                       " (degrade requested)" if degrade else "")
         self._notified_at = time.monotonic()
         metrics.flight_recorder().record("reconfiguration_notified",
-                                         lost_ip=lost_ip, ip=self.agent_ip)
+                                         lost_ip=lost_ip, ip=self.agent_ip,
+                                         verb="degrade" if degrade
+                                         else "reconfiguration")
         recovery.mark(recovery.NOTIFIED, lost_ip=lost_ip, ip=self.agent_ip)
         if lost_ip == self.agent_ip:
             # We are declared dead: the built-in failure-injection kill switch.
@@ -412,8 +425,12 @@ class OobleckAgent:
                 await asyncio.to_thread(self.respawn_worker)
         elif self.worker is not None:
             # Single-host: the engine reconfigures in place — the
-            # reference's NCCL-rebuild model (engine.py:91-180).
-            self.worker.pipe.send({"kind": "reconfigure", "lost_ip": lost_ip})
+            # reference's NCCL-rebuild model (engine.py:91-180). The verb
+            # survives the pipe so the engine's listener sees what the
+            # master asked for.
+            self.worker.pipe.send(
+                {"kind": "degrade" if degrade else "reconfigure",
+                 "lost_ip": lost_ip})
 
     async def ping_loop(self) -> None:
         while True:

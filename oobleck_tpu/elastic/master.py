@@ -513,10 +513,18 @@ class OobleckMasterDaemon:
             agent.writer.close()
         if agent is not None and agent.clean_exit:
             return
+        # Broadcast the degraded-mode verb when the deployment has it on
+        # (OOBLECK_DEGRADE, default yes): survivors try rerouting the lost
+        # host's microbatches into their pipeline bubbles before paying for
+        # re-instantiation. Distinct verb — the wire trace and flight
+        # recorder must show which recovery the master ASKED for, not just
+        # which one the engine took.
+        degrade = os.environ.get("OOBLECK_DEGRADE", "1").lower() not in (
+            "0", "false", "no")
+        verb = ResponseType.DEGRADE if degrade else ResponseType.RECONFIGURATION
         for other in list(self.agents.values()):
             try:
-                await send_response(other.writer, ResponseType.RECONFIGURATION,
-                                    {"lost_ip": ip})
+                await send_response(other.writer, verb, {"lost_ip": ip})
             except ConnectionError:
                 pass
         self._m_reconfigs.inc()
@@ -526,7 +534,7 @@ class OobleckMasterDaemon:
                     r["broadcast_at"] = time.time()
         fr = metrics.flight_recorder()
         fr.record("reconfiguration_broadcast", lost_ip=ip,
-                  survivors=len(self.agents))
+                  survivors=len(self.agents), verb=verb.value)
         # Second dump so the postmortem file holds the complete sequence
         # detect → broadcast (the detect-time dump races the broadcast).
         fr.dump(f"reconfiguration_broadcast:{ip}")

@@ -70,6 +70,14 @@ class ResponseType(str, Enum):
     FAILURE = "failure"
     PONG = "pong"
     RECONFIGURATION = "reconfiguration"
+    # Degraded-mode hint: the lost host's work should first be REROUTED
+    # into surviving DP peers' pipeline bubbles (oobleck_tpu/degrade) —
+    # same payload as RECONFIGURATION, distinct verb so agents, the flight
+    # recorder, and the wire traces can tell a fast-path recovery from a
+    # full re-instantiation. Receivers that predate the verb fall back to
+    # treating it as RECONFIGURATION (the engine funnels both into the
+    # same recovery entry point, which tries reroute first anyway).
+    DEGRADE = "degrade"
     FORWARD_COORDINATOR = "forward_coordinator"
 
 

@@ -520,7 +520,11 @@ class ReconfigurationEngine:
                 return
             if not isinstance(msg, dict):
                 continue
-            if msg.get("kind") == "reconfigure":
+            if msg.get("kind") in ("reconfigure", "degrade"):
+                # Both verbs funnel into the same pending queue: the engine
+                # tries the degrade fast path first whenever it is enabled,
+                # so the verb is a control-plane hint (and a distinct wire
+                # event for the flight recorder), not a hard dispatch.
                 self.engine.request_reconfiguration(msg["lost_ip"])
             else:
                 self.engine._control_msgs.put(msg)
@@ -1687,6 +1691,7 @@ class OobleckEngine:
         try:
             while self.step < max_steps:
                 self._tracer.on_step(self.step)
+                self._maybe_chaos_kill_stage()
                 self._maybe_reconfigure()
                 # Fault-injection points (utils/chaos.py): the barrier ip/
                 # ordinal selectors let a test SIGKILL exactly one worker at
@@ -2493,6 +2498,41 @@ class OobleckEngine:
             self._precompiler.wait()
         return self._precompiler
 
+    def _maybe_chaos_kill_stage(self) -> None:
+        """Stage-addressed fault injection (OOBLECK_CHAOS=kill_stage=
+        <stage>:<replica>): declare the host owning that stage of that
+        pipeline lost, in place of an out-of-band SIGKILL — the
+        single-controller analog of killing one DP peer, deterministic
+        enough for the degraded-mode tests to target a specific peer."""
+        if not chaos().active or not self.pipelines:
+            return
+        target = chaos().kill_stage_target()
+        if target is None:
+            return
+        stage, replica = target
+        if replica >= len(self.pipelines):
+            logger.warning("chaos kill_stage: no pipeline replica %d "
+                           "(have %d); ignoring", replica, len(self.pipelines))
+            return
+        pipe = self.pipelines[replica]
+        if stage >= pipe.num_stages:
+            logger.warning("chaos kill_stage: pipeline %d has no stage %d; "
+                           "ignoring", replica, stage)
+            return
+        host = pipe.stages[stage].ranks[0] // self.chips_per_host
+        ip = next((p for p in self.host_ips
+                   if self._host_index[p] == host), None)
+        if ip is None:
+            logger.warning("chaos kill_stage: host %d already gone", host)
+            return
+        logger.warning(
+            "chaos kill_stage: stage %d of replica %d lives on host %s; "
+            "declaring it lost", stage, replica, ip)
+        metrics.flight_recorder().record(
+            "chaos_kill_stage_resolved", stage=stage, replica=replica,
+            lost_ip=ip, step=self.step)
+        self.request_reconfiguration(ip)
+
     def request_reconfiguration(self, lost_ip: str) -> None:
         with self._lock:
             self._pending_lost.append(lost_ip)
@@ -2535,6 +2575,29 @@ class OobleckEngine:
             self._reconfigure_fused(lost_ip, lost_host, t0)
             return
 
+        # Degraded-mode fast path FIRST (oobleck_tpu/degrade): reroute the
+        # dead replica's microbatches into the survivors' bubbles on the
+        # same topology — no re-plan, no recompile. try_degrade returns one
+        # DegradeDecision either way; on fallback it is recorded below with
+        # the measured re-instantiation latency so estimate and actual land
+        # in the same flight-recorder event.
+        decision = None
+        if self.args.execution.degrade_enabled:
+            from oobleck_tpu.degrade.apply import try_degrade
+
+            decision = try_degrade(self, lost_ip, lost_host, t0)
+            if decision.mechanism == "reroute":
+                return
+        else:
+            from oobleck_tpu.degrade.decision import (
+                MECH_DISABLED,
+                DegradeDecision,
+            )
+
+            decision = DegradeDecision(
+                lost_ip=lost_ip, lost_host=lost_host,
+                mechanism=MECH_DISABLED, reason="degrade_disabled")
+
         # Host algebra + template re-match, shared verbatim with the
         # recovery precompiler so its AOT executables hit here.
         plan, host_assignment, idle = self.predict_replan({lost_host})
@@ -2567,6 +2630,9 @@ class OobleckEngine:
         self._m_reconfigs.inc(path="mpmd")
         self._set_template_gauge()
         recovery.observe_latency(elapsed, stage="reconfigure")
+        if decision is not None:
+            decision.measured_recovery_s = elapsed
+            decision.record()
         metrics.flight_recorder().record(
             "engine_reconfigured", lost_ip=lost_ip, path="mpmd",
             elapsed_s=round(elapsed, 3), step=self.step)

@@ -764,6 +764,23 @@ class PipelineInstance:
             return jax.device_put(received, dst.batch_sharding)
         return None
 
+    def adopt_microbatches(self, new_num_microbatches: int) -> None:
+        """Degraded-mode reroute: run this replica at a different per-step
+        microbatch count from the next train_step on, WITHOUT recompiling.
+
+        Safe because nothing compiled depends on the per-pipeline count:
+        the stage executables are keyed on (layers, ranks, microbatch_size,
+        seq_len, total_num_microbatches, ...) — see _build_stage_fns — and
+        total_num_microbatches is preserved by rerouting (the borrowed
+        microbatches exist either way, so the 1/total gradient scale baked
+        into the last stage's backward stays exact). train_step reads
+        self.num_microbatches fresh each call and canonical_order caches
+        per (S, M, v), so the next step simply interprets the longer
+        stream."""
+        validate_interleaving(self.num_stages, new_num_microbatches,
+                              self.virtual_stages)
+        self.num_microbatches = new_num_microbatches
+
     def train_step(self, batch, placed=None):
         """One iteration over this pipeline's microbatches.
 

@@ -79,6 +79,7 @@ class RecoveryPrecompiler:
         self.stats: dict[str, Any] = {
             "plans": 0, "stages_compiled": 0, "stages_cached": 0,
             "aux_compiled": 0, "errors": 0, "elapsed_s": None,
+            "reroute_feasible": 0, "reroute_infeasible": 0,
         }
         self._done_keys: set = set()
         self._thread: threading.Thread | None = None
@@ -172,6 +173,24 @@ class RecoveryPrecompiler:
         cph = engine.chips_per_host
         frontier = [[sorted({r // cph for r in p.ranks})
                      for p in live_pipelines]]
+        # Annotate each first-loss prediction with the degrade plane's
+        # verdict: a reroute-feasible loss will likely never touch the
+        # fallback executables being warmed below, but the walk still
+        # compiles them — the planner can refuse a classifier-feasible
+        # reroute at failure time (the slowdown bound depends on op
+        # durations measured then), and the fallback must stay warm for
+        # that refusal.
+        from oobleck_tpu.degrade.classify import classify_failure
+
+        ranks_list = [list(p.ranks) for p in live_pipelines]
+        for lost in sorted({h for g in frontier[0] for h in g}):
+            rep = classify_failure(lost, ranks_list, cph)
+            self.stats["reroute_feasible" if rep.feasible
+                       else "reroute_infeasible"] += 1
+            logger.info(
+                "predicted loss of host %d: degrade verdict %s",
+                lost, rep.as_record()["reason"],
+            )
         seen_groups: set = set()
         for _ in range(self.depth):
             next_frontier = []

@@ -128,3 +128,23 @@ def hosts_to_ranks(hosts: list[int], chips_per_host: int) -> list[int]:
     for h in hosts:
         out.extend(range(h * chips_per_host, (h + 1) * chips_per_host))
     return out
+
+
+def split_pipelines_by_host(
+    pipeline_ranks: list[list[int]],
+    lost_host: int,
+    chips_per_host: int,
+) -> tuple[list[int], list[int]]:
+    """(dead, surviving) pipeline indices after losing `lost_host`.
+
+    A pipeline is dead iff ANY of its chip ranks lives on the lost host
+    (ranks encode original host indices: host = rank // chips_per_host).
+    Same algebra family as reconfigure_hosts, but classification only —
+    the degraded-mode plane decides between reroute and re-instantiation
+    before any host borrowing/merging happens.
+    """
+    dead, surviving = [], []
+    for i, ranks in enumerate(pipeline_ranks):
+        hosts = {r // chips_per_host for r in ranks}
+        (dead if lost_host in hosts else surviving).append(i)
+    return dead, surviving

@@ -205,25 +205,30 @@ def all_instructions(num_stages: int, num_microbatches: int,
             for i in range(num_stages)]
 
 
-def simulate_bubble(num_stages: int, num_microbatches: int,
+def replay_schedule(num_stages: int, num_microbatches: int,
                     virtual_stages: int = 1,
-                    duration_fn=None) -> float:
-    """Measured-schedule bubble via dependency replay.
+                    duration_fn=None,
+                    streams: "list[list[Instruction]] | None" = None,
+                    ) -> tuple[float, float]:
+    """Dependency replay of per-unit compute durations: (makespan, busy).
 
-    Replays per-unit compute durations through the schedule's dependency
-    graph — FORWARD(vs, m) waits for FORWARD(vs-1, m), BACKWARD(vs, m)
-    waits for FORWARD(vs, m) and BACKWARD(vs+1, m), each stage is serial —
-    and returns 1 - busy/(S * makespan). Transfers are modeled as free
-    (the interpreter overlaps them), so this isolates the schedule-shape
-    component of the bubble from dispatch/input stalls, which the engine
-    reports separately. duration_fn(instruction) -> seconds; defaults to
-    fwd=1, bwd=2 (the classic cost model).
+    FORWARD(vs, m) waits for FORWARD(vs-1, m), BACKWARD(vs, m) waits for
+    FORWARD(vs, m) and BACKWARD(vs+1, m), each physical stage is serial.
+    Transfers are modeled as free (the interpreter overlaps them), so this
+    isolates the schedule-shape component from dispatch/input stalls,
+    which the engine reports separately. duration_fn(instruction) ->
+    seconds; defaults to fwd=1, bwd=2 (the classic cost model). `streams`
+    overrides the canonical per-stage instruction streams — the degrade
+    planner replays rerouted streams through the same dependency rules,
+    which is what makes its makespan estimate and the test-side replay of
+    the emitted schedule one computation instead of two.
     """
     S, M, v = num_stages, num_microbatches, virtual_stages
     if duration_fn is None:
         duration_fn = lambda inst: 2.0 if inst.op is Op.BACKWARD else 1.0
 
-    streams = all_instructions(S, M, v)
+    if streams is None:
+        streams = all_instructions(S, M, v)
     ptr = [0] * S
     clock = [0.0] * S
     done: dict[tuple[str, int, int], float] = {}
@@ -281,6 +286,16 @@ def simulate_bubble(num_stages: int, num_microbatches: int,
             raise RuntimeError(
                 f"schedule deadlock in replay: S={S} M={M} v={v}")
     makespan = max(clock) if clock else 0.0
+    return makespan, busy
+
+
+def simulate_bubble(num_stages: int, num_microbatches: int,
+                    virtual_stages: int = 1,
+                    duration_fn=None) -> float:
+    """Measured-schedule bubble via dependency replay (replay_schedule):
+    1 - busy/(S * makespan)."""
+    makespan, busy = replay_schedule(
+        num_stages, num_microbatches, virtual_stages, duration_fn)
     if makespan <= 0 or busy <= 0:
         return 0.0
-    return max(0.0, 1.0 - busy / (S * makespan))
+    return max(0.0, 1.0 - busy / (num_stages * makespan))

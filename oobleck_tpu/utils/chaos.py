@@ -22,6 +22,14 @@ directives; each directive is ``action=arg[:qual][@ip]``:
     delay_at=serve_reload:0.5   sleep 0.5 s at every hit of the named
                                 barrier (slow-I/O injection: a reload
                                 crawling on cold storage, an NFS stall)
+    kill_stage=1:0              stage-addressed kill: declare the host
+                                owning STAGE 1 of pipeline REPLICA 0
+                                lost, once, at the next step boundary —
+                                the deterministic "kill one DP peer of a
+                                specific stage" fault the degraded-mode
+                                tests need (single-controller: the engine
+                                synthesizes the host loss in place of an
+                                out-of-band SIGKILL)
 
 Barriers are explicit calls (``chaos().barrier("step_end", ip=...)``)
 placed at recovery-relevant points: worker start, step start/end, and
@@ -48,7 +56,7 @@ logger = logging.getLogger("oobleck.chaos")
 ENV_VAR = "OOBLECK_CHAOS"
 
 _KNOWN_ACTIONS = ("delay_send", "drop_send", "stall_heartbeat", "kill_at",
-                  "delay_at")
+                  "delay_at", "kill_stage")
 
 
 @dataclass
@@ -89,6 +97,9 @@ def parse_spec(spec: str) -> list[Rule]:
             float(rule.qual or 0)  # delay_at=<barrier>:<seconds>
         elif action == "stall_heartbeat":
             int(rule.arg or 0)
+        elif action == "kill_stage":
+            int(rule.arg)           # kill_stage=<stage>:<replica>
+            int(rule.qual or 0)
         elif rule.qual is not None:
             int(rule.qual)
         rules.append(rule)
@@ -145,6 +156,33 @@ class Chaos:
                 if self._count(r) > int(r.arg or 0):
                     return True
         return False
+
+    # -- stage-addressed kill ---------------------------------------------- #
+
+    def kill_stage_target(self) -> tuple[int, int] | None:
+        """One-shot (stage, replica) of a pending stage-addressed kill,
+        or None. Consuming: each kill_stage rule fires exactly once — the
+        injected failure kills the host, and a dead host cannot die again.
+        The caller (the engine's step loop) resolves which host owns that
+        stage and synthesizes the loss."""
+        for r in self.rules:
+            if r.action != "kill_stage":
+                continue
+            i = self.rules.index(r)
+            if self._counts.get(i, 0):
+                continue
+            self._counts[i] = 1
+            stage, replica = int(r.arg), int(r.qual or 0)
+            logger.warning(
+                "chaos: stage-addressed kill of stage %d replica %d",
+                stage, replica)
+            from oobleck_tpu.utils import metrics
+
+            metrics.flight_recorder().record(
+                "chaos_injection", action="kill_stage", stage=stage,
+                replica=replica)
+            return stage, replica
+        return None
 
     # -- named barriers ---------------------------------------------------- #
 

@@ -128,8 +128,8 @@ async def test_kill_switch_terminates_self(job_args):
 @pytest.mark.asyncio
 async def test_response_loop_dispatches_reconfiguration(job_args):
     """End-to-end over sockets: a peer agent disconnecting makes the master
-    broadcast RECONFIGURATION, which the response_loop routes to the worker
-    pipe."""
+    broadcast its recovery verb (DEGRADE by default — reroute first), which
+    the response_loop routes to the worker pipe verb intact."""
     daemon, task = await start_master_with_job(job_args)
     agent = await registered_agent(daemon, "10.0.0.1")
     agent.worker, child = fake_worker()
@@ -145,7 +145,7 @@ async def test_response_loop_dispatches_reconfiguration(job_args):
         if child.poll(0):
             break
         await asyncio.sleep(0.05)
-    assert child.recv() == {"kind": "reconfigure", "lost_ip": "10.0.0.3"}
+    assert child.recv() == {"kind": "degrade", "lost_ip": "10.0.0.3"}
     assert agent.node_ips == ["10.0.0.1", "10.0.0.2"]
     loop_task.cancel()
     task.cancel()

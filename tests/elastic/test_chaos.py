@@ -138,8 +138,8 @@ async def test_send_msg_honors_drop():
 async def test_hung_heartbeat_peer_evicted_within_deadline(caplog):
     """A v2 agent advertising a fast ping cadence goes silent WITHOUT
     closing its socket. The master must evict it within its read deadline,
-    broadcast RECONFIGURATION to survivors, and stamp the RECOVERY_DEADLINE
-    detect mark with cause=heartbeat_deadline."""
+    broadcast its recovery verb (DEGRADE by default) to survivors, and
+    stamp the RECOVERY_DEADLINE detect mark with cause=heartbeat_deadline."""
     from oobleck_tpu.config import OobleckArguments
     from oobleck_tpu.elastic.master import OobleckMasterDaemon
     from oobleck_tpu.elastic.message import (
@@ -185,7 +185,7 @@ async def test_hung_heartbeat_peer_evicted_within_deadline(caplog):
 
         msg = await recv_msg(r_srv, timeout=deadline + 5)
         detected = time.monotonic() - t0
-        assert msg["kind"] == ResponseType.RECONFIGURATION.value
+        assert msg["kind"] == ResponseType.DEGRADE.value
         assert msg["lost_ip"] == "10.0.0.2"
         assert "10.0.0.2" not in daemon.agents
         assert "10.0.0.1" in daemon.agents  # survivor untouched

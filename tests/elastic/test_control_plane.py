@@ -112,9 +112,12 @@ async def test_ping_pong_and_dist_info(job_args):
 
 
 @pytest.mark.asyncio
-async def test_disconnect_broadcasts_reconfiguration(job_args):
+async def test_disconnect_broadcasts_reconfiguration(job_args, monkeypatch):
     """The core failure-detection path: agent dies -> survivors get
-    (RECONFIGURATION, lost_ip) (reference master.py:192-231)."""
+    (DEGRADE, lost_ip) — the default recovery verb asks survivors to try
+    the reroute fast path first (reference master.py:192-231 broadcasts
+    plain reconfiguration; see the legacy-verb test below)."""
+    monkeypatch.delenv("OOBLECK_DEGRADE", raising=False)
     daemon, _, task = await start_master()
     await launch_job(daemon, job_args)
     r1, w1, _ = await register_agent(daemon, "10.0.0.1")
@@ -127,9 +130,27 @@ async def test_disconnect_broadcasts_reconfiguration(job_args):
     msg1 = await recv_msg(r1, timeout=5)
     msg3 = await recv_msg(r3, timeout=5)
     for msg in (msg1, msg3):
-        assert msg["kind"] == ResponseType.RECONFIGURATION.value
+        assert msg["kind"] == ResponseType.DEGRADE.value
         assert msg["lost_ip"] == "10.0.0.2"
     assert "10.0.0.2" not in daemon.agents
+    task.cancel()
+
+
+@pytest.mark.asyncio
+async def test_disconnect_broadcasts_legacy_verb_when_degrade_off(
+        job_args, monkeypatch):
+    """OOBLECK_DEGRADE=0 restores the reference behavior: survivors get
+    plain RECONFIGURATION, skipping the reroute fast path."""
+    monkeypatch.setenv("OOBLECK_DEGRADE", "0")
+    daemon, _, task = await start_master()
+    await launch_job(daemon, job_args)
+    r1, w1, _ = await register_agent(daemon, "10.0.0.1")
+    r2, w2, _ = await register_agent(daemon, "10.0.0.2")
+
+    w2.close()
+    msg = await recv_msg(r1, timeout=5)
+    assert msg["kind"] == ResponseType.RECONFIGURATION.value
+    assert msg["lost_ip"] == "10.0.0.2"
     task.cancel()
 
 

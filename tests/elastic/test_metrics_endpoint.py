@@ -121,7 +121,7 @@ async def test_status_tracks_recovery_lifecycle(job_args, tmp_path,
 
         w2.close()  # host 2 dies silently
         msg = await recv_msg(r1, timeout=5)
-        assert msg["kind"] == ResponseType.RECONFIGURATION.value
+        assert msg["kind"] == ResponseType.DEGRADE.value  # default verb
 
         payload = daemon._status()
         (rec,) = payload["recoveries"]
