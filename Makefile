@@ -2,7 +2,7 @@
 
 PY ?= python
 
-.PHONY: lint test test-slow tier1 bench ckpt-bench serve-bench pipeline-bench degrade-bench
+.PHONY: lint test test-slow tier1 bench bench-diff trace-report ckpt-bench serve-bench pipeline-bench degrade-bench
 
 # Lint via ruff (config in pyproject.toml). Degrades to a skip when ruff
 # is not installed — the hermetic CI image does not ship it, and the gate
@@ -30,6 +30,20 @@ tier1:
 
 bench:
 	$(PY) bench.py
+
+# Honest round-over-round bench comparison: newest BENCH_r*.json vs the
+# previous round, per numeric key, stale sections skipped (never compared
+# as if fresh). Nonzero exit on regressions beyond the 5% threshold.
+bench-diff:
+	$(PY) bench.py --diff
+
+# Incident forensics report: phase breakdowns of every committed
+# incident-<n>.json under $$OOBLECK_METRICS_DIR (or ./metrics), plus a
+# merged Perfetto trace when TRACE_OUT is set.
+# Usage: make trace-report [OOBLECK_METRICS_DIR=...] [TRACE_OUT=trace.json]
+trace-report:
+	JAX_PLATFORMS=cpu $(PY) -m oobleck_tpu.obs.report \
+		$(if $(TRACE_OUT),--trace $(TRACE_OUT),)
 
 # Checkpoint-stall microbench: async writer vs sync baseline p50/p99
 # (oobleck_tpu/ckpt/bench.py; also folded into bench.py's "ckpt" key).

@@ -50,6 +50,21 @@ MEASURE_TIMEOUT_S = 280  # includes ~30 s of on-device flash validation
 CPU_FALLBACK_TIMEOUT_S = 120
 
 
+def _probe_timeout_s() -> int:
+    """Probe budget, overridable via BENCH_PROBE_TIMEOUT (seconds) for
+    deployments where the relay answers slower (or a CI that wants to fail
+    faster); the hard subprocess timeout + SIGTERM->SIGKILL escalation in
+    _probe_device applies either way."""
+    raw = os.environ.get("BENCH_PROBE_TIMEOUT", "")
+    try:
+        t = int(raw) if raw else PROBE_TIMEOUT_S
+    except ValueError:
+        print(f"ignoring malformed BENCH_PROBE_TIMEOUT={raw!r}",
+              file=sys.stderr)
+        return PROBE_TIMEOUT_S
+    return max(t, 1)
+
+
 def _baseline() -> dict | None:
     try:
         with open(os.path.join(os.path.dirname(os.path.abspath(__file__)),
@@ -589,7 +604,142 @@ def _stamp_provenance(result: dict) -> None:
             section.setdefault("stale_from", None)
 
 
+# --------------------------------------------------------------------------
+# --diff: honest round-over-round comparison of the emitted bench lines.
+
+# Relative change below this is noise, not a finding.
+DIFF_THRESHOLD = 0.05
+
+# Key-name fragments whose metrics improve DOWNWARD (latencies, pauses,
+# stalls, bubbles). Everything else is treated as higher-is-better.
+# Rate/ratio fragments win over any lower-is-better match: "_s" as a bare
+# substring would swallow "_sec"/"_speedup" and invert the headline
+# throughput keys, so unit suffixes are matched as suffixes only.
+_HIGHER_BETTER = ("per_sec", "per_second", "speedup", "retention",
+                  "throughput")
+_LOWER_BETTER = ("latency", "seconds", "ttft", "pause", "bubble", "stall",
+                 "p50", "p90", "p99")
+_LOWER_BETTER_SUFFIXES = ("_s", "_ms")
+
+
+def _round_files() -> list[str]:
+    """BENCH_r*.json next to this script, ordered by round number."""
+    import glob
+    import re
+
+    here = os.path.dirname(os.path.abspath(__file__))
+    out = []
+    for path in glob.glob(os.path.join(here, "BENCH_r*.json")):
+        m = re.match(r"BENCH_r(\d+)\.json$", os.path.basename(path))
+        if m:
+            out.append((int(m.group(1)), path))
+    return [p for _, p in sorted(out)]
+
+
+def _parsed_line(path: str) -> dict | None:
+    """The emitted bench line inside one round file (the driver wraps it
+    under "parsed"; accept a bare line too)."""
+    try:
+        with open(path) as f:
+            rec = json.load(f)
+    except Exception:
+        return None
+    if isinstance(rec, dict) and isinstance(rec.get("parsed"), dict):
+        return rec["parsed"]
+    if isinstance(rec, dict) and "value" in rec:
+        return rec
+    return None
+
+
+def _numeric_leaves(d: dict, prefix: str = "") -> dict:
+    """Flatten to {dotted.key: float}; stale sections are EXCLUDED (with a
+    marker entry) — comparing a replayed number against a fresh one, or two
+    replays of the same measurement, reports nothing honestly."""
+    out: dict = {}
+    if d.get("stale"):
+        out[prefix + "<stale>"] = d.get("stale_from") or "unknown"
+        return out
+    for k, v in d.items():
+        if k in ("stale", "stale_from", "note", "metric", "unit", "config"):
+            continue
+        key = f"{prefix}{k}"
+        if isinstance(v, bool):
+            continue
+        if isinstance(v, (int, float)):
+            out[key] = float(v)
+        elif isinstance(v, dict):
+            out.update(_numeric_leaves(v, key + "."))
+    return out
+
+
+def _lower_is_better(key: str) -> bool:
+    leaf = key.rsplit(".", 1)[-1]
+    if any(frag in leaf for frag in _HIGHER_BETTER):
+        return False
+    return (leaf.endswith(_LOWER_BETTER_SUFFIXES)
+            or any(frag in leaf for frag in _LOWER_BETTER))
+
+
+def bench_diff(old: dict, new: dict) -> tuple[list[str], list[str]]:
+    """(report_lines, regressions) comparing two emitted bench lines."""
+    a, b = _numeric_leaves(old), _numeric_leaves(new)
+    lines: list[str] = []
+    regressions: list[str] = []
+    for key, src in sorted({**a, **b}.items()):
+        if key.endswith("<stale>"):
+            which = ("both" if key in a and key in b
+                     else "old" if key in a else "new")
+            lines.append(f"  {key[:-len('<stale>')] or '(headline)'} "
+                         f"skipped: stale in {which} (from {src})")
+            continue
+        if key not in a:
+            lines.append(f"  {key}: (new) {b[key]:g}")
+            continue
+        if key not in b:
+            lines.append(f"  {key}: {a[key]:g} -> (gone)")
+            continue
+        ov, nv = a[key], b[key]
+        if ov == 0:
+            delta = 0.0 if nv == 0 else float("inf")
+        else:
+            delta = (nv - ov) / abs(ov)
+        if abs(delta) < DIFF_THRESHOLD:
+            continue
+        worse = delta > 0 if _lower_is_better(key) else delta < 0
+        tag = "REGRESSION" if worse else "improved"
+        lines.append(f"  {key}: {ov:g} -> {nv:g} ({delta:+.1%}) {tag}")
+        if worse:
+            regressions.append(key)
+    return lines, regressions
+
+
+def _diff_main() -> int:
+    files = _round_files()
+    if len(files) < 2:
+        print(f"bench --diff: need two BENCH_r*.json rounds, have "
+              f"{len(files)}")
+        return 0
+    old_path, new_path = files[-2], files[-1]
+    old, new = _parsed_line(old_path), _parsed_line(new_path)
+    if old is None or new is None:
+        print("bench --diff: unparseable round file "
+              f"({old_path if old is None else new_path})")
+        return 1
+    print(f"bench --diff: {os.path.basename(old_path)} -> "
+          f"{os.path.basename(new_path)}")
+    lines, regressions = bench_diff(old, new)
+    for line in lines or ["  no changes beyond "
+                          f"{DIFF_THRESHOLD:.0%} threshold"]:
+        print(line)
+    if regressions:
+        print(f"{len(regressions)} regression(s): {', '.join(regressions)}")
+        return 1
+    return 0
+
+
 def main() -> None:
+    if "--diff" in sys.argv[1:]:
+        raise SystemExit(_diff_main())
     if os.environ.get(_PIPELINE_ENV) == "1":
         print(json.dumps(_measure_pipeline()))
         return
@@ -599,7 +749,7 @@ def main() -> None:
 
     reasons: list[str] = []
     for attempt in range(2):
-        reason = _probe_device(PROBE_TIMEOUT_S)
+        reason = _probe_device(_probe_timeout_s())
         if reason is None:
             break
         reasons.append(reason)

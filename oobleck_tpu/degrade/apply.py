@@ -33,6 +33,7 @@ from oobleck_tpu.degrade.decision import (
 )
 from oobleck_tpu.degrade.emitter import emit_rerouted, validate_reroute
 from oobleck_tpu.degrade.planner import PipelineSpec, plan_reroute
+from oobleck_tpu.obs import spans
 from oobleck_tpu.utils import metrics, recovery
 
 logger = logging.getLogger(__name__)
@@ -63,13 +64,17 @@ def try_degrade(engine, lost_ip: str, lost_host: int,
     owns stamping measured_recovery_s and calling decision.record() once
     the fallback finishes, so one decision covers the whole failure.
     """
-    report = classify_failure(
-        lost_host, [p.ranks for p in engine.pipelines],
-        engine.chips_per_host)
+    # Spans parent onto the incident's ambient trace (engine.reconfigure
+    # pins it), so the postmortem timeline shows where degrade time went.
+    with spans.span("degrade.classify", lost_ip=lost_ip):
+        report = classify_failure(
+            lost_host, [p.ranks for p in engine.pipelines],
+            engine.chips_per_host)
     specs = specs_from_pipelines(engine.pipelines)
-    plan = plan_reroute(
-        report, specs,
-        max_slowdown=engine.args.execution.degrade_max_slowdown)
+    with spans.span("degrade.plan", survivors=len(report.surviving)):
+        plan = plan_reroute(
+            report, specs,
+            max_slowdown=engine.args.execution.degrade_max_slowdown)
     decision = DegradeDecision(
         lost_ip=lost_ip,
         lost_host=lost_host,
@@ -101,7 +106,9 @@ def try_degrade(engine, lost_ip: str, lost_host: int,
         decision.reason = "reroute_apply_failed"
         return decision
 
-    _apply_reroute(engine, lost_ip, report, plan)
+    with spans.span("degrade.apply",
+                    extra_microbatches=plan.extra_microbatches):
+        _apply_reroute(engine, lost_ip, report, plan)
 
     elapsed = time.perf_counter() - t0
     engine.recovery_times.append(elapsed)

@@ -37,6 +37,7 @@ from oobleck_tpu.elastic.message import (
     recv_msg,
     send_request,
 )
+from oobleck_tpu.obs import spans
 from oobleck_tpu.utils import metrics, recovery
 from oobleck_tpu.utils.chaos import chaos
 
@@ -366,9 +367,11 @@ class OobleckAgent:
                     self._m_rtt.set(rtt)
                 continue
             if kind == ResponseType.RECONFIGURATION.value:
-                await self.on_reconfiguration(msg["lost_ip"])
+                await self.on_reconfiguration(msg["lost_ip"],
+                                              trace=spans.extract(msg))
             elif kind == ResponseType.DEGRADE.value:
-                await self.on_reconfiguration(msg["lost_ip"], degrade=True)
+                await self.on_reconfiguration(msg["lost_ip"], degrade=True,
+                                              trace=spans.extract(msg))
             elif kind == ResponseType.FORWARD_COORDINATOR.value:
                 payload = {"kind": "coordinator", "address": msg["address"]}
                 if msg.get("world") is not None:
@@ -383,7 +386,8 @@ class OobleckAgent:
                     )
 
     async def on_reconfiguration(self, lost_ip: str,
-                                 degrade: bool = False) -> None:
+                                 degrade: bool = False,
+                                 trace: dict | None = None) -> None:
         """Reference on_receive_reconfiguration (agent.py:217-232).
 
         `degrade` carries the master's DEGRADE verb through to the worker:
@@ -391,10 +395,22 @@ class OobleckAgent:
         (oobleck_tpu/degrade) before template re-instantiation. Victim
         self-termination and multihost respawn are verb-independent — a
         dead host is dead either way; the verb only matters to a surviving
-        single-host engine that can recover in place."""
+        single-host engine that can recover in place.
+
+        `trace` is the incident's propagated trace context (obs/spans);
+        the agent stamps its notified_at wall time into it and forwards it
+        down the worker pipe so the engine's incident report spans master,
+        agent, and worker."""
         logger.warning("host %s lost%s", lost_ip,
                        " (degrade requested)" if degrade else "")
         self._notified_at = time.monotonic()
+        notified_wall = time.time()
+        if trace is not None:
+            trace = {**trace, "notified_at": notified_wall}
+            spans.span_recorder().record(
+                "incident.notified", notified_wall, notified_wall,
+                trace_id=trace.get("trace_id"), lost_ip=lost_ip,
+                ip=self.agent_ip)
         metrics.flight_recorder().record("reconfiguration_notified",
                                          lost_ip=lost_ip, ip=self.agent_ip,
                                          verb="degrade" if degrade
@@ -428,9 +444,11 @@ class OobleckAgent:
             # reference's NCCL-rebuild model (engine.py:91-180). The verb
             # survives the pipe so the engine's listener sees what the
             # master asked for.
-            self.worker.pipe.send(
-                {"kind": "degrade" if degrade else "reconfigure",
-                 "lost_ip": lost_ip})
+            payload = {"kind": "degrade" if degrade else "reconfigure",
+                       "lost_ip": lost_ip}
+            if trace is not None:
+                payload[spans.TRACE_KEY] = trace
+            self.worker.pipe.send(payload)
 
     async def ping_loop(self) -> None:
         while True:

@@ -46,9 +46,13 @@ from oobleck_tpu.elastic.message import (
     recv_msg,
     send_response,
 )
+from oobleck_tpu.obs import spans
 from oobleck_tpu.utils import metrics, recovery
 
 MAX_NUM_HOSTS = 32
+
+# Committed incident reports pushed up from workers, kept for /status.
+MAX_INCIDENTS = 16
 
 logger = logging.getLogger("oobleck.master")
 
@@ -168,6 +172,9 @@ class OobleckMasterDaemon:
         # (first post-broadcast worker snapshot = the pipeline is stepping
         # again).
         self._recoveries: list[dict] = []
+        # Incident forensics reports (obs/incident.py) committed by workers
+        # and pushed up piggybacked on METRICS snapshots; bounded ring.
+        self._incidents: list[dict] = []
         self.metrics_port: int | None = None
         self._http: metrics.MetricsHTTPServer | None = None
         reg = metrics.registry()
@@ -257,6 +264,13 @@ class OobleckMasterDaemon:
         ]
         with self._snap_lock:
             recoveries = [dict(r) for r in self._recoveries]
+            # Full reports are heavy; /status carries the forensic digest
+            # (phases + totals), the JSON file on the worker has the rest.
+            incidents = [
+                {k: i.get(k) for k in ("trace_id", "lost_ip", "cause",
+                                       "phases", "total_s", "committed_at")}
+                for i in self._incidents
+            ]
             worker_snaps = {
                 host: snap for (host, role), snap
                 in self._remote_snapshots.items() if role == "worker"
@@ -294,6 +308,7 @@ class OobleckMasterDaemon:
             "in_flight_recoveries": [
                 r for r in recoveries if r.get("resolved_at") is None
             ],
+            "incidents": incidents,
         }
 
     def _record_metrics_push(self, msg: dict) -> None:
@@ -301,8 +316,17 @@ class OobleckMasterDaemon:
         role = msg.get("role", "agent")
         snap = msg.get("snapshot") or {}
         self._m_pushes.inc(role=role)
+        incident = msg.get("incident") or snap.get("incident")
         with self._snap_lock:
             self._remote_snapshots[(ip, role)] = snap
+            if isinstance(incident, dict):
+                # A worker committed incident-<n>.json and piggybacked the
+                # report on its metrics push; keep it for /status forensics
+                # (dedup by trace_id — periodic pushes may resend it).
+                tid = incident.get("trace_id")
+                if not any(i.get("trace_id") == tid for i in self._incidents):
+                    self._incidents.append(incident)
+                    del self._incidents[:-MAX_INCIDENTS]
             if role == "worker":
                 # A worker shipping fresh metrics after a broadcast means
                 # the pipeline is stepping again: close open recoveries.
@@ -493,15 +517,22 @@ class OobleckMasterDaemon:
 
     def _on_failure_detected(self, lost_ip: str, cause: str) -> None:
         """Flight-record the detection, open a /status recovery entry, and
-        dump the ring — this is the postmortem moment."""
+        dump the ring — this is the postmortem moment. Mints the incident's
+        trace_id: every span and verb in this recovery, in every process,
+        stitches onto it."""
+        trace_id = spans.new_trace_id()
         with self._snap_lock:
             self._recoveries.append({
-                "lost_ip": lost_ip, "cause": cause,
+                "lost_ip": lost_ip, "cause": cause, "trace_id": trace_id,
                 "detected_at": time.time(), "broadcast_at": None,
                 "resolved_at": None,
             })
+        t = time.time()
+        spans.span_recorder().record(
+            "incident.detect", t, t, trace_id=trace_id,
+            lost_ip=lost_ip, cause=cause)
         fr = metrics.flight_recorder()
-        fr.record("detect", ip=lost_ip, cause=cause)
+        fr.record("detect", ip=lost_ip, cause=cause, trace_id=trace_id)
         fr.dump(f"failure_detected:{lost_ip}")
 
     async def _close_agent(self, ip: str) -> None:
@@ -522,16 +553,36 @@ class OobleckMasterDaemon:
         degrade = os.environ.get("OOBLECK_DEGRADE", "1").lower() not in (
             "0", "false", "no")
         verb = ResponseType.DEGRADE if degrade else ResponseType.RECONFIGURATION
-        for other in list(self.agents.values()):
-            try:
-                await send_response(other.writer, verb, {"lost_ip": ip})
-            except ConnectionError:
-                pass
-        self._m_reconfigs.inc()
+        # Trace context rides the verb (one extra JSON key; legacy agents
+        # ignore it) carrying the incident's trace_id plus the master-side
+        # wall-clock marks, so the worker's incident report can reconstruct
+        # the full detect → broadcast → notified → apply chain.
+        broadcast_at = time.time()
+        trace_ctx: dict | None = None
         with self._snap_lock:
             for r in self._recoveries:
                 if r["lost_ip"] == ip and r["broadcast_at"] is None:
-                    r["broadcast_at"] = time.time()
+                    r["broadcast_at"] = broadcast_at
+                    if r.get("trace_id"):
+                        trace_ctx = {
+                            "trace_id": r["trace_id"],
+                            "detected_at": r["detected_at"],
+                            "broadcast_at": broadcast_at,
+                            "cause": r.get("cause"),
+                        }
+        payload: dict = {"lost_ip": ip}
+        if trace_ctx is not None:
+            payload[spans.TRACE_KEY] = trace_ctx
+            spans.span_recorder().record(
+                "incident.broadcast", broadcast_at, broadcast_at,
+                trace_id=trace_ctx["trace_id"], lost_ip=ip, verb=verb.value,
+                survivors=len(self.agents))
+        for other in list(self.agents.values()):
+            try:
+                await send_response(other.writer, verb, payload)
+            except ConnectionError:
+                pass
+        self._m_reconfigs.inc()
         fr = metrics.flight_recorder()
         fr.record("reconfiguration_broadcast", lost_ip=ip,
                   survivors=len(self.agents), verb=verb.value)

@@ -223,6 +223,9 @@ class PipelineInstance:
         self.model = model
         self.num_microbatches = num_microbatches
         self.total_num_microbatches = total_num_microbatches
+        # Pre-reroute share; set by adopt_microbatches so the obs pipeline
+        # trace can tag reroute-borrowed microbatches (obs/pipeline_trace).
+        self.original_num_microbatches: int | None = None
         self.microbatch_size = microbatch_size
         self.seq_len = seq_len
         self._exec_cache = exec_cache if exec_cache is not None else {}
@@ -779,6 +782,8 @@ class PipelineInstance:
         stream."""
         validate_interleaving(self.num_stages, new_num_microbatches,
                               self.virtual_stages)
+        if self.original_num_microbatches is None:
+            self.original_num_microbatches = self.num_microbatches
         self.num_microbatches = new_num_microbatches
 
     def train_step(self, batch, placed=None):

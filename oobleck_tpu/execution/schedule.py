@@ -209,6 +209,7 @@ def replay_schedule(num_stages: int, num_microbatches: int,
                     virtual_stages: int = 1,
                     duration_fn=None,
                     streams: "list[list[Instruction]] | None" = None,
+                    on_op=None,
                     ) -> tuple[float, float]:
     """Dependency replay of per-unit compute durations: (makespan, busy).
 
@@ -221,7 +222,11 @@ def replay_schedule(num_stages: int, num_microbatches: int,
     overrides the canonical per-stage instruction streams — the degrade
     planner replays rerouted streams through the same dependency rules,
     which is what makes its makespan estimate and the test-side replay of
-    the emitted schedule one computation instead of two.
+    the emitted schedule one computation instead of two. `on_op(stage,
+    inst, start, end)` observes every scheduled compute unit — the obs
+    pipeline-trace exporter renders these into per-(stage, chunk,
+    microbatch) Perfetto slices, so the exported timeline and the bubble
+    estimate cannot drift apart.
     """
     S, M, v = num_stages, num_microbatches, virtual_stages
     if duration_fn is None:
@@ -276,6 +281,8 @@ def replay_schedule(num_stages: int, num_microbatches: int,
                 end = start + d
                 clock[i] = end
                 busy += d
+                if on_op is not None:
+                    on_op(i, inst, start, end)
                 vs = inst.chunk * S + inst.stage
                 kind = "f" if inst.op is Op.FORWARD else "b"
                 done[(kind, vs, inst.microbatch)] = end

@@ -1,0 +1,204 @@
+"""Incident forensics: one committed ``incident-<n>.json`` per recovery.
+
+An IncidentBuilder accumulates the wall-clock marks of one failure's
+handling chain — detect → broadcast → notified → apply → first
+post-recovery step — and on commit joins them with the spans recorded on
+the incident's trace, the recent flight-recorder ring, and the relevant
+metrics families into a single self-contained postmortem artifact.
+
+Commit is atomic AND exclusive: the record is written to a temp file
+(fsync'd) and published under the next free ``incident-<n>.json`` name via
+``os.link`` — an all-or-nothing operation, so a crash mid-commit leaves no
+torn report and two concurrent committers can never both claim one index.
+
+Phase semantics (all adjacent-mark deltas; a mark the chain never reached
+is simply absent, and its phases collapse out of the breakdown):
+
+    detect      master observed the failure (or the engine resolved a
+                chaos kill_stage directive in-process)
+    broadcast   master sent DEGRADE/RECONFIGURATION to survivors
+    notified    agent received the verb
+    apply_start engine entered reconfigure()
+    apply_end   reroute applied or plan re-instantiated
+    first_step  first training step after recovery completed
+
+``total_s`` = first mark → last mark, which for a complete chain is the
+same failure-to-resume latency the recovery histogram observes.
+"""
+
+from __future__ import annotations
+
+import json
+import logging
+import os
+import re
+import time
+import uuid
+
+from oobleck_tpu.obs import spans as spans_mod
+from oobleck_tpu.utils import metrics
+
+logger = logging.getLogger("oobleck.obs")
+
+# Canonical mark names, in chain order.
+MARK_ORDER = ("detect", "broadcast", "notified", "apply_start", "apply_end",
+              "first_step")
+
+# Metric families worth freezing into the postmortem (recovery + degrade
+# planes); everything else stays in the live registry/JSONL sink.
+_METRIC_PREFIXES = ("oobleck_recovery_", "oobleck_degrade_",
+                    "oobleck_engine_reconfig")
+
+_INCIDENT_RE = re.compile(r"incident-(\d+)\.json$")
+
+
+class IncidentBuilder:
+    """Accumulates one incident's marks; ``commit()`` writes the report."""
+
+    def __init__(self, lost_ip: str, *, trace_id: str | None = None,
+                 cause: str | None = None, **attrs):
+        self.trace_id = trace_id or spans_mod.new_trace_id()
+        self.lost_ip = lost_ip
+        self.cause = cause
+        self.attrs = dict(attrs)
+        self.marks: dict[str, float] = {}
+
+    def mark(self, name: str, t: float | None = None) -> float:
+        t = time.time() if t is None else float(t)
+        self.marks[name] = t
+        return t
+
+    def adopt(self, trace_ctx: dict | None) -> None:
+        """Fold wall-clock marks a propagated trace context carried along
+        (detected_at/broadcast_at/notified_at from upstream processes)."""
+        if not trace_ctx:
+            return
+        for key, name in (("detected_at", "detect"),
+                          ("broadcast_at", "broadcast"),
+                          ("notified_at", "notified")):
+            v = trace_ctx.get(key)
+            if isinstance(v, (int, float)):
+                self.marks.setdefault(name, float(v))
+
+    def phase_breakdown(self) -> dict:
+        """{"phases": {"<a>_to_<b>": s, ...}, "total_s": s} over the marks
+        actually present, in chain order."""
+        present = [(n, self.marks[n]) for n in MARK_ORDER if n in self.marks]
+        phases = {}
+        for (a, ta), (b, tb) in zip(present, present[1:]):
+            phases[f"{a}_to_{b}"] = round(tb - ta, 6)
+        total = present[-1][1] - present[0][1] if len(present) > 1 else 0.0
+        return {"phases": phases, "total_s": round(total, 6)}
+
+    def build(self) -> dict:
+        """The full incident record (not yet written anywhere)."""
+        first = min(self.marks.values()) if self.marks else time.time()
+        flight = [e for e in metrics.flight_recorder().events()
+                  if e.get("t", 0.0) >= first - 5.0]
+        snap = metrics.registry().snapshot()
+        frozen = [m for m in snap.get("metrics", [])
+                  if any(m.get("name", "").startswith(p)
+                         for p in _METRIC_PREFIXES)]
+        rec = {
+            "trace_id": self.trace_id,
+            "lost_ip": self.lost_ip,
+            "cause": self.cause,
+            "role": metrics.get_role(),
+            "pid": os.getpid(),
+            "committed_at": time.time(),
+            "marks": {n: self.marks[n] for n in MARK_ORDER
+                      if n in self.marks},
+            **self.phase_breakdown(),
+            "spans": spans_mod.span_recorder().for_trace(self.trace_id),
+            "flight": flight,
+            "metrics": frozen,
+        }
+        if self.attrs:
+            rec["attrs"] = self.attrs
+        return rec
+
+    def commit(self, d: str | None = None) -> str | None:
+        """Atomically publish the report as the next free incident-<n>.json
+        under ``d`` (default OOBLECK_METRICS_DIR); None when no sink."""
+        d = d or metrics.metrics_dir()
+        if d is None:
+            return None
+        rec = self.build()
+        tmp = os.path.join(d, f".incident-{os.getpid()}-{uuid.uuid4().hex[:8]}.tmp")
+        try:
+            with open(tmp, "w") as f:
+                json.dump(rec, f, indent=1)
+                f.flush()
+                os.fsync(f.fileno())
+            n = next_index(d)
+            while True:
+                final = os.path.join(d, f"incident-{n}.json")
+                try:
+                    os.link(tmp, final)
+                    break
+                except FileExistsError:
+                    n += 1
+                except OSError:
+                    # Filesystem without hard links: exclusive-create the
+                    # final name, then replace it with the fsync'd temp so
+                    # the visible content transition is still atomic. A
+                    # concurrent committer winning the index retries the
+                    # next one, exactly like the os.link path above.
+                    try:
+                        fd = os.open(final,
+                                     os.O_CREAT | os.O_EXCL | os.O_WRONLY)
+                    except FileExistsError:
+                        n += 1
+                        continue
+                    os.close(fd)
+                    os.replace(tmp, final)
+                    tmp = None
+                    break
+        except OSError as e:
+            logger.warning("obs: cannot commit incident report: %s", e)
+            return None
+        finally:
+            if tmp is not None:
+                try:
+                    os.unlink(tmp)
+                except OSError:
+                    pass
+        logger.warning("incident report committed: %s (lost_ip=%s total=%.3fs)",
+                       final, self.lost_ip, rec["total_s"])
+        return final
+
+
+def next_index(d: str) -> int:
+    """Smallest index >= every existing incident-<n>.json under ``d``."""
+    n = 0
+    try:
+        for name in os.listdir(d):
+            m = _INCIDENT_RE.match(name)
+            if m:
+                n = max(n, int(m.group(1)) + 1)
+    except OSError:
+        pass
+    return n
+
+
+def list_incidents(d: str) -> list[tuple[str, dict]]:
+    """(path, record) for every parseable incident-<n>.json, index order."""
+    out = []
+    try:
+        names = os.listdir(d)
+    except OSError:
+        return out
+    indexed = sorted((int(m.group(1)), name) for name in names
+                     if (m := _INCIDENT_RE.match(name)))
+    for _, name in indexed:
+        path = os.path.join(d, name)
+        try:
+            with open(path) as f:
+                rec = json.load(f)
+        except (OSError, ValueError) as e:
+            logger.warning("obs: skipping unreadable incident %s: %s",
+                           path, e)
+            continue
+        if isinstance(rec, dict):
+            out.append((path, rec))
+    return out

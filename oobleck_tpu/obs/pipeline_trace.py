@@ -1,0 +1,136 @@
+"""Per-op pipeline timeline export: the dispatched instruction schedule as
+Perfetto slices.
+
+The engine's measured bubble gauge (oobleck_engine_pipeline_bubble_fraction,
+kind=measured) replays the calibrated per-(stage, chunk) fwd/bwd durations
+through ``schedule.replay_schedule``. This module runs the SAME replay with
+an ``on_op`` observer and renders every scheduled compute unit as one
+Chrome-trace "X" slice per (stage, chunk, microbatch) — so warmup/cooldown
+bubbles, reroute-borrowed microbatches, and serialization stalls show up as
+gaps between slices in the Perfetto UI, and the trace's measured gap
+fraction equals the bubble gauge by construction (one computation, two
+renderings).
+
+Lanes: one trace process per pipeline replica (pid = pipeline_id), one
+thread lane per physical stage. Slice names are ``F`` / ``B`` plus the
+microbatch (and ``c<chunk>`` when interleaved); borrowed microbatches
+(index >= the pipeline's original share after a reroute) are tagged in
+``args.borrowed``.
+"""
+
+from __future__ import annotations
+
+import json
+import logging
+import os
+
+from oobleck_tpu.execution.schedule import Instruction, Op, replay_schedule
+
+logger = logging.getLogger("oobleck.obs")
+
+ENV_PIPELINE_TRACE = "OOBLECK_PIPELINE_TRACE"
+
+
+def duration_fn_from_op_times(op_times: dict):
+    """duration_fn(inst) from a PipelineInstance's calibrated
+    ``last_op_times`` ({(stage, chunk, "f"/"b"): (total_s, count)}), with
+    the same same-kind-average fallback the engine's bubble gauge uses for
+    never-timed chunks."""
+
+    def dur(inst: Instruction) -> float:
+        kind = "f" if inst.op is Op.FORWARD else "b"
+        tot, n = op_times.get((inst.stage, inst.chunk, kind), (0.0, 0))
+        if n:
+            return tot / n
+        vals = [t / c for (_, _, k), (t, c) in op_times.items()
+                if k == kind and c]
+        return sum(vals) / len(vals) if vals else 1.0
+
+    return dur
+
+
+def replay_slices(num_stages: int, num_microbatches: int,
+                  virtual_stages: int = 1, duration_fn=None, streams=None):
+    """(slices, makespan, busy): the dependency replay with every scheduled
+    unit captured as (instruction, start_s, end_s)."""
+    slices: list[tuple[Instruction, float, float]] = []
+
+    def on_op(stage: int, inst: Instruction, start: float, end: float):
+        slices.append((inst, start, end))
+
+    makespan, busy = replay_schedule(
+        num_stages, num_microbatches, virtual_stages, duration_fn,
+        streams=streams, on_op=on_op)
+    return slices, makespan, busy
+
+
+def pipeline_trace(pipes, *, extra_events: list[dict] | None = None) -> dict:
+    """Chrome-trace dict for one or more PipelineInstance objects.
+
+    Each pipeline is replayed from its calibrated per-op durations (or the
+    fwd=1/bwd=2 cost model before any step has timed ops). The per-pipeline
+    summary carries makespan/busy and the gap fraction
+    ``1 - busy/(S*makespan)`` — numerically the engine's measured bubble.
+    """
+    events: list[dict] = []
+    summaries: list[dict] = []
+    for pipe in pipes:
+        S = pipe.num_stages
+        M = pipe.num_microbatches
+        v = getattr(pipe, "virtual_stages", 1)
+        pid = int(getattr(pipe, "pipeline_id", 0))
+        op_times = getattr(pipe, "last_op_times", None) or {}
+        dur = duration_fn_from_op_times(op_times) if op_times else None
+        try:
+            slices, makespan, busy = replay_slices(S, M, v, dur)
+        except RuntimeError as e:  # replay deadlock: skip this replica
+            logger.warning("pipeline trace: replay failed for pipeline %d: %s",
+                           pid, e)
+            continue
+        borrowed_from = getattr(pipe, "original_num_microbatches", None)
+        events.append({"name": "process_name", "ph": "M", "pid": pid,
+                       "tid": 0, "args": {"name": f"pipeline-{pid}"}})
+        for i in range(S):
+            events.append({"name": "thread_name", "ph": "M", "pid": pid,
+                           "tid": i, "args": {"name": f"stage {i}"}})
+        for inst, start, end in slices:
+            kind = "F" if inst.op is Op.FORWARD else "B"
+            name = f"{kind} mb{inst.microbatch}"
+            if v > 1:
+                name += f" c{inst.chunk}"
+            args = {"op": inst.op.value, "stage": inst.stage,
+                    "chunk": inst.chunk, "microbatch": inst.microbatch}
+            if borrowed_from is not None and inst.microbatch >= borrowed_from:
+                args["borrowed"] = True
+            events.append({
+                "name": name, "ph": "X", "cat": "pipeline",
+                "ts": round(start * 1e6, 3),
+                "dur": round((end - start) * 1e6, 3),
+                "pid": pid, "tid": inst.stage, "args": args,
+            })
+        gap = (max(0.0, 1.0 - busy / (S * makespan))
+               if makespan > 0 and busy > 0 else 0.0)
+        summaries.append({
+            "pipeline_id": pid, "num_stages": S, "num_microbatches": M,
+            "virtual_stages": v, "calibrated": bool(op_times),
+            "makespan_s": makespan, "busy_s": busy,
+            "bubble_fraction": gap,
+        })
+    events.extend(extra_events or [])
+    return {"traceEvents": events, "displayTimeUnit": "ms",
+            "otherData": {"pipelines": summaries}}
+
+
+def write_pipeline_trace(path: str, pipes, **kwargs) -> dict:
+    """Atomic (tmp + rename) write; returns the trace dict."""
+    trace = pipeline_trace(pipes, **kwargs)
+    tmp = f"{path}.tmp-{os.getpid()}"
+    with open(tmp, "w") as f:
+        json.dump(trace, f)
+        f.flush()
+        os.fsync(f.fileno())
+    os.replace(tmp, path)
+    logger.info("pipeline trace: %d events for %d pipeline(s) -> %s",
+                len(trace["traceEvents"]),
+                len(trace["otherData"]["pipelines"]), path)
+    return trace

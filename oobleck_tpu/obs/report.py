@@ -1,0 +1,150 @@
+"""Incident forensics report CLI.
+
+    python -m oobleck_tpu.obs.report [--dir DIR] [--trace OUT.json]
+                                     [--incident N]
+
+Reads the metrics sink directory (default: $OOBLECK_METRICS_DIR, falling
+back to ./metrics) and renders every committed ``incident-<n>.json`` as a
+phase-breakdown table, cross-checked against the recovery-latency
+histogram collected by the same run. ``--trace`` additionally merges all
+``spans-*.jsonl`` dumps plus incident spans into one Chrome-trace JSON
+loadable in Perfetto (https://ui.perfetto.dev) or chrome://tracing.
+"""
+
+from __future__ import annotations
+
+import argparse
+import glob
+import json
+import os
+import sys
+
+from oobleck_tpu.obs import incident as incident_mod
+from oobleck_tpu.obs import spans as spans_mod
+from oobleck_tpu.utils import metrics
+
+
+def _load_span_dumps(d: str) -> list[dict]:
+    """All spans from every spans-*.jsonl dump under ``d`` (header lines
+    have an "event" key and are skipped)."""
+    out: list[dict] = []
+    for path in sorted(glob.glob(os.path.join(d, "spans-*.jsonl"))):
+        try:
+            with open(path) as f:
+                for line in f:
+                    line = line.strip()
+                    if not line:
+                        continue
+                    try:
+                        rec = json.loads(line)
+                    except ValueError:
+                        continue
+                    if isinstance(rec, dict) and "event" not in rec:
+                        out.append(rec)
+        except OSError:
+            continue
+    return out
+
+
+def _dedupe_spans(spans: list[dict]) -> list[dict]:
+    seen: set[tuple] = set()
+    out = []
+    for s in spans:
+        key = (s.get("span_id"), s.get("t0"))
+        if key in seen:
+            continue
+        seen.add(key)
+        out.append(s)
+    return out
+
+
+def _recovery_histogram(d: str) -> dict | None:
+    """Merged oobleck_recovery_latency_seconds across all JSONL sinks."""
+    snapshots = metrics.read_jsonl_dir(d)
+    if not snapshots:
+        return None
+    latest = metrics.latest_per_file(snapshots)
+    series = metrics.find_series(latest, "oobleck_recovery_latency_seconds")
+    return metrics.merge_histogram_series(series)
+
+
+def _fmt_seconds(s: float) -> str:
+    return f"{s * 1000:.1f} ms" if s < 1.0 else f"{s:.3f} s"
+
+
+def render_incident(path: str, rec: dict, out=None) -> None:
+    out = out if out is not None else sys.stdout
+    print(f"\n== {os.path.basename(path)} ==", file=out)
+    print(f"  trace_id : {rec.get('trace_id')}", file=out)
+    print(f"  lost_ip  : {rec.get('lost_ip')}"
+          f"   cause: {rec.get('cause')}", file=out)
+    phases = rec.get("phases") or {}
+    if phases:
+        width = max(len(k) for k in phases)
+        print("  phases:", file=out)
+        for name, dt in phases.items():
+            print(f"    {name:<{width}}  {_fmt_seconds(float(dt))}",
+                  file=out)
+    print(f"  total    : {_fmt_seconds(float(rec.get('total_s', 0.0)))}",
+          file=out)
+    nspans = len(rec.get("spans") or [])
+    nflight = len(rec.get("flight") or [])
+    print(f"  evidence : {nspans} span(s), {nflight} flight event(s)",
+          file=out)
+
+
+def main(argv: list[str] | None = None) -> int:
+    ap = argparse.ArgumentParser(
+        prog="python -m oobleck_tpu.obs.report", description=__doc__,
+        formatter_class=argparse.RawDescriptionHelpFormatter)
+    ap.add_argument("--dir", default=None,
+                    help="metrics sink dir (default: $OOBLECK_METRICS_DIR "
+                         "or ./metrics)")
+    ap.add_argument("--incident", type=int, default=None,
+                    help="render only incident-<N>.json")
+    ap.add_argument("--trace", default=None, metavar="OUT.json",
+                    help="also write a merged Chrome-trace JSON here")
+    args = ap.parse_args(argv)
+
+    d = args.dir or metrics.metrics_dir() or "metrics"
+    if not os.path.isdir(d):
+        print(f"no metrics directory at {d!r} (set --dir or "
+              f"{metrics.ENV_METRICS_DIR})", file=sys.stderr)
+        return 1
+
+    incidents = incident_mod.list_incidents(d)
+    if args.incident is not None:
+        want = f"incident-{args.incident}.json"
+        incidents = [(p, r) for p, r in incidents
+                     if os.path.basename(p) == want]
+
+    if not incidents:
+        print(f"no incident reports under {d}")
+    for path, rec in incidents:
+        render_incident(path, rec)
+
+    hist = _recovery_histogram(d)
+    if hist and hist.get("count"):
+        p50 = metrics.histogram_percentile(hist, 0.50)
+        p99 = metrics.histogram_percentile(hist, 0.99)
+        print(f"\nrecovery latency histogram: n={hist['count']} "
+              f"sum={hist['sum']:.3f}s p50={p50:.3f}s p99={p99:.3f}s")
+
+    if args.trace:
+        spans = _load_span_dumps(d)
+        for _, rec in incidents:
+            spans.extend(rec.get("spans") or [])
+        spans = _dedupe_spans(spans)
+        spans.sort(key=lambda s: s.get("t0", 0.0))
+        spans_mod.write_chrome_trace(
+            args.trace, spans,
+            metadata={"source_dir": os.path.abspath(d),
+                      "incidents": [os.path.basename(p)
+                                    for p, _ in incidents]})
+        print(f"\nwrote {len(spans)} span(s) -> {args.trace} "
+              f"(load in https://ui.perfetto.dev)")
+    return 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

@@ -25,6 +25,7 @@ import time
 
 import numpy as np
 
+from oobleck_tpu.obs import spans
 from oobleck_tpu.utils import metrics
 from oobleck_tpu.utils.metrics import SERVE_LATENCY_BUCKETS
 
@@ -42,7 +43,7 @@ class GenRequest:
 
     def __init__(self, tokens: list[int], *, max_tokens: int,
                  temperature: float = 0.0, deadline_s: float | None = None,
-                 eos_token: int | None = None):
+                 eos_token: int | None = None, trace_id: str | None = None):
         self.id = next(self._ids)
         self.tokens = list(tokens)
         self.max_tokens = int(max_tokens)
@@ -56,6 +57,14 @@ class GenRequest:
         self.ttft_s: float | None = None
         self.total_s: float | None = None
         self.done = threading.Event()
+        # Tracing (obs/spans): the request is one trace; queue wait,
+        # prefill, and decode become child spans at finish, so TTFT is
+        # decomposed by cause. Wall stamps ride next to the monotonic
+        # latency fields — spans need an epoch timeline.
+        self.trace_id = trace_id or spans.new_trace_id()
+        self.t_submit_wall = time.time()
+        self.t_admit_wall: float | None = None
+        self.t_prefill_wall: float | None = None
 
     def expired(self, now: float) -> bool:
         return self.deadline is not None and now > self.deadline
@@ -166,7 +175,33 @@ class ContinuousBatcher:
         req.step = self.engine.params_step
         req.total_s = time.monotonic() - req.submitted
         self.m_requests.inc(outcome=reason)
+        self._record_spans(req, reason)
         req.done.set()
+
+    def _record_spans(self, req: GenRequest, reason: str) -> None:
+        """One stitched timeline per request: serve.request parent span
+        with queue_wait / prefill / decode children — the Perfetto view
+        of the TTFT histogram, decomposed by cause."""
+        end = time.time()
+        rec = spans.span_recorder()
+        root = rec.record(
+            "serve.request", req.t_submit_wall, end,
+            trace_id=req.trace_id, request_id=req.id, outcome=reason,
+            tokens_in=len(req.tokens), tokens_out=len(req.out_tokens),
+            ttft_s=req.ttft_s, params_step=req.step)
+        parent = root["span_id"]
+        if req.t_admit_wall is not None:
+            rec.record("serve.queue_wait", req.t_submit_wall,
+                       req.t_admit_wall, trace_id=req.trace_id,
+                       parent_id=parent, request_id=req.id)
+            if req.t_prefill_wall is not None:
+                rec.record("serve.prefill", req.t_admit_wall,
+                           req.t_prefill_wall, trace_id=req.trace_id,
+                           parent_id=parent, request_id=req.id)
+                rec.record("serve.decode", req.t_prefill_wall, end,
+                           trace_id=req.trace_id, parent_id=parent,
+                           request_id=req.id,
+                           tokens_out=len(req.out_tokens))
 
     def _sample(self, logits_row: np.ndarray, temperature: float) -> int:
         if temperature <= 0.0:
@@ -229,7 +264,9 @@ class ContinuousBatcher:
             if req.expired(now):
                 self._finish(req, "deadline")
                 continue
+            req.t_admit_wall = time.time()
             logits = self.engine.prefill(req.tokens, i)
+            req.t_prefill_wall = time.time()
             now = time.monotonic()
             token = self._sample(logits, req.temperature)
             if not self._emit(req, token, now):

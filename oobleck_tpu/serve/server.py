@@ -9,7 +9,9 @@ Endpoints:
                       stand-in tokenizer), optional "max_tokens",
                       "temperature", "deadline_ms", "eos_token".
                       -> {"tokens", "text", "finish_reason", "step",
-                          "ttft_ms", "latency_ms"}
+                          "ttft_ms", "latency_ms", "trace_id"}
+                      Optional "trace_id" in the body joins server-side
+                      spans to the caller's trace (obs/spans).
                       429 when the admission queue is full (backpressure),
                       400 on malformed input.
   GET  /healthz       {"ok", "step", "slots_active", "queue_depth"}
@@ -144,11 +146,17 @@ class ServeHTTPServer:
         eos = body.get("eos_token")
         if eos is not None and not isinstance(eos, int):
             raise ValueError("eos_token must be an int")
+        # Client-supplied trace id (distributed tracing across the caller's
+        # own spans) or a fresh one; returned in the response either way so
+        # the caller can join server-side spans to its request.
+        trace_id = body.get("trace_id")
+        if trace_id is not None and not isinstance(trace_id, str):
+            raise ValueError("trace_id must be a string")
         req = GenRequest(
             tokens, max_tokens=max_tokens,
             temperature=float(body.get("temperature", 0.0)),
             deadline_s=(float(deadline_ms) / 1e3) if deadline_ms else None,
-            eos_token=eos)
+            eos_token=eos, trace_id=trace_id)
         try:
             self.batcher.submit(req)
         except QueueFull as e:
@@ -166,6 +174,7 @@ class ServeHTTPServer:
             "step": req.step,
             "ttft_ms": round((req.ttft_s or 0.0) * 1e3, 3),
             "latency_ms": round((req.total_s or 0.0) * 1e3, 3),
+            "trace_id": req.trace_id,
         }
 
     def start(self) -> "ServeHTTPServer":

@@ -73,6 +73,14 @@ def mark(event: str, **fields) -> float:
     rec = {"event": event, "t": round(t, 3)}
     rec.update({k: v for k, v in fields.items() if v is not None})
     logger.warning("%s %s", MARK, json.dumps(rec, sort_keys=True))
+    # Mirror the mark as a point span: when an incident trace is ambient
+    # (the engine pins it around reconfigure), the mark stitches into the
+    # same Perfetto timeline the postmortem report renders. Imported here,
+    # not at module top, purely to keep this leaf module import-light.
+    from oobleck_tpu.obs import spans as _spans
+
+    _spans.event(f"recovery.{event}", t=t,
+                 **{k: v for k, v in fields.items() if v is not None})
     reg = metrics.registry()
     reg.counter("oobleck_recovery_marks_total",
                 "RECOVERY_DEADLINE marks emitted").inc(stage=event)

@@ -20,6 +20,7 @@ double-start, and an unclosed trace loses its buffered data).
 
 from __future__ import annotations
 
+import contextlib
 import logging
 import os
 
@@ -31,6 +32,17 @@ logger = logging.getLogger("oobleck.tracing")
 def annotate(name: str):
     """Named span visible in TPU profiler traces (and a no-op otherwise)."""
     return jax.profiler.TraceAnnotation(name)
+
+
+@contextlib.contextmanager
+def traced(name: str, **attrs):
+    """One region, both tracing planes: a jax.profiler annotation (shows in
+    device traces captured by StepTracer) AND an obs span (shows in the
+    distributed timeline, stitched to whatever trace is current/ambient)."""
+    from oobleck_tpu.obs import spans
+
+    with jax.profiler.TraceAnnotation(name), spans.span(name, **attrs):
+        yield
 
 
 def _env_int(name: str, default: int) -> int:

@@ -145,7 +145,13 @@ async def test_response_loop_dispatches_reconfiguration(job_args):
         if child.poll(0):
             break
         await asyncio.sleep(0.05)
-    assert child.recv() == {"kind": "degrade", "lost_ip": "10.0.0.3"}
+    verb = child.recv()
+    assert verb["kind"] == "degrade"
+    assert verb["lost_ip"] == "10.0.0.3"
+    # the recovery verb carries its trace context down the pipe, with the
+    # agent's notified_at stamped after the master's broadcast_at
+    trace = verb["trace"]
+    assert trace["notified_at"] >= trace["broadcast_at"]
     assert agent.node_ips == ["10.0.0.1", "10.0.0.2"]
     loop_task.cancel()
     task.cancel()

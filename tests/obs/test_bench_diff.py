@@ -1,0 +1,95 @@
+"""bench.py --diff: the honest round-over-round comparison. Stale sections
+must be skipped with explicit provenance (never compared as if fresh),
+direction must follow the lower-is-better key classification, and only
+changes beyond the noise threshold may be reported."""
+
+import bench
+
+
+def test_stale_sections_are_skipped_not_compared():
+    old = {"metric": "tokens_per_second", "value": 100.0, "stale": True,
+           "stale_from": "r3",
+           "serve": {"ttft_p50_ms": 10.0, "stale": False}}
+    new = {"metric": "tokens_per_second", "value": 50.0, "stale": True,
+           "stale_from": "r3",
+           "serve": {"ttft_p50_ms": 10.0, "stale": False}}
+    lines, regressions = bench.bench_diff(old, new)
+    # the 2x headline "drop" is two replays of the same measurement: it
+    # must NOT be called a regression, and the skip names the source round
+    assert regressions == []
+    assert any("skipped: stale in both (from r3)" in line for line in lines)
+    assert not any("REGRESSION" in line for line in lines)
+
+
+def test_stale_on_one_side_still_skips():
+    old = {"value": 100.0, "stale": False, "stale_from": None}
+    new = {"value": 100.0, "stale": True, "stale_from": "r1"}
+    lines, regressions = bench.bench_diff(old, new)
+    assert regressions == []
+    assert any("stale in new" in line for line in lines)
+
+
+def test_regression_direction_higher_is_better():
+    old = {"value": 100.0, "stale": False}
+    new = {"value": 80.0, "stale": False}
+    lines, regressions = bench.bench_diff(old, new)
+    assert regressions == ["value"]
+    assert any("REGRESSION" in line for line in lines)
+    # and the improvement direction is not a regression
+    _, regressions = bench.bench_diff(new, old)
+    assert regressions == []
+
+
+def test_regression_direction_lower_is_better():
+    old = {"serve": {"ttft_p50_ms": 10.0, "stale": False}, "stale": False}
+    new = {"serve": {"ttft_p50_ms": 20.0, "stale": False}, "stale": False}
+    _, regressions = bench.bench_diff(old, new)
+    assert regressions == ["serve.ttft_p50_ms"]
+    _, regressions = bench.bench_diff(new, old)
+    assert regressions == []  # latency halved = improvement
+
+
+def test_throughput_keys_are_higher_is_better():
+    # "_s" must only match as a unit suffix: as a substring it swallows
+    # "_sec"/"_speedup" and inverts the headline throughput metrics.
+    for key in ("tokens_per_sec", "pipeline.mpmd_tokens_per_sec_per_chip",
+                "degrade.reroute_speedup", "degrade.retention",
+                "serve.tokens_per_second"):
+        assert not bench._lower_is_better(key), key
+    for key in ("serve.ttft_p50_ms", "step_s", "recovery.total_s",
+                "pipeline.bubble_fraction", "latency"):
+        assert bench._lower_is_better(key), key
+    old = {"pipeline": {"tokens_per_sec": 100.0}, "stale": False}
+    new = {"pipeline": {"tokens_per_sec": 150.0}, "stale": False}
+    lines, regressions = bench.bench_diff(old, new)
+    assert regressions == []  # 1.5x throughput is an improvement
+    assert any("improved" in line for line in lines)
+    _, regressions = bench.bench_diff(new, old)
+    assert regressions == ["pipeline.tokens_per_sec"]
+
+
+def test_noise_below_threshold_is_silent():
+    old = {"value": 100.0, "stale": False}
+    new = {"value": 100.0 * (1 - bench.DIFF_THRESHOLD / 2), "stale": False}
+    lines, regressions = bench.bench_diff(old, new)
+    assert lines == [] and regressions == []
+
+
+def test_new_and_gone_keys_reported_without_regression():
+    old = {"value": 1.0, "stale": False, "pipeline": {"bubble": 0.1}}
+    new = {"value": 1.0, "stale": False, "degrade": {"retention": 0.9}}
+    lines, regressions = bench.bench_diff(old, new)
+    assert regressions == []
+    assert any("(new)" in line and "retention" in line for line in lines)
+    assert any("(gone)" in line and "bubble" in line for line in lines)
+
+
+def test_probe_timeout_env(monkeypatch, capsys):
+    monkeypatch.delenv("BENCH_PROBE_TIMEOUT", raising=False)
+    assert bench._probe_timeout_s() == bench.PROBE_TIMEOUT_S
+    monkeypatch.setenv("BENCH_PROBE_TIMEOUT", "7")
+    assert bench._probe_timeout_s() == 7
+    monkeypatch.setenv("BENCH_PROBE_TIMEOUT", "0")
+    assert bench._probe_timeout_s() == 1  # floored: 0 would kill the probe
+    monkeypatch.setenv("BENCH_PROBE_TIMEOUT", "soon")
+    assert bench._probe_timeout_s() == bench.PROBE_TIMEOUT_S  # malformed

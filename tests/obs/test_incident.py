@@ -1,0 +1,163 @@
+"""Incident forensics (oobleck_tpu/obs/incident): mark/adopt semantics,
+phase-breakdown arithmetic, the atomic+exclusive incident-<n>.json commit,
+and the report CLI that renders the result."""
+
+import json
+import os
+
+import pytest
+
+from oobleck_tpu.obs import incident as incident_mod
+from oobleck_tpu.obs import report, spans
+from oobleck_tpu.obs.incident import IncidentBuilder, list_incidents, next_index
+
+
+def test_phase_breakdown_chain_order():
+    inc = IncidentBuilder("10.0.0.2", cause="test")
+    inc.mark("detect", 100.0)
+    inc.mark("broadcast", 100.5)
+    inc.mark("apply_start", 101.0)
+    inc.mark("first_step", 103.5)
+    pb = inc.phase_breakdown()
+    # "notified"/"apply_end" never happened: their phases collapse out
+    assert pb["phases"] == {"detect_to_broadcast": 0.5,
+                           "broadcast_to_apply_start": 0.5,
+                           "apply_start_to_first_step": 2.5}
+    assert pb["total_s"] == 3.5
+    assert sum(pb["phases"].values()) == pytest.approx(pb["total_s"])
+
+
+def test_phase_breakdown_degenerate():
+    inc = IncidentBuilder("x")
+    assert inc.phase_breakdown() == {"phases": {}, "total_s": 0.0}
+    inc.mark("detect", 5.0)
+    assert inc.phase_breakdown()["total_s"] == 0.0
+
+
+def test_adopt_folds_propagated_wall_marks():
+    inc = IncidentBuilder("10.0.0.2", trace_id="abc123")
+    inc.mark("detect", 50.0)  # locally observed first
+    inc.adopt({"trace_id": "abc123", "detected_at": 49.0,
+               "broadcast_at": 49.5, "notified_at": "bogus-type"})
+    # adopt never overwrites a locally observed mark, skips non-numerics
+    assert inc.marks == {"detect": 50.0, "broadcast": 49.5}
+    inc.adopt(None)  # legacy peer: no trace context at all
+    assert inc.marks == {"detect": 50.0, "broadcast": 49.5}
+
+
+def test_build_joins_spans_and_flight(tmp_path):
+    from oobleck_tpu.utils import metrics
+
+    inc = IncidentBuilder("10.0.0.9", cause="unit", note="n1")
+    inc.mark("detect")
+    spans.span_recorder().record("incident.detect", 1.0, 1.0,
+                                 trace_id=inc.trace_id)
+    spans.span_recorder().record("unrelated", 1.0, 2.0)
+    metrics.flight_recorder().record("test_evt", lost_ip="10.0.0.9")
+    rec = inc.build()
+    assert rec["trace_id"] == inc.trace_id
+    assert rec["attrs"] == {"note": "n1"}
+    assert [s["name"] for s in rec["spans"]] == ["incident.detect"]
+    assert any(e.get("event") == "test_evt" for e in rec["flight"])
+    # only the recovery/degrade metric families are frozen in
+    for fam in rec["metrics"]:
+        assert fam["name"].startswith(incident_mod._METRIC_PREFIXES)
+    json.dumps(rec)
+
+
+def test_commit_is_atomic_and_exclusive(tmp_path):
+    d = str(tmp_path)
+    a = IncidentBuilder("10.0.0.1")
+    a.mark("detect", 1.0)
+    b = IncidentBuilder("10.0.0.2")
+    b.mark("detect", 2.0)
+    pa = a.commit(d)
+    pb = b.commit(d)
+    # two committers never claim one index
+    assert os.path.basename(pa) == "incident-0.json"
+    assert os.path.basename(pb) == "incident-1.json"
+    assert next_index(d) == 2
+    assert not [n for n in os.listdir(d) if n.startswith(".incident")]
+    got = list_incidents(d)
+    assert [r["lost_ip"] for _, r in got] == ["10.0.0.1", "10.0.0.2"]
+
+
+def test_commit_fallback_retries_concurrently_taken_index(tmp_path,
+                                                          monkeypatch):
+    # No-hardlink filesystems fall back to O_EXCL create + replace; a
+    # concurrent committer winning the index must push us to the next one,
+    # not abort the whole commit (the FileExistsError is an OSError).
+    d = str(tmp_path)
+    calls = []
+
+    def no_hardlinks(src, dst):
+        if not calls:
+            # concurrent committer claims index 0 between next_index()
+            # and our exclusive create
+            with open(os.path.join(d, "incident-0.json"), "w") as f:
+                f.write("{}")
+        calls.append(dst)
+        raise OSError("hard links not supported")
+
+    monkeypatch.setattr(os, "link", no_hardlinks)
+    inc = IncidentBuilder("10.0.0.3")
+    inc.mark("detect", 1.0)
+    path = inc.commit(d)
+    assert os.path.basename(path) == "incident-1.json"
+    with open(path) as f:
+        assert json.load(f)["lost_ip"] == "10.0.0.3"
+    assert not [n for n in os.listdir(d) if n.startswith(".incident")]
+
+
+def test_commit_without_sink_is_none(monkeypatch):
+    from oobleck_tpu.utils import metrics
+
+    monkeypatch.delenv(metrics.ENV_METRICS_DIR, raising=False)
+    assert IncidentBuilder("x").commit() is None
+
+
+def test_list_incidents_skips_corrupt_and_orders_by_index(tmp_path):
+    d = str(tmp_path)
+    for n, ip in ((10, "10.0.0.10"), (2, "10.0.0.2")):
+        inc = IncidentBuilder(ip)
+        with open(os.path.join(d, f"incident-{n}.json"), "w") as f:
+            json.dump(inc.build(), f)
+    (tmp_path / "incident-5.json").write_text("{torn write")
+    got = list_incidents(d)
+    assert [r["lost_ip"] for _, r in got] == ["10.0.0.2", "10.0.0.10"]
+    assert next_index(d) == 11  # never reuses a seen index
+
+
+# ------------------------------------------------------------------ #
+# report CLI over a committed incident
+
+
+def test_report_renders_incident_and_trace(tmp_path, capfd):
+    d = str(tmp_path)
+    inc = IncidentBuilder("10.0.0.2", cause="chaos_kill_stage")
+    inc.mark("detect", 100.0)
+    inc.mark("apply_start", 100.2)
+    inc.mark("first_step", 101.0)
+    spans.span_recorder().record("engine.reconfigure", 100.2, 100.9,
+                                 trace_id=inc.trace_id)
+    assert inc.commit(d)
+    out_trace = str(tmp_path / "merged.json")
+    rc = report.main(["--dir", d, "--trace", out_trace])
+    assert rc == 0
+    # capfd, not capsys: render_incident's `out` default bound sys.stdout
+    # at import time, so only fd-level capture sees the table.
+    out = capfd.readouterr().out
+    assert "incident-0.json" in out
+    assert "detect_to_apply_start" in out
+    assert "chaos_kill_stage" in out
+    with open(out_trace) as f:
+        merged = json.load(f)
+    assert any(e["ph"] == "X" and e["name"] == "engine.reconfigure"
+               for e in merged["traceEvents"])
+    assert merged["otherData"]["incidents"] == ["incident-0.json"]
+
+
+def test_report_missing_dir_fails_cleanly(tmp_path, capsys):
+    rc = report.main(["--dir", str(tmp_path / "nope")])
+    assert rc == 1
+    assert "no metrics directory" in capsys.readouterr().err

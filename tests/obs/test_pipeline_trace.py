@@ -1,0 +1,116 @@
+"""Per-op pipeline timeline (oobleck_tpu/obs/pipeline_trace): the exported
+slices must be the SAME computation as the engine's measured bubble gauge —
+gap fraction from the trace equals simulate_bubble — and the rendered
+Chrome-trace must be structurally loadable (complete X events, named
+stage lanes, borrowed-microbatch tagging after a reroute)."""
+
+import json
+
+import pytest
+
+from oobleck_tpu.execution.schedule import Op, simulate_bubble
+from oobleck_tpu.obs import pipeline_trace as ptrace
+
+
+class FakePipe:
+    """The attribute surface pipeline_trace() reads off a PipelineInstance."""
+
+    def __init__(self, S, M, v=1, pipeline_id=0, op_times=None,
+                 original=None):
+        self.num_stages = S
+        self.num_microbatches = M
+        self.virtual_stages = v
+        self.pipeline_id = pipeline_id
+        self.last_op_times = op_times or {}
+        self.original_num_microbatches = original
+
+
+def _gap_from_slices(slices, makespan, S):
+    busy = sum(end - start for _, start, end in slices)
+    return 1.0 - busy / (S * makespan)
+
+
+@pytest.mark.parametrize("S,M,v", [(2, 8, 1), (2, 8, 2), (4, 8, 1)])
+def test_replayed_gap_matches_simulate_bubble(S, M, v):
+    """ISSUE acceptance: trace-derived gap within 0.05 of simulate_bubble.
+    They are one replay, so the match is in fact exact."""
+    slices, makespan, busy = ptrace.replay_slices(S, M, v)
+    assert slices and makespan > 0
+    gap = _gap_from_slices(slices, makespan, S)
+    assert gap == pytest.approx(simulate_bubble(S, M, v), abs=0.05)
+    assert gap == pytest.approx(simulate_bubble(S, M, v), rel=1e-12)
+    # every scheduled unit appears exactly once: S*v fwd + S*v bwd per mb
+    assert len(slices) == S * v * M * 2
+
+
+def test_replay_slices_with_calibrated_durations():
+    op_times = {(0, 0, "f"): (2.0, 2), (1, 0, "f"): (6.0, 2),
+                (0, 0, "b"): (8.0, 2), (1, 0, "b"): (18.0, 2)}
+    dur = ptrace.duration_fn_from_op_times(op_times)
+    slices, makespan, busy = ptrace.replay_slices(2, 4, 1, dur)
+    assert makespan > 0
+    gap = _gap_from_slices(slices, makespan, 2)
+    assert gap == pytest.approx(simulate_bubble(2, 4, 1, dur), rel=1e-12)
+    # stage-1 fwd slices carry the calibrated 3.0 s average
+    s1f = [end - start for inst, start, end in slices
+           if inst.stage == 1 and inst.op is Op.FORWARD]
+    assert all(d == pytest.approx(3.0) for d in s1f)
+
+
+def test_duration_fn_falls_back_to_same_kind_average():
+    dur = ptrace.duration_fn_from_op_times({(0, 0, "f"): (4.0, 2)})
+
+    class Inst:
+        op = Op.FORWARD
+        stage = 1
+        chunk = 0
+
+    assert dur(Inst()) == pytest.approx(2.0)  # never-timed chunk -> avg
+
+
+def test_pipeline_trace_chrome_shape_and_lanes():
+    trace = ptrace.pipeline_trace([FakePipe(2, 4, pipeline_id=3)])
+    events = trace["traceEvents"]
+    meta = [e for e in events if e["ph"] == "M"]
+    xs = [e for e in events if e["ph"] == "X"]
+    assert {m["name"] for m in meta} == {"process_name", "thread_name"}
+    assert [m["args"]["name"] for m in meta
+            if m["name"] == "process_name"] == ["pipeline-3"]
+    assert sorted(m["args"]["name"] for m in meta
+                  if m["name"] == "thread_name") == ["stage 0", "stage 1"]
+    assert len(xs) == 2 * 4 * 2  # S*M*(fwd+bwd)
+    for e in xs:
+        assert e["pid"] == 3 and e["tid"] == e["args"]["stage"]
+        assert e["dur"] > 0 and e["ts"] >= 0
+    (summary,) = trace["otherData"]["pipelines"]
+    assert summary["bubble_fraction"] == pytest.approx(
+        simulate_bubble(2, 4, 1))
+    assert summary["calibrated"] is False
+    json.dumps(trace)
+
+
+def test_borrowed_microbatches_are_tagged():
+    """After a reroute the survivor runs extra microbatches; the trace must
+    distinguish them so the absorbed work is visible in Perfetto."""
+    trace = ptrace.pipeline_trace([FakePipe(2, 6, original=4)])
+    xs = [e for e in trace["traceEvents"] if e["ph"] == "X"]
+    borrowed = {e["args"]["microbatch"] for e in xs
+                if e["args"].get("borrowed")}
+    native = {e["args"]["microbatch"] for e in xs
+              if not e["args"].get("borrowed")}
+    assert borrowed == {4, 5}
+    assert native == {0, 1, 2, 3}
+
+
+def test_interleaved_slice_names_carry_chunk():
+    trace = ptrace.pipeline_trace([FakePipe(2, 8, v=2)])
+    names = {e["name"] for e in trace["traceEvents"] if e["ph"] == "X"}
+    assert "F mb0 c0" in names and "F mb0 c1" in names
+
+
+def test_write_pipeline_trace_atomic(tmp_path):
+    path = str(tmp_path / "pipe.json")
+    trace = ptrace.write_pipeline_trace(path, [FakePipe(2, 4)])
+    with open(path) as f:
+        assert json.load(f) == trace
+    assert not list(tmp_path.glob("*.tmp-*"))

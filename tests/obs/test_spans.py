@@ -1,0 +1,159 @@
+"""Span recorder core (oobleck_tpu/obs/spans): ring bounds, nesting /
+ambient-context stitching, wire propagation (inject/extract with legacy
+peers), and the Chrome-trace export contract Perfetto actually loads."""
+
+import json
+import threading
+
+from oobleck_tpu.obs import spans
+
+
+def test_ring_is_bounded_and_thread_safe():
+    rec = spans.SpanRecorder(capacity=8)
+    def worker(k):
+        for i in range(50):
+            rec.record(f"w{k}.{i}", 0.0, 1.0)
+    threads = [threading.Thread(target=worker, args=(k,)) for k in range(4)]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join()
+    got = rec.spans()
+    assert len(got) == 8  # 200 recorded, only the newest 8 retained
+    assert all(s["span_id"] and s["trace_id"] for s in got)
+
+
+def test_capacity_env_parsing(monkeypatch):
+    monkeypatch.setenv(spans.ENV_SPAN_CAPACITY, "3")
+    assert spans.SpanRecorder()._ring.maxlen == 3
+    monkeypatch.setenv(spans.ENV_SPAN_CAPACITY, "banana")
+    assert spans.SpanRecorder()._ring.maxlen == 1024  # malformed -> default
+    monkeypatch.setenv(spans.ENV_SPAN_CAPACITY, "0")
+    assert spans.SpanRecorder()._ring.maxlen == 1  # floor, never unbounded
+
+
+def test_nested_spans_share_trace_and_parent():
+    rec = spans.SpanRecorder(capacity=16)
+    with spans.span("outer", recorder=rec) as outer:
+        with spans.span("inner", recorder=rec) as inner:
+            assert inner["trace_id"] == outer["trace_id"]
+    inner_s, outer_s = rec.spans()  # inner closes (and records) first
+    assert inner_s["name"] == "inner" and outer_s["name"] == "outer"
+    assert inner_s["parent_id"] == outer_s["span_id"]
+    assert inner_s["trace_id"] == outer_s["trace_id"]
+    assert outer_s["parent_id"] is None
+    assert outer_s["t1"] >= outer_s["t0"]
+
+
+def test_ambient_context_stitches_unrelated_spans():
+    """The engine pins the incident trace as ambient around reconfigure();
+    spans opened anywhere in the process during that window must join it."""
+    rec = spans.SpanRecorder(capacity=16)
+    tid = spans.new_trace_id()
+    spans.set_ambient({"trace_id": tid, "span_id": "rootspan"})
+    try:
+        with spans.span("somewhere.deep", recorder=rec):
+            pass
+        ev = spans.event("a.point.mark")
+    finally:
+        spans.set_ambient(None)
+    s = rec.spans()[0]
+    assert s["trace_id"] == tid and s["parent_id"] == "rootspan"
+    assert ev["trace_id"] == tid
+    assert ev["t0"] == ev["t1"]  # point event
+    # ambient cleared: a fresh span mints its own trace again
+    with spans.span("after", recorder=rec):
+        pass
+    assert rec.spans()[-1]["trace_id"] != tid
+
+
+def test_for_trace_filters():
+    rec = spans.SpanRecorder(capacity=16)
+    a = rec.record("a", 0.0, 1.0)
+    rec.record("b", 0.0, 1.0)
+    assert [s["name"] for s in rec.for_trace(a["trace_id"])] == ["a"]
+
+
+# ------------------------------------------------------------------ #
+# wire propagation: the TRACE_KEY payload riding the elastic verbs
+
+
+def test_inject_extract_roundtrip():
+    with spans.span("sender") as ctx:
+        msg = {"kind": "reconfigure", "lost_ip": "10.0.0.2"}
+        msg[spans.TRACE_KEY] = spans.inject()
+    got = spans.extract(msg)
+    assert got == {"trace_id": ctx["trace_id"], "span_id": ctx["span_id"]}
+
+
+def test_extract_tolerates_legacy_and_malformed_peers():
+    # a legacy peer sends no trace key at all
+    assert spans.extract({"kind": "reconfigure", "lost_ip": "x"}) is None
+    assert spans.extract(None) is None
+    assert spans.extract("not a dict") is None
+    # future/hostile shapes must not raise, only decline
+    assert spans.extract({spans.TRACE_KEY: "oops"}) is None
+    assert spans.extract({spans.TRACE_KEY: {"trace_id": 7}}) is None
+    # extra context keys pass through untouched (forward compat)
+    ctx = {"trace_id": "abc", "detected_at": 1.5, "cause": "chaos"}
+    assert spans.extract({spans.TRACE_KEY: ctx}) == ctx
+
+
+def test_inject_without_context_mints_fresh_ids():
+    ctx = spans.inject()
+    assert isinstance(ctx["trace_id"], str) and len(ctx["trace_id"]) == 16
+
+
+# ------------------------------------------------------------------ #
+# Chrome-trace export
+
+
+def test_chrome_trace_shape_and_process_lanes():
+    rec = spans.SpanRecorder(capacity=16)
+    rec.record("step", 10.0, 10.5, foo="bar")
+    rec.record("other", 10.2, 10.3)
+    trace = spans.to_chrome_trace(rec.spans(), metadata={"src": "test"})
+    assert trace["displayTimeUnit"] == "ms"
+    assert trace["otherData"] == {"src": "test"}
+    xs = [e for e in trace["traceEvents"] if e["ph"] == "X"]
+    ms = [e for e in trace["traceEvents"] if e["ph"] == "M"]
+    assert len(xs) == 2
+    # one process lane per (role, pid), named for Perfetto's sidebar
+    assert [m["name"] for m in ms] == ["process_name"]
+    for e in xs:
+        assert e["ts"] >= 0 and e["dur"] >= 0  # complete events, never open
+        assert isinstance(e["args"]["trace_id"], str)
+    assert xs[0]["dur"] == 0.5e6  # seconds -> microseconds
+    assert xs[0]["args"]["foo"] == "bar"
+    json.dumps(trace)  # and the whole thing is JSON-serializable
+
+
+def test_write_chrome_trace_is_loadable(tmp_path):
+    rec = spans.SpanRecorder(capacity=4)
+    rec.record("a", 1.0, 2.0)
+    path = str(tmp_path / "trace.json")
+    assert spans.write_chrome_trace(path, rec.spans()) == path
+    with open(path) as f:
+        loaded = json.load(f)
+    assert {e["ph"] for e in loaded["traceEvents"]} == {"M", "X"}
+    assert not list(tmp_path.glob("*.tmp-*"))  # atomic: no droppings
+
+
+def test_dump_writes_jsonl_with_header(tmp_path, monkeypatch):
+    from oobleck_tpu.utils import metrics
+
+    monkeypatch.setenv(metrics.ENV_METRICS_DIR, str(tmp_path))
+    rec = spans.SpanRecorder(capacity=4)
+    rec.record("x", 0.0, 1.0)
+    path = rec.dump("test_reason")
+    assert path is not None
+    lines = [json.loads(line) for line in open(path)]
+    assert lines[0]["event"] == "dump" and lines[0]["reason"] == "test_reason"
+    assert [s["name"] for s in lines[1:]] == ["x"]
+
+
+def test_dump_disabled_without_sink(monkeypatch):
+    from oobleck_tpu.utils import metrics
+
+    monkeypatch.delenv(metrics.ENV_METRICS_DIR, raising=False)
+    assert spans.SpanRecorder(capacity=4).dump("r") is None
