@@ -2,7 +2,7 @@
 
 PY ?= python
 
-.PHONY: lint test test-slow tier1 bench bench-diff trace-report ckpt-bench serve-bench pipeline-bench degrade-bench
+.PHONY: lint test test-slow tier1 bench bench-diff trace-report ckpt-bench serve-bench pipeline-bench degrade-bench policy-bench
 
 # Lint via ruff (config in pyproject.toml). Degrades to a skip when ruff
 # is not installed — the hermetic CI image does not ship it, and the gate
@@ -71,3 +71,10 @@ degrade-bench:
 	JAX_PLATFORMS=cpu OOBLECK_METRICS_DIR= \
 		XLA_FLAGS="--xla_force_host_platform_device_count=4" \
 		$(PY) -m oobleck_tpu.degrade.bench
+
+# Adaptive recovery policy vs each forced mechanism under scripted churn
+# (single-host loss + correlated double loss). 8 virtual devices: 4 hosts.
+policy-bench:
+	JAX_PLATFORMS=cpu OOBLECK_METRICS_DIR= \
+		XLA_FLAGS="--xla_force_host_platform_device_count=8" \
+		$(PY) -m oobleck_tpu.policy.bench

@@ -476,6 +476,44 @@ def _degrade_summary() -> dict:
         return {"error": f"unparseable degrade bench output: {exc}"}
 
 
+POLICY_BENCH_TIMEOUT_S = 420
+
+
+def _policy_summary() -> dict:
+    """Adaptive-recovery policy microbench (oobleck_tpu/policy/bench.py)
+    in a throwaway CPU subprocess with 8 virtual devices (4 hosts x 2
+    chips: enough survivors to replay a single-host loss AND a correlated
+    double loss). Compares the adaptive scorer against every forced
+    mechanism on the same scripted churn; never on the TPU relay — it
+    builds and kills four engines."""
+    env = dict(os.environ)
+    env.update({
+        "JAX_PLATFORMS": "cpu", "PALLAS_AXON_POOL_IPS": "",
+        "OOBLECK_METRICS_DIR": "",
+        "XLA_FLAGS": (env.get("XLA_FLAGS", "")
+                      + " --xla_force_host_platform_device_count=8").strip(),
+    })
+    env.pop(_INNER_ENV, None)
+    env.pop(_PIPELINE_ENV, None)
+    env.pop("OOBLECK_POLICY", None)  # arms are forced in-process, not by env
+    proc = subprocess.Popen(
+        [sys.executable, "-m", "oobleck_tpu.policy.bench"],
+        stdout=subprocess.PIPE, stderr=subprocess.PIPE, text=True, env=env)
+    try:
+        out, err = proc.communicate(timeout=POLICY_BENCH_TIMEOUT_S)
+    except subprocess.TimeoutExpired:
+        proc.kill()
+        return {"error": f"policy bench hung >{POLICY_BENCH_TIMEOUT_S}s"}
+    if proc.returncode != 0:
+        tail = (err or "").strip().splitlines()[-1:] or ["no stderr"]
+        return {"error":
+                f"policy bench exit {proc.returncode}: {tail[0][:160]}"}
+    try:
+        return json.loads(out.strip().splitlines()[-1])
+    except Exception as exc:  # noqa: BLE001
+        return {"error": f"unparseable policy bench output: {exc}"}
+
+
 SERVE_BENCH_TIMEOUT_S = 75
 
 
@@ -583,6 +621,13 @@ def _emit(result: dict) -> None:
         result["degrade"] = _degrade_summary()
     except Exception as exc:  # noqa: BLE001 — emit must never fail
         result["degrade"] = {"error": f"{type(exc).__name__}: {exc}"}
+    # Adaptive-recovery policy (scorer vs each forced mechanism under
+    # scripted churn): CPU subprocess, bounded, best-effort — see
+    # _policy_summary.
+    try:
+        result["policy"] = _policy_summary()
+    except Exception as exc:  # noqa: BLE001 — emit must never fail
+        result["policy"] = {"error": f"{type(exc).__name__}: {exc}"}
     _stamp_provenance(result)
     print(json.dumps(result))
 

@@ -47,7 +47,7 @@ import numpy as np
 
 from oobleck_tpu.ckpt import manifest as mf
 from oobleck_tpu.ckpt import snapshot as snp
-from oobleck_tpu.utils import metrics
+from oobleck_tpu.utils import background, metrics
 from oobleck_tpu.utils.chaos import chaos
 
 logger = logging.getLogger("oobleck.ckpt")
@@ -124,7 +124,10 @@ class SnapshotWriter:
         double-buffer drain), stages the snapshot to host copies, then
         enqueues and returns. Sync: performs the full write inline."""
         t0 = time.perf_counter()
-        snp.stage_to_host(snap)
+        # Staging reads device buffers back to host; fence it against the
+        # recovery precompiler's background compiles (utils/background.py).
+        with background.device_work("ckpt_stage"):
+            snp.stage_to_host(snap)
         if not self.asynchronous:
             try:
                 self._write(snap)

@@ -141,7 +141,10 @@ def _apply_reroute(engine, lost_ip: str, report, plan) -> None:
     re-instantiation slow: no weight collection/re-placement, no stage
     rebuild, no optimizer-state re-placement — survivors keep their
     arrays and compiled programs untouched."""
-    from oobleck_tpu.execution.engine import DataParallelEngine
+    from oobleck_tpu.execution.engine import (
+        DataParallelEngine,
+        MultiHostDataParallelEngine,
+    )
     from oobleck_tpu.execution.dataloader import (
         DeviceStager,
         OobleckDataLoader,
@@ -149,6 +152,9 @@ def _apply_reroute(engine, lost_ip: str, report, plan) -> None:
         PrefetchingLoader,
     )
     from oobleck_tpu.planning.instantiator import HeterogeneousPlan
+
+    multihost = bool(getattr(engine, "multihost", False)
+                     and engine.comm is not None)
 
     # Data position carries over — taken from the CONSUMED position, so a
     # prefetched-but-unconsumed iteration is replayed, not skipped.
@@ -181,16 +187,34 @@ def _apply_reroute(engine, lost_ip: str, report, plan) -> None:
             epoch=epoch,
         )
         loader = OobleckDataLoader(engine.dataset, sampler)
-        if engine._prefetch_enabled():
-            loader = DeviceStager(
-                loader,
-                lambda b, _p=pipe: _p._place_batch(_p._as_batch_dict(b))[0],
-            )
-        else:
-            loader = PrefetchingLoader(loader)
+        # Multihost: non-participating pipelines only track position
+        # (advance()), exactly as in engine._materialize_plan.
+        if not multihost or pipe.participates_locally:
+            if engine._prefetch_enabled():
+                loader = DeviceStager(
+                    loader,
+                    lambda b, _p=pipe: _p._place_batch(
+                        _p._as_batch_dict(b))[0],
+                )
+            else:
+                loader = PrefetchingLoader(loader)
         engine.dataloaders.append(loader)
 
-    engine.dp_engine = DataParallelEngine(survivors)
+    if multihost:
+        # Zero-respawn multihost reroute: the world object survives, but
+        # the drained victim process leaves the collectives — shrink the
+        # loss-psum membership (and the engine's consensus set) to the
+        # survivors so nothing ever waits on the corpse.
+        lost_proc = engine._host_index[lost_ip]
+        live = [p for p in (engine._live_procs
+                            if engine._live_procs is not None
+                            else range(engine.comm.process_count))
+                if p != lost_proc]
+        engine._live_procs = live
+        engine.dp_engine = MultiHostDataParallelEngine(
+            survivors, engine.model, engine.comm, participants=live)
+    else:
+        engine.dp_engine = DataParallelEngine(survivors)
     engine.host_ips.remove(lost_ip)
     if engine.plan is not None:
         # Rebuild the plan descriptor so /status and the precompile

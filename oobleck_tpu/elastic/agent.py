@@ -38,6 +38,7 @@ from oobleck_tpu.elastic.message import (
     send_request,
 )
 from oobleck_tpu.obs import spans
+from oobleck_tpu.policy.engine import DECISION_KEY
 from oobleck_tpu.utils import metrics, recovery
 from oobleck_tpu.utils.chaos import chaos
 
@@ -101,6 +102,10 @@ class OobleckAgent:
         # Heartbeat RTT: stamp of the last PING sent; the PONG in the
         # response loop closes the measurement.
         self._ping_sent_at: float | None = None
+        # True while a chaos flap cycle holds the master connection down:
+        # the response/ping loops must ride it out instead of terminating
+        # on the (intentional) connection loss.
+        self._flapping = False
         reg = metrics.registry()
         self._m_rtt = reg.gauge(
             "oobleck_agent_heartbeat_rtt_seconds",
@@ -124,9 +129,17 @@ class OobleckAgent:
         # seconds. Pinging only after profiling would get a healthy agent
         # evicted as hung before its worker ever launched, so the bring-up
         # runs off-thread while the event loop keeps the control plane live.
-        await asyncio.gather(self._bringup(), self.response_loop(),
-                             self.ping_loop(), self.worker_port_loop(),
-                             self.worker_watch_loop())
+        tasks = [self._bringup(), self.response_loop(),
+                 self.ping_loop(), self.worker_port_loop(),
+                 self.worker_watch_loop()]
+        # Churn fault injections owned by the agent (utils/chaos.py).
+        flap = chaos().flap_period(self.agent_ip)
+        if flap is not None:
+            tasks.append(self._flap_loop(flap))
+        notice = chaos().preempt_notice(self.agent_ip)
+        if notice is not None:
+            tasks.append(self._preemption_chaos(*notice))
+        await asyncio.gather(*tasks)
 
     async def _bringup(self) -> None:
         await asyncio.to_thread(self.ensure_profile)
@@ -181,6 +194,59 @@ class OobleckAgent:
     @staticmethod
     def _multihost() -> bool:
         return os.environ.get("OOBLECK_MULTIHOST") == "1"
+
+    # -- churn fault injections (utils/chaos.py directives) -------------- #
+
+    async def _flap_loop(self, period: float) -> None:
+        """flap_host: drop the master connection every `period` seconds and
+        re-register — the repeated down/up the policy plane's quarantine
+        exists for. The gap before re-dialing lets the master observe the
+        disconnect as a failure (that is the point of the fault). Once the
+        master quarantines this host, register() exhausts its bounded
+        retries and the agent dies for real — a quarantined flapper must
+        not hammer the control plane forever."""
+        while True:
+            await asyncio.sleep(period)
+            logger.warning("chaos: flap — dropping master connection")
+            metrics.flight_recorder().record(
+                "chaos_injection", action="flap_drop", ip=self.agent_ip)
+            self._flapping = True
+            try:
+                self._writer.close()
+                await self._writer.wait_closed()
+            except (ConnectionError, OSError):
+                pass
+            await asyncio.sleep(min(1.0, period / 4))
+            await self.connect_to_master()
+            await self.register()  # raises once quarantined -> agent exits
+            self._flapping = False
+            logger.warning("chaos: flap — re-registered")
+
+    async def _preemption_chaos(self, warn_s: float, delay_s: float) -> None:
+        """preempt_notice: after `delay_s`, send the master a SIGTERM-style
+        advance warning, then die for real `warn_s` later — whatever state
+        the drain managed to flush by then is all that survives."""
+        await asyncio.sleep(delay_s)
+        logger.warning("chaos: preemption notice (host dies in %.1fs)",
+                       warn_s)
+        try:
+            async with self._send_lock:
+                await send_request(self._writer,
+                                   RequestType.PREEMPTION_NOTICE,
+                                   {"ip": self.agent_ip,
+                                    "deadline_s": warn_s})
+        except (ConnectionError, OSError):
+            pass
+        await asyncio.sleep(warn_s)
+        logger.warning("chaos: preemption deadline reached; host dies now")
+        metrics.flight_recorder().record(
+            "chaos_injection", action="preempt_kill", ip=self.agent_ip)
+        metrics.flight_recorder().dump("preemption_deadline")
+        w = self.worker
+        if w is not None and w.process.is_alive():
+            w.process.kill()
+        logging.shutdown()
+        os._exit(1)
 
     async def connect_to_master(self, attempts: int = CONNECT_ATTEMPTS) -> None:
         """Exponential-backoff reconnect: agents race the master's listener
@@ -353,9 +419,16 @@ class OobleckAgent:
         """Dispatch master messages (reference on_receive_response,
         agent.py:234-278)."""
         while True:
+            if self._flapping:
+                # A chaos flap cycle owns the connection (and its register
+                # handshake reads); stay off the stream until it is back.
+                await asyncio.sleep(0.1)
+                continue
             try:
                 msg = await recv_msg(self._reader, timeout=None)
-            except (asyncio.IncompleteReadError, ConnectionError):
+            except (asyncio.IncompleteReadError, ConnectionError, OSError):
+                if self._flapping:
+                    continue
                 logger.error("master connection lost; exiting")
                 self.terminate()
                 return
@@ -368,10 +441,16 @@ class OobleckAgent:
                 continue
             if kind == ResponseType.RECONFIGURATION.value:
                 await self.on_reconfiguration(msg["lost_ip"],
-                                              trace=spans.extract(msg))
+                                              trace=spans.extract(msg),
+                                              decision=msg.get(DECISION_KEY))
             elif kind == ResponseType.DEGRADE.value:
                 await self.on_reconfiguration(msg["lost_ip"], degrade=True,
-                                              trace=spans.extract(msg))
+                                              trace=spans.extract(msg),
+                                              decision=msg.get(DECISION_KEY))
+            elif kind == ResponseType.RESTORE.value:
+                await self.on_reconfiguration(msg["lost_ip"], restore=True,
+                                              trace=spans.extract(msg),
+                                              decision=msg.get(DECISION_KEY))
             elif kind == ResponseType.FORWARD_COORDINATOR.value:
                 payload = {"kind": "coordinator", "address": msg["address"]}
                 if msg.get("world") is not None:
@@ -387,22 +466,31 @@ class OobleckAgent:
 
     async def on_reconfiguration(self, lost_ip: str,
                                  degrade: bool = False,
-                                 trace: dict | None = None) -> None:
+                                 restore: bool = False,
+                                 trace: dict | None = None,
+                                 decision: dict | None = None) -> None:
         """Reference on_receive_reconfiguration (agent.py:217-232).
 
-        `degrade` carries the master's DEGRADE verb through to the worker:
-        the engine should try the zero-reconfiguration reroute fast path
-        (oobleck_tpu/degrade) before template re-instantiation. Victim
-        self-termination and multihost respawn are verb-independent — a
-        dead host is dead either way; the verb only matters to a surviving
-        single-host engine that can recover in place.
+        `degrade` / `restore` carry the master's verb through to the
+        worker: reroute the loss into pipeline bubbles (oobleck_tpu/
+        degrade) or resume from the last durable checkpoint, instead of
+        the default template re-instantiation. `decision` is the policy
+        plane's full verdict (oobleck_tpu/policy), forwarded down the
+        worker pipe so the engine honors the same mechanism the master
+        chose; a proactive decision (preemption notice) makes the VICTIM
+        drain — checkpoint flush before the host dies — rather than
+        self-terminate on the spot. A DEGRADE decision flagged `inplace`
+        on multihost is forwarded to the live worker (survivors reroute
+        at a consensus step boundary, zero respawns) instead of paying
+        the ~21 s respawn.
 
         `trace` is the incident's propagated trace context (obs/spans);
         the agent stamps its notified_at wall time into it and forwards it
         down the worker pipe so the engine's incident report spans master,
         agent, and worker."""
-        logger.warning("host %s lost%s", lost_ip,
-                       " (degrade requested)" if degrade else "")
+        verb = ("restore" if restore
+                else "degrade" if degrade else "reconfiguration")
+        logger.warning("host %s lost (verb=%s)", lost_ip, verb)
         self._notified_at = time.monotonic()
         notified_wall = time.time()
         if trace is not None:
@@ -413,10 +501,22 @@ class OobleckAgent:
                 ip=self.agent_ip)
         metrics.flight_recorder().record("reconfiguration_notified",
                                          lost_ip=lost_ip, ip=self.agent_ip,
-                                         verb="degrade" if degrade
-                                         else "reconfiguration")
+                                         verb=verb)
         recovery.mark(recovery.NOTIFIED, lost_ip=lost_ip, ip=self.agent_ip)
         if lost_ip == self.agent_ip:
+            w = self.worker
+            if (decision and decision.get("proactive") and w is not None
+                    and w.process.is_alive()):
+                # Advance notice: the host is still alive — drain. The
+                # worker flushes its checkpoint and exits 0; the watch
+                # loop then reports JOB_DONE and the agent exits cleanly.
+                logger.warning("this host is being preempted; draining "
+                               "worker before death")
+                payload = {"kind": "drain", "lost_ip": lost_ip}
+                if trace is not None:
+                    payload[spans.TRACE_KEY] = trace
+                w.pipe.send(payload)
+                return
             # We are declared dead: the built-in failure-injection kill switch.
             logger.warning("this host is the victim; terminating")
             self.terminate()
@@ -429,6 +529,22 @@ class OobleckAgent:
                 # Our own training already completed; a peer's departure
                 # (however the master classified it) changes nothing.
                 logger.info("training already complete; ignoring host loss")
+                return
+            if (degrade and decision and decision.get("inplace")
+                    and w is not None and w.process.is_alive()):
+                # ROADMAP item-1 remainder: survivors apply the reroute in
+                # place. The victim is still draining (proactive notice),
+                # so the jax.distributed world is not yet broken — all
+                # processes agree on a reroute generation and apply it at
+                # the same step boundary (engine-side consensus). If the
+                # engine can't, it sends `degrade_fallback` back up and we
+                # respawn after all.
+                payload = {"kind": "degrade", "lost_ip": lost_ip,
+                           "inplace": True}
+                if trace is not None:
+                    payload[spans.TRACE_KEY] = trace
+                payload[DECISION_KEY] = decision
+                w.pipe.send(payload)
                 return
             # A peer process is gone: the shared jax.distributed world is
             # broken and cannot shrink in place — restart the worker over
@@ -444,15 +560,20 @@ class OobleckAgent:
             # reference's NCCL-rebuild model (engine.py:91-180). The verb
             # survives the pipe so the engine's listener sees what the
             # master asked for.
-            payload = {"kind": "degrade" if degrade else "reconfigure",
-                       "lost_ip": lost_ip}
+            kind = ("restore" if restore
+                    else "degrade" if degrade else "reconfigure")
+            payload = {"kind": kind, "lost_ip": lost_ip}
             if trace is not None:
                 payload[spans.TRACE_KEY] = trace
+            if decision is not None:
+                payload[DECISION_KEY] = decision
             self.worker.pipe.send(payload)
 
     async def ping_loop(self) -> None:
         while True:
             await asyncio.sleep(self.ping_interval)
+            if self._flapping:
+                continue  # connection intentionally down (chaos flap)
             if chaos().heartbeat_stalled(self.agent_ip):
                 # Fault injection: go silent WITHOUT closing the socket —
                 # the hung-peer case only the master's heartbeat deadline
@@ -467,7 +588,9 @@ class OobleckAgent:
                 # cadence — one extra fire-and-forget frame per interval.
                 await self._push_metrics("agent",
                                          metrics.registry().snapshot())
-            except ConnectionError:
+            except (ConnectionError, OSError):
+                if self._flapping:
+                    continue
                 return
 
     async def _push_metrics(self, role: str, snapshot: dict) -> None:
@@ -492,6 +615,17 @@ class OobleckAgent:
                         # master's /metrics covers training-quality gauges.
                         await self._push_metrics(
                             "worker", msg.get("snapshot") or {})
+                    elif msg.get("kind") == "degrade_fallback":
+                        # The engine judged the in-place multihost reroute
+                        # infeasible after all — pay for the respawn.
+                        logger.warning(
+                            "worker cannot apply in-place reroute (%s); "
+                            "respawning", msg.get("reason"))
+                        metrics.flight_recorder().record(
+                            "degrade_fallback", ip=self.agent_ip,
+                            reason=msg.get("reason"))
+                        async with self._worker_lock:
+                            await asyncio.to_thread(self.respawn_worker)
                     elif msg.get("kind") == "coordinator":
                         # Keep the `world` generation tag intact: dropping
                         # it here would make every downstream worker take

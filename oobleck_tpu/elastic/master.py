@@ -47,6 +47,9 @@ from oobleck_tpu.elastic.message import (
     send_response,
 )
 from oobleck_tpu.obs import spans
+from oobleck_tpu.policy import PolicyEngine
+from oobleck_tpu.policy.engine import DECISION_KEY, MECH_REINSTANTIATE, \
+    MECH_REROUTE, MECH_RESTORE
 from oobleck_tpu.utils import metrics, recovery
 
 MAX_NUM_HOSTS = 32
@@ -175,6 +178,10 @@ class OobleckMasterDaemon:
         # Incident forensics reports (obs/incident.py) committed by workers
         # and pushed up piggybacked on METRICS snapshots; bounded ring.
         self._incidents: list[dict] = []
+        # Adaptive fault-tolerance policy: scores reroute / reinstantiate /
+        # restore per incident from live signals (oobleck_tpu/policy).
+        self.policy = PolicyEngine(
+            multihost=os.environ.get("OOBLECK_MULTIHOST") == "1")
         self.metrics_port: int | None = None
         self._http: metrics.MetricsHTTPServer | None = None
         reg = metrics.registry()
@@ -309,7 +316,65 @@ class OobleckMasterDaemon:
                 r for r in recoveries if r.get("resolved_at") is None
             ],
             "incidents": incidents,
+            # Bounded like the incident digest: quarantine set, per-host
+            # MTBF estimates, and the last MAX_DECISIONS policy decisions.
+            "policy": self.policy.status(),
         }
+
+    # -- live signals for the policy scorer (worker-pushed metrics) ------ #
+
+    def _worker_series(self, name: str):
+        """All series of one metric family across worker snapshots."""
+        with self._snap_lock:
+            snaps = [snap for (_, role), snap
+                     in self._remote_snapshots.items() if role == "worker"]
+        for snap in snaps:
+            for m in snap.get("metrics", []):
+                if m["name"] == name:
+                    yield from m["series"]
+
+    def _worker_gauge_max(self, name: str) -> float | None:
+        vals = [s.get("value", 0) for s in self._worker_series(name)]
+        return max(vals) if vals else None
+
+    def _step_seconds(self) -> float | None:
+        """Mean step wall time across the cluster, or None pre-training."""
+        total = count = 0.0
+        for s in self._worker_series("oobleck_engine_step_seconds"):
+            total += s.get("sum", 0.0)
+            count += s.get("count", 0)
+        return total / count if count else None
+
+    def _staleness_steps(self) -> float | None:
+        """current step - last durable checkpoint step, or None when no
+        restorable checkpoint exists (restore infeasible)."""
+        durable = self._worker_gauge_max("oobleck_ckpt_last_durable_step")
+        if durable is None or durable < 0:
+            return None
+        step = self._worker_gauge_max("oobleck_engine_steps_total")
+        return max(float(step) - durable, 0.0) if step is not None else 0.0
+
+    def _projected_retention(self) -> float | None:
+        """The degrade plane's replay-projected survivor throughput, as
+        published by the workers (planner projection when one exists)."""
+        return self._worker_gauge_max("oobleck_degrade_projected_retention")
+
+    def decide_recovery(self, lost_ips: list[str], *,
+                        proactive: bool = False):
+        """Consult the policy engine with master-side live signals."""
+        degrade = os.environ.get("OOBLECK_DEGRADE", "1").lower() not in (
+            "0", "false", "no")
+        survivors = [ip for ip in self.agents if ip not in lost_ips]
+        total = len(survivors) + len(lost_ips)
+        return self.policy.decide(
+            lost_ips,
+            degrade_enabled=degrade,
+            reroute_retention=self._projected_retention(),
+            survivor_frac=len(survivors) / total if total else 1.0,
+            staleness_steps=self._staleness_steps(),
+            step_seconds=self._step_seconds(),
+            proactive=proactive,
+        )
 
     def _record_metrics_push(self, msg: dict) -> None:
         ip = msg.get("ip", "?")
@@ -398,6 +463,19 @@ class OobleckMasterDaemon:
         if self.job is None:
             await send_response(writer, ResponseType.FAILURE,
                                 {"error": "no job configured"})
+            writer.close()
+            return
+        if self.policy.is_quarantined(ip):
+            # Flap quarantine: a host that failed twice inside its MTBF
+            # window is refused until it proves stable (hysteresis in
+            # policy/health.py). The agent's bounded register backoff
+            # turns the refusal into a clean exit, not a retry storm.
+            logger.warning("refusing registration from quarantined host %s",
+                           ip)
+            metrics.flight_recorder().record("register_refused", ip=ip,
+                                             reason="quarantined")
+            await send_response(writer, ResponseType.FAILURE,
+                                {"error": "quarantined"})
             writer.close()
             return
         interval = float(msg.get("ping_interval") or DEFAULT_PING_INTERVAL)
@@ -491,6 +569,8 @@ class OobleckMasterDaemon:
             elif kind == RequestType.JOB_DONE.value:
                 logger.info("agent %s reports training complete", agent.ip)
                 agent.clean_exit = True
+            elif kind == RequestType.PREEMPTION_NOTICE.value:
+                await self._handle_preemption(agent, msg)
             elif kind == RequestType.FORWARD_COORDINATOR.value:
                 # First agent's worker announces the JAX coordinator address;
                 # relay to everyone (reference forward_rank0_port_handler,
@@ -520,6 +600,9 @@ class OobleckMasterDaemon:
         dump the ring — this is the postmortem moment. Mints the incident's
         trace_id: every span and verb in this recovery, in every process,
         stitches onto it."""
+        # Feed the online MTBF/flap estimator — the failure log IS the
+        # policy plane's churn signal.
+        self.policy.observe_failure(lost_ip, cause)
         trace_id = spans.new_trace_id()
         with self._snap_lock:
             self._recoveries.append({
@@ -535,6 +618,28 @@ class OobleckMasterDaemon:
         fr.record("detect", ip=lost_ip, cause=cause, trace_id=trace_id)
         fr.dump(f"failure_detected:{lost_ip}")
 
+    async def _handle_preemption(self, agent: AgentInfo, msg: dict) -> None:
+        """Spot-preemption advance notice: the host will die in ~deadline_s.
+        React BEFORE the corpse appears — policy decision now (proactive),
+        recovery broadcast to everyone INCLUDING the victim, whose agent
+        drains its worker (checkpoint flush) inside the warning window.
+        The victim's later disconnect is then a clean exit, not a second
+        incident."""
+        ip = msg.get("ip") or agent.ip
+        deadline_s = float(msg.get("deadline_s") or 0.0)
+        logger.warning("preemption notice from %s: host dies in ~%.1fs",
+                       ip, deadline_s)
+        metrics.flight_recorder().record(
+            "preemption_notice", ip=ip, deadline_s=deadline_s)
+        self._on_failure_detected(ip, "preemption_notice")
+        decision = self.decide_recovery([ip], proactive=True)
+        victim = self.agents.get(ip)
+        if victim is not None:
+            # Its read-loop exit (the host dying) must not re-broadcast.
+            victim.clean_exit = True
+        await self._broadcast_recovery(ip, decision,
+                                       include=list(self.agents.values()))
+
     async def _close_agent(self, ip: str) -> None:
         """Reference close_agent (master.py:192-203): drop the agent and
         broadcast the loss to survivors — unless the agent announced a clean
@@ -544,15 +649,28 @@ class OobleckMasterDaemon:
             agent.writer.close()
         if agent is not None and agent.clean_exit:
             return
-        # Broadcast the degraded-mode verb when the deployment has it on
-        # (OOBLECK_DEGRADE, default yes): survivors try rerouting the lost
-        # host's microbatches into their pipeline bubbles before paying for
-        # re-instantiation. Distinct verb — the wire trace and flight
-        # recorder must show which recovery the master ASKED for, not just
-        # which one the engine took.
-        degrade = os.environ.get("OOBLECK_DEGRADE", "1").lower() not in (
-            "0", "false", "no")
-        verb = ResponseType.DEGRADE if degrade else ResponseType.RECONFIGURATION
+        # Adaptive policy (oobleck_tpu/policy): score reroute /
+        # reinstantiate / restore from live signals and broadcast the
+        # cheapest feasible verb. OOBLECK_DEGRADE=0 stays a hard
+        # feasibility gate on rerouting; OOBLECK_POLICY forces a fixed arm.
+        decision = self.decide_recovery([ip])
+        await self._broadcast_recovery(ip, decision,
+                                       include=list(self.agents.values()))
+
+    def _verb_for(self, mechanism: str) -> ResponseType:
+        return {
+            MECH_REROUTE: ResponseType.DEGRADE,
+            MECH_REINSTANTIATE: ResponseType.RECONFIGURATION,
+            MECH_RESTORE: ResponseType.RESTORE,
+        }[mechanism]
+
+    async def _broadcast_recovery(self, ip: str, decision,
+                                  include: list[AgentInfo]) -> None:
+        """Broadcast the decided recovery verb for the loss of `ip` with
+        the policy decision attached. The wire trace and flight recorder
+        must show which recovery the master ASKED for (and why), not just
+        which one the engine took."""
+        verb = self._verb_for(decision.mechanism)
         # Trace context rides the verb (one extra JSON key; legacy agents
         # ignore it) carrying the incident's trace_id plus the master-side
         # wall-clock marks, so the worker's incident report can reconstruct
@@ -563,6 +681,7 @@ class OobleckMasterDaemon:
             for r in self._recoveries:
                 if r["lost_ip"] == ip and r["broadcast_at"] is None:
                     r["broadcast_at"] = broadcast_at
+                    r["mechanism"] = decision.mechanism
                     if r.get("trace_id"):
                         trace_ctx = {
                             "trace_id": r["trace_id"],
@@ -570,14 +689,15 @@ class OobleckMasterDaemon:
                             "broadcast_at": broadcast_at,
                             "cause": r.get("cause"),
                         }
-        payload: dict = {"lost_ip": ip}
+        payload: dict = {"lost_ip": ip, DECISION_KEY: decision.as_payload()}
         if trace_ctx is not None:
             payload[spans.TRACE_KEY] = trace_ctx
+            decision.trace_id = trace_ctx["trace_id"]
             spans.span_recorder().record(
                 "incident.broadcast", broadcast_at, broadcast_at,
                 trace_id=trace_ctx["trace_id"], lost_ip=ip, verb=verb.value,
-                survivors=len(self.agents))
-        for other in list(self.agents.values()):
+                mechanism=decision.mechanism, survivors=len(self.agents))
+        for other in include:
             try:
                 await send_response(other.writer, verb, payload)
             except ConnectionError:
@@ -585,7 +705,8 @@ class OobleckMasterDaemon:
         self._m_reconfigs.inc()
         fr = metrics.flight_recorder()
         fr.record("reconfiguration_broadcast", lost_ip=ip,
-                  survivors=len(self.agents), verb=verb.value)
+                  survivors=len(self.agents), verb=verb.value,
+                  mechanism=decision.mechanism)
         # Second dump so the postmortem file holds the complete sequence
         # detect → broadcast (the detect-time dump races the broadcast).
         fr.dump(f"reconfiguration_broadcast:{ip}")

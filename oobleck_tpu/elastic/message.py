@@ -63,6 +63,12 @@ class RequestType(str, Enum):
     # can serve a merged cluster-wide /metrics view. No response — a slow
     # metrics path must never back-pressure the heartbeat channel.
     METRICS = "metrics"
+    # Spot-preemption advance notice ({"ip", "deadline_s"}): the agent's
+    # host received a SIGTERM-style warning and will die in ~deadline_s.
+    # The master reacts proactively — drain + checkpoint flush + reroute
+    # decided BEFORE the host disappears — instead of waiting for the
+    # heartbeat deadline to notice the corpse.
+    PREEMPTION_NOTICE = "preemption_notice"
 
 
 class ResponseType(str, Enum):
@@ -78,6 +84,13 @@ class ResponseType(str, Enum):
     # treating it as RECONFIGURATION (the engine funnels both into the
     # same recovery entry point, which tries reroute first anyway).
     DEGRADE = "degrade"
+    # Checkpoint-restore verb: the policy plane judged in-memory recovery
+    # a losing bet (churn storm, correlated loss) and the cluster should
+    # resume from the last durable checkpoint. Same payload shape as
+    # RECONFIGURATION; receivers that predate the verb treat it as
+    # RECONFIGURATION (the respawned worker restores from durable state
+    # on bringup anyway, so the fallback is correct, just slower).
+    RESTORE = "restore"
     FORWARD_COORDINATOR = "forward_coordinator"
 
 

@@ -63,7 +63,14 @@ from oobleck_tpu.parallel.train import make_optimizer
 from oobleck_tpu.planning.instantiator import HeterogeneousPlan, PipelineInstantiator
 from oobleck_tpu.planning.profiler import load_profile, profile
 from oobleck_tpu.planning.templates import PipelineTemplate, TemplateGenerator
-from oobleck_tpu.utils import metrics, recovery
+from oobleck_tpu.policy import DECISION_KEY as POLICY_DECISION_KEY
+from oobleck_tpu.policy import (
+    MECH_REINSTANTIATE,
+    MECH_REROUTE,
+    MECH_RESTORE,
+    decision_from_payload,
+)
+from oobleck_tpu.utils import background, metrics, recovery
 from oobleck_tpu.utils.chaos import chaos
 from oobleck_tpu.utils.timer import measure_time, sync_timers
 
@@ -322,12 +329,18 @@ class MultiHostDataParallelEngine:
     owner-set collectives can never deadlock. A 1-pipeline plan (no DP) has
     no shared layers and transfers ~nothing beyond the loss scalar."""
 
-    def __init__(self, pipelines: list[PipelineInstance], model, comm):
+    def __init__(self, pipelines: list[PipelineInstance], model, comm,
+                 participants=None):
         from oobleck_tpu.parallel.cross_host import (
             TypedFlatLayout, layer_avals)
 
         self.pipelines = pipelines
         self.comm = comm
+        # Loss-psum membership. Defaults to the whole world; an in-place
+        # degrade (zero-respawn recovery) shrinks it to the survivors so
+        # collectives never wait on the drained victim process.
+        self.participants = (list(participants) if participants is not None
+                            else list(range(comm.process_count)))
         # Union of owners across ALL pipelines (remote included): needed so
         # every process agrees on which layers are DP-shared.
         self.owners: dict[int, list[PipelineInstance]] = {}
@@ -468,7 +481,7 @@ class MultiHostDataParallelEngine:
                 loss_vec[2 * i] = float(loss) * weight
                 loss_vec[2 * i + 1] = weight
         tail = self.comm.group_sum(
-            loss_vec, loss_vec.shape[0], range(self.comm.process_count)
+            loss_vec, loss_vec.shape[0], self.participants
         )
         self.last_wire_bytes = self.comm.wire_bytes - wire0
 
@@ -522,14 +535,28 @@ class ReconfigurationEngine:
                 return
             if not isinstance(msg, dict):
                 continue
-            if msg.get("kind") in ("reconfigure", "degrade"):
-                # Both verbs funnel into the same pending queue: the engine
-                # tries the degrade fast path first whenever it is enabled,
-                # so the verb is a control-plane hint (and a distinct wire
-                # event for the flight recorder), not a hard dispatch. The
-                # incident's trace context rides along (obs/spans).
+            if msg.get("kind") == "drain":
+                # Proactive preemption: flush durable state at the next
+                # step boundary and exit cleanly (agent reports JOB_DONE).
+                self.engine.request_drain(trace=obs_spans.extract(msg))
+            elif (msg.get("kind") == "degrade" and msg.get("inplace")
+                    and self.engine.multihost):
+                # Multihost zero-respawn reroute: queued separately so every
+                # process can agree on ONE apply boundary via the per-step
+                # consensus collective (_maybe_inplace_degrade).
+                self.engine.request_inplace_degrade(
+                    msg["lost_ip"], trace=obs_spans.extract(msg),
+                    decision=msg.get(POLICY_DECISION_KEY))
+            elif msg.get("kind") in ("reconfigure", "degrade", "restore"):
+                # The verbs funnel into the same pending queue: the policy
+                # decision riding the payload (or, absent one, the engine's
+                # own policy consult) picks the mechanism, so the verb is a
+                # control-plane hint (and a distinct wire event for the
+                # flight recorder), not a hard dispatch. The incident's
+                # trace context rides along (obs/spans).
                 self.engine.request_reconfiguration(
-                    msg["lost_ip"], trace=obs_spans.extract(msg))
+                    msg["lost_ip"], trace=obs_spans.extract(msg),
+                    decision=msg.get(POLICY_DECISION_KEY))
             else:
                 self.engine._control_msgs.put(msg)
 
@@ -649,11 +676,30 @@ class OobleckEngine:
         args.execution.apply_durable_env_overrides()
         self._durable = None
         self.ckpt_stall_s: list[float] = []
-        self._pending_lost: list[tuple[str, dict | None]] = []
+        self._pending_lost: list[tuple[str, dict | None, dict | None]] = []
         self._lock = threading.Lock()
         import queue as _queue
 
         self._control_msgs: _queue.Queue = _queue.Queue()
+        # Policy plane (oobleck_tpu/policy): local decision engine for
+        # losses the control plane never saw (in-process chaos). A decision
+        # attached to the broadcast overrides it, so every process applies
+        # the master's verdict. Built lazily.
+        self._policy = None
+        # Set by a preemption drain request (or by the victim of an
+        # in-place degrade): flush durable state at the next step boundary
+        # and leave the train loop cleanly.
+        self._drain_requested = False
+        # Multihost in-place degrade consensus (_maybe_inplace_degrade):
+        # the listener thread enqueues under _lock; every process applies
+        # entry k only once ALL live processes have seen it.
+        self._inplace_queue: list[dict] = []
+        self._inplace_applied = 0
+        # Processes still in the per-step collectives; None = full world.
+        self._live_procs: list[int] | None = None
+        # EWMA of wall seconds per step: the policy scorer's unit for
+        # converting checkpoint staleness into lost work.
+        self._step_s_ewma: float | None = None
 
         # Training-quality metrics (utils/metrics.py): per-step gauges the
         # master aggregates cluster-wide via the METRICS push.
@@ -1542,6 +1588,13 @@ class OobleckEngine:
                                       for m in self.plan.num_microbatches),
                 hosts=str(len(self.host_ips)),
             )
+            # Refresh the projected reroute-retention gauge for the NEW
+            # topology (a representative single-host loss): the master's
+            # policy scorer reads it from the next snapshot push, so its
+            # decisions price degraded throughput from the live plan, not
+            # a prior.
+            if self.pipelines and self.host_ips:
+                self._projected_degrade_retention([self.host_ips[0]])
         elif self.fused is not None:
             self._m_template.set(
                 self.step, path="fused", hosts=str(len(self.host_ips)))
@@ -1748,14 +1801,36 @@ class OobleckEngine:
             while self.step < max_steps:
                 self._tracer.on_step(self.step)
                 self._maybe_chaos_kill_stage()
+                self._maybe_chaos_kill_hosts()
                 self._maybe_reconfigure()
+                self._maybe_inplace_degrade()
+                if self._drain_requested:
+                    # Preemption drain (or in-place-degrade victim): flush
+                    # durable state and leave cleanly — the agent reports
+                    # JOB_DONE, not a failure.
+                    logger.warning(
+                        "drain requested: flushing durable state and "
+                        "exiting cleanly at step %d", self.step)
+                    self.save_checkpoint(wait=True)
+                    metrics.flight_recorder().record(
+                        "drain_complete", ip=self.agent_ip, step=self.step)
+                    break
                 # Fault-injection points (utils/chaos.py): the barrier ip/
                 # ordinal selectors let a test SIGKILL exactly one worker at
                 # exactly one step boundary.
                 chaos().barrier("step_start", ip=self.agent_ip)
-                t0 = time.perf_counter()
-                loss = self._train_step()
-                step_s = time.perf_counter() - t0
+                # Fence the step dispatch against background XLA work
+                # (recovery precompiles, mirror device_get) — see
+                # utils/background.py. t0 sits inside the fence so step_s
+                # measures the step, not lock contention (the wait is
+                # flight-recorded separately as background_work_wait).
+                with background.device_work("train_step"):
+                    t0 = time.perf_counter()
+                    loss = self._train_step()
+                    step_s = time.perf_counter() - t0
+                self._step_s_ewma = (
+                    step_s if self._step_s_ewma is None
+                    else 0.8 * self._step_s_ewma + 0.2 * step_s)
                 chaos().barrier("step_end", ip=self.agent_ip)
                 first_after_recovery = self._recovering
                 if first_after_recovery:
@@ -2139,9 +2214,13 @@ class OobleckEngine:
         bufs = {dt: np.zeros(layout.lengths[dt], dt)
                 for dt in layout.dtypes}
         have = np.zeros(len(layout.layers), bool)
-        for li, tree in state.items():
-            layout.pack_into(bufs, li, tree)
-            have[layout.layers.index(li)] = True
+        # pack_into device_gets live jax arrays — fence it against the
+        # train thread's dispatch/readback (utils/background.py). The npz
+        # write below is pure host I/O and runs outside the fence.
+        with background.device_work("mirror"):
+            for li, tree in state.items():
+                layout.pack_into(bufs, li, tree)
+                have[layout.layers.index(li)] = True
         path.parent.mkdir(parents=True, exist_ok=True)
         tmp = path.with_suffix(".tmp.npz")
         np.savez(tmp, have=have, **meta,
@@ -2561,6 +2640,235 @@ class OobleckEngine:
             self._precompiler.wait()
         return self._precompiler
 
+    # -- adaptive fault-tolerance policy (oobleck_tpu/policy) ----------- #
+
+    def _policy_engine(self):
+        if self._policy is None:
+            from oobleck_tpu.policy import PolicyEngine
+
+            self._policy = PolicyEngine(multihost=self.multihost)
+        return self._policy
+
+    def _consult_policy(self, lost_ips: list[str], *, cause: str = ""):
+        """Score the recovery arms for an in-process-detected loss with
+        the same signals the master would use: planner-projected reroute
+        retention, durable-checkpoint staleness, measured step time, and
+        the local MTBF history."""
+        pol = self._policy_engine()
+        for ip in lost_ips:
+            pol.observe_failure(ip, cause)
+        staleness = None
+        plane = self._durable_plane()
+        if plane is not None:
+            durable = plane.last_durable_step
+            if durable is not None and durable >= 0:
+                staleness = max(float(self.step - durable), 0.0)
+        n = len(self.host_ips)
+        survivor_frac = (max(n - len(lost_ips), 0) / n) if n else 1.0
+        return pol.decide(
+            lost_ips,
+            degrade_enabled=(self.args.execution.degrade_enabled
+                             and self.fused is None),
+            reroute_retention=self._projected_degrade_retention(lost_ips),
+            survivor_frac=survivor_frac,
+            staleness_steps=staleness,
+            step_seconds=self._step_s_ewma,
+            cause=cause)
+
+    def _observe_policy_measured(self, mechanism: str,
+                                 seconds: float | None) -> None:
+        """Close the projected-vs-measured loop on the local policy engine
+        (and, via its histogram, on the master's next snapshot scan)."""
+        if seconds is not None:
+            self._policy_engine().observe_measured(mechanism, seconds)
+
+    def _projected_degrade_retention(self, lost_ips: list[str]
+                                     ) -> float | None:
+        """Planner-projected survivor throughput retention if `lost_ips`
+        were rerouted — the scorer's reroute-retention signal, published
+        as a gauge so the master scores from the same number. None when
+        the reroute is structurally off the table."""
+        if (len(lost_ips) != 1 or not self.pipelines
+                or self.fused is not None
+                or lost_ips[0] not in self.host_ips):
+            return None
+        try:
+            from oobleck_tpu.degrade.apply import specs_from_pipelines
+            from oobleck_tpu.degrade.classify import classify_failure
+            from oobleck_tpu.degrade.planner import plan_reroute
+
+            report = classify_failure(
+                self._host_index[lost_ips[0]],
+                [p.ranks for p in self.pipelines], self.chips_per_host)
+            plan = plan_reroute(
+                report, specs_from_pipelines(self.pipelines),
+                max_slowdown=self.args.execution.degrade_max_slowdown)
+        except Exception:
+            logger.debug("reroute projection failed", exc_info=True)
+            return None
+        if not plan.feasible:
+            return None
+        metrics.registry().gauge(
+            "oobleck_degrade_projected_retention",
+            "Planner-projected survivor throughput retention of a "
+            "single-host reroute from the current topology",
+        ).set(plan.throughput_retention)
+        return plan.throughput_retention
+
+    def _restore_recover(self, lost_ips: list[str], t0: float) -> bool:
+        """Checkpoint-restore recovery: the same survivor re-plan as
+        re-instantiation, but the state comes from the last durable
+        checkpoint instead of the surviving live arrays — the policy plane
+        picks this when a churn storm makes in-memory recovery a losing
+        bet (the next failure would eat the replayed work anyway). Returns
+        False when no checkpoint is loadable; the caller falls back."""
+        restored = self.try_restore_checkpoint()
+        if restored is None:
+            return False
+        rolled_back = self.step
+        with obs_spans.span("engine.restore",
+                            lost_ips=",".join(lost_ips)):
+            old_params = restored["params"]
+            old_opt = {}
+            for li, leaves in restored["opt"].items():
+                struct = jax.tree.structure(
+                    jax.eval_shape(self.optimizer.init, old_params[li]))
+                old_opt[li] = jax.tree.unflatten(struct, leaves)
+            meta = restored["meta"]
+            plan, host_assignment, idle = self.predict_replan(
+                {self._host_index[ip] for ip in lost_ips})
+            if idle:
+                logger.warning("hosts %s idle after restore", idle)
+            for ip in lost_ips:
+                self.host_ips.remove(ip)
+            self.step = int(meta["step"])
+            self.plan = plan
+            self._materialize_plan(
+                plan, int(meta["num_iterations_done"]), int(meta["epoch"]),
+                old_params, old_opt, host_assignment=host_assignment)
+        rolled_back -= self.step
+        elapsed = time.perf_counter() - t0
+        self.recovery_times.append(elapsed)
+        self._recovering = True
+        self._recovered_at = time.monotonic()
+        self._m_reconfigs.inc(path="restore")
+        self._set_template_gauge()
+        recovery.observe_latency(elapsed, stage="restore")
+        self._observe_policy_measured(MECH_RESTORE, elapsed)
+        metrics.flight_recorder().record(
+            "engine_restored", lost_ips=lost_ips, path="restore",
+            elapsed_s=round(elapsed, 3), step=self.step,
+            rolled_back_steps=rolled_back)
+        logger.warning(
+            "restored from durable checkpoint after losing %s in %.2fs "
+            "(rolled back %d step(s)): %s",
+            lost_ips, elapsed, rolled_back, plan)
+        if self._precompiler is not None:
+            self.start_recovery_precompile()
+        return True
+
+    # -- multihost zero-respawn degrade --------------------------------- #
+
+    def _maybe_inplace_degrade(self) -> None:
+        """Multihost in-place DEGRADE (ROADMAP item 1 remainder): apply a
+        queued reroute once EVERY live process has seen it, at the same
+        step boundary, via a 1-float group-min each step. The collective
+        runs unconditionally on the (multihost, degrade-enabled) path — a
+        conditionally-entered collective would deadlock against the step's
+        own allreduce when one process enters it and another does not."""
+        if (not self.multihost or self.comm is None
+                or self.fused is not None
+                or not self.args.execution.degrade_enabled):
+            return
+        if self._live_procs is None:
+            self._live_procs = list(range(self.comm.process_count))
+        if self.comm.process_index not in self._live_procs:
+            return
+        with self._lock:
+            pending = len(self._inplace_queue) > self._inplace_applied
+        ready = np.array([1.0 if pending else 0.0], np.float32)
+        agreed = self.comm.group_min(ready, 1, self._live_procs)
+        if agreed[0] < 1.0:
+            return
+        with self._lock:
+            entry = self._inplace_queue[self._inplace_applied]
+            self._inplace_applied += 1
+        lost_ip = entry["lost_ip"]
+        if lost_ip not in self.host_ips:
+            return
+        if self.agent_ip == lost_ip:
+            # Victim at the agreed boundary: flush what only this process
+            # holds, then leave the train loop cleanly — the survivors
+            # drop this process from their collectives at the same step.
+            metrics.flight_recorder().record(
+                "inplace_drain", ip=self.agent_ip, step=self.step,
+                trace_id=(entry["trace"] or {}).get("trace_id"))
+            self._mirror_flush()
+            self._drain_requested = True
+            return
+        self.reconfigure(lost_ip, trace=entry["trace"],
+                         decision=entry["decision"], inplace=True)
+
+    def _do_inplace_reroute(self, lost_ip: str, decision: dict | None,
+                            t0: float) -> None:
+        """Survivor side of the multihost zero-respawn DEGRADE. The plan
+        is deterministic from shared state, so every survivor computes —
+        and applies — the identical reroute without exchanging it; only
+        the boundary needed consensus. Infeasibility is equally
+        deterministic: every survivor falls back to respawn via its
+        agent."""
+        from oobleck_tpu.degrade.apply import try_degrade
+
+        if self._tracer is not None:
+            self._tracer.close()
+        ddec = try_degrade(self, lost_ip, self._host_index[lost_ip], t0)
+        if ddec.mechanism == "reroute":
+            self._observe_policy_measured(
+                MECH_REROUTE, ddec.measured_recovery_s)
+            return
+        metrics.flight_recorder().record(
+            "degrade_fallback", lost_ip=lost_ip, reason=ddec.reason,
+            step=self.step)
+        logger.warning("in-place degrade infeasible (%s); requesting "
+                       "respawn fallback", ddec.reason)
+        if self.agent_pipe is not None:
+            try:
+                self.agent_pipe.send({"kind": "degrade_fallback",
+                                      "lost_ip": lost_ip,
+                                      "reason": ddec.reason})
+            except (OSError, ValueError):
+                pass
+
+    def _maybe_chaos_kill_hosts(self) -> None:
+        """Correlated fault injection (OOBLECK_CHAOS=kill_hosts=
+        <ip1+ip2+...>): declare several hosts lost in the same detection
+        window, exercising the policy plane's correlated-failure path
+        (reroute infeasible, one incident covering the whole blast
+        radius)."""
+        if not chaos().active or not self.pipelines:
+            return
+        ips = chaos().kill_hosts_target()
+        if not ips:
+            return
+        known = [ip for ip in ips if ip in self.host_ips]
+        if not known:
+            logger.warning("chaos kill_hosts: no known hosts in %s", ips)
+            return
+        detected_at = time.time()
+        trace = {"trace_id": obs_spans.new_trace_id(),
+                 "detected_at": detected_at, "cause": "chaos_kill_hosts"}
+        metrics.flight_recorder().record(
+            "chaos_kill_hosts_resolved", lost_ips=known, step=self.step)
+        obs_spans.span_recorder().record(
+            "incident.detect", detected_at, detected_at,
+            trace_id=trace["trace_id"], lost_ip=",".join(known),
+            cause="chaos_kill_hosts")
+        logger.warning("chaos kill_hosts: declaring %s lost together",
+                       known)
+        for ip in known:
+            # Same trace, same drain window -> one correlated incident.
+            self.request_reconfiguration(ip, trace=trace)
+
     def _maybe_chaos_kill_stage(self) -> None:
         """Stage-addressed fault injection (OOBLECK_CHAOS=kill_stage=
         <stage>:<replica>): declare the host owning that stage of that
@@ -2606,18 +2914,52 @@ class OobleckEngine:
         self.request_reconfiguration(ip, trace=trace)
 
     def request_reconfiguration(self, lost_ip: str,
-                                trace: dict | None = None) -> None:
+                                trace: dict | None = None,
+                                decision: dict | None = None) -> None:
         with self._lock:
-            self._pending_lost.append((lost_ip, trace))
+            self._pending_lost.append((lost_ip, trace, decision))
+
+    def request_drain(self, trace: dict | None = None) -> None:
+        """Proactive preemption drain: the host got an advance notice, so
+        flush durable state at the next step boundary and exit cleanly
+        (the agent reports JOB_DONE, not a failure)."""
+        metrics.flight_recorder().record(
+            "drain_requested", ip=self.agent_ip, step=self.step,
+            trace_id=(trace or {}).get("trace_id"))
+        with self._lock:
+            self._drain_requested = True
+
+    def request_inplace_degrade(self, lost_ip: str,
+                                trace: dict | None = None,
+                                decision: dict | None = None) -> None:
+        """Multihost zero-respawn reroute request; applied at the next
+        step boundary ALL live processes agree on."""
+        with self._lock:
+            self._inplace_queue.append(
+                {"lost_ip": lost_ip, "trace": trace, "decision": decision})
 
     def _maybe_reconfigure(self) -> None:
         with self._lock:
             lost = list(self._pending_lost)
             self._pending_lost.clear()
-        for ip, trace in lost:
-            self.reconfigure(ip, trace=trace)
+        if not lost:
+            return
+        # Losses pending at the same boundary are ONE correlated incident:
+        # recovering them serially would let the first re-plan route work
+        # onto hosts the second is about to remove (and the policy plane
+        # must see the full blast radius to rule out rerouting).
+        seen: dict[str, None] = {}
+        for ip, _, _ in lost:
+            seen.setdefault(ip)
+        ip0, trace, decision = lost[0]
+        extra = [ip for ip in seen if ip != ip0]
+        self.reconfigure(ip0, trace=trace, decision=decision,
+                         extra_lost=extra)
 
-    def reconfigure(self, lost_ip: str, trace: dict | None = None) -> None:
+    def reconfigure(self, lost_ip: str, trace: dict | None = None,
+                    decision: dict | None = None,
+                    extra_lost: tuple | list = (),
+                    inplace: bool = False) -> None:
         """Incident-instrumented recovery entry point: opens the incident
         (adopting the upstream detect/broadcast/notified marks the trace
         context carried), pins the trace as the process ambient so every
@@ -2635,57 +2977,93 @@ class OobleckEngine:
         prev_recovered = self._recovered_at
         try:
             with obs_spans.span("engine.reconfigure",
-                                trace_id=incident.trace_id, lost_ip=lost_ip):
-                self._do_reconfigure(lost_ip)
+                                trace_id=incident.trace_id, lost_ip=lost_ip,
+                                extra_lost=",".join(extra_lost)):
+                self._do_reconfigure(lost_ip, decision=decision,
+                                     extra_lost=extra_lost, inplace=inplace)
         finally:
             obs_spans.set_ambient(None)
             if self._recovering and self._recovered_at != prev_recovered:
                 incident.mark("apply_end")
                 self._incident = incident
 
-    def _do_reconfigure(self, lost_ip: str) -> None:
-        """Full recovery path (reference on_reconfigure, engine.py:91-180):
-        host algebra -> template re-match -> batch redistribution ->
-        re-instantiate reusing surviving weights + optimizer state and the
-        data position."""
+    def _do_reconfigure(self, lost_ip: str, decision: dict | None = None,
+                        extra_lost: tuple | list = (),
+                        inplace: bool = False) -> None:
+        """Full recovery path (reference on_reconfigure, engine.py:91-180),
+        dispatched on the policy verdict: reroute mutates the live topology
+        in place (degrade/), reinstantiate runs host algebra -> template
+        re-match -> batch redistribution -> re-instantiation reusing
+        surviving weights + optimizer state and the data position, restore
+        does the same re-plan but from the last durable checkpoint (the
+        policy plane picks it when in-memory recovery is a losing bet)."""
         t0 = time.perf_counter()
         # Deferred losses reference arrays on the pre-failure meshes; read
         # them back now, while (most of) the backing buffers still exist.
         self._drain_pending_losses()
         if self.multihost:
+            if inplace:
+                self._do_inplace_reroute(lost_ip, decision, t0)
+                return
             # A lost peer breaks the shared jax.distributed world; the agent
             # respawns the worker over the survivors (live mirrors make the
-            # restart checkpoint-free). In-place reconfiguration is the
-            # single-controller path only.
+            # restart checkpoint-free). In-place RECONFIGURATION stays
+            # single-controller; an in-place DEGRADE rides the consensus
+            # queue (_maybe_inplace_degrade) instead of this path.
             logger.warning(
                 "multihost MPMD reconfigures by respawn; ignoring in-place "
                 "request for %s", lost_ip,
             )
             return
-        if lost_ip not in self.host_ips:
+        lost_ips = [ip for ip in (lost_ip, *extra_lost)
+                    if ip in self.host_ips]
+        if not lost_ips:
             logger.warning("unknown lost host %s", lost_ip)
             return
+        lost_ip = lost_ips[0]
         lost_host = self._host_index[lost_ip]
+        correlated = len(lost_ips) > 1
         # A mid-window jax.profiler trace must not straddle the topology
         # change: close it now; the tracer re-arms on its next window.
         if self._tracer is not None:
             self._tracer.close()
         if self.fused is not None:
-            self._reconfigure_fused(lost_ip, lost_host, t0)
+            # Fused recovery is a mesh shrink; one host at a time.
+            for ip in lost_ips:
+                self._reconfigure_fused(ip, self._host_index[ip], t0)
             return
 
-        # Degraded-mode fast path FIRST (oobleck_tpu/degrade): reroute the
-        # dead replica's microbatches into the survivors' bubbles on the
-        # same topology — no re-plan, no recompile. try_degrade returns one
+        # Policy verdict: the broadcast decision when the master attached
+        # one (every process applies the same verdict), the local policy
+        # engine's otherwise (in-process detection never crossed the
+        # control plane).
+        pdec = decision_from_payload(decision)
+        if pdec is None:
+            pdec = self._consult_policy(lost_ips, cause="engine_detected")
+        mechanism = pdec.mechanism
+
+        if mechanism == MECH_RESTORE:
+            if self._restore_recover(lost_ips, t0):
+                return
+            logger.warning("policy chose restore but no durable checkpoint "
+                           "is loadable; re-instantiating instead")
+            mechanism = MECH_REINSTANTIATE
+
+        # Degraded-mode fast path (oobleck_tpu/degrade): reroute the dead
+        # replica's microbatches into the survivors' bubbles on the same
+        # topology — no re-plan, no recompile. try_degrade returns one
         # DegradeDecision either way; on fallback it is recorded below with
         # the measured re-instantiation latency so estimate and actual land
         # in the same flight-recorder event.
-        decision = None
-        if self.args.execution.degrade_enabled:
+        ddec = None
+        if (mechanism == MECH_REROUTE and not correlated
+                and self.args.execution.degrade_enabled):
             from oobleck_tpu.degrade.apply import try_degrade
 
-            decision = try_degrade(self, lost_ip, lost_host, t0)
-            if decision.mechanism == "reroute":
+            ddec = try_degrade(self, lost_ip, lost_host, t0)
+            if ddec.mechanism == "reroute":
+                self._observe_policy_measured(
+                    MECH_REROUTE, ddec.measured_recovery_s)
                 return
         else:
             from oobleck_tpu.degrade.decision import (
@@ -2693,13 +3071,26 @@ class OobleckEngine:
                 DegradeDecision,
             )
 
-            decision = DegradeDecision(
+            if not self.args.execution.degrade_enabled:
+                reason = "degrade_disabled"
+            elif correlated:
+                # Correlated loss: the survivors' bubbles cannot absorb
+                # several replicas' worth of work (policy marks the reroute
+                # arm infeasible); fall straight through to a full re-plan.
+                reason = "correlated_failure"
+            else:
+                reason = f"policy:{pdec.reason}"
+            ddec = DegradeDecision(
                 lost_ip=lost_ip, lost_host=lost_host,
-                mechanism=MECH_DISABLED, reason="degrade_disabled")
+                mechanism=(MECH_DISABLED
+                           if not self.args.execution.degrade_enabled
+                           else MECH_REINSTANTIATE),
+                reason=reason)
 
         # Host algebra + template re-match, shared verbatim with the
         # recovery precompiler so its AOT executables hit here.
-        plan, host_assignment, idle = self.predict_replan({lost_host})
+        plan, host_assignment, idle = self.predict_replan(
+            {self._host_index[ip] for ip in lost_ips})
         if idle:
             logger.warning(
                 "hosts %s idle after reconfiguration: no template extension "
@@ -2716,7 +3107,8 @@ class OobleckEngine:
         it_done = self.dataloaders[0].num_iterations_done
         epoch = self.dataloaders[0].epoch
 
-        self.host_ips.remove(lost_ip)
+        for ip in lost_ips:
+            self.host_ips.remove(ip)
         self.plan = plan
         self._materialize_plan(
             plan, it_done, epoch, old_params, old_opt,
@@ -2729,11 +3121,13 @@ class OobleckEngine:
         self._m_reconfigs.inc(path="mpmd")
         self._set_template_gauge()
         recovery.observe_latency(elapsed, stage="reconfigure")
-        if decision is not None:
-            decision.measured_recovery_s = elapsed
-            decision.record()
+        if ddec is not None:
+            ddec.measured_recovery_s = elapsed
+            ddec.record()
+        self._observe_policy_measured(MECH_REINSTANTIATE, elapsed)
         metrics.flight_recorder().record(
             "engine_reconfigured", lost_ip=lost_ip, path="mpmd",
+            lost_ips=lost_ips, correlated=correlated,
             elapsed_s=round(elapsed, 3), step=self.step)
         logger.warning(
             "reconfigured after losing %s in %.2fs: %s", lost_ip, elapsed, plan,

@@ -57,6 +57,8 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
+from oobleck_tpu.utils import background
+
 logger = logging.getLogger("oobleck.precompile")
 
 
@@ -293,8 +295,14 @@ class RecoveryPrecompiler:
                     self.stats["stages_cached"] += 1
                     continue
                 try:
-                    self._aot_chunk(pipe, st, c, chunk_layers,
-                                    is_first, is_last)
+                    # One chunk per fence hold: compiling concurrently with
+                    # the train thread's dispatch/readback/staging crashes
+                    # the XLA CPU runtime (utils/background.py — the PR-3
+                    # respawn flake); yielding between chunks bounds how
+                    # long the train loop can wait on a compile.
+                    with background.device_work("precompile"):
+                        self._aot_chunk(pipe, st, c, chunk_layers,
+                                        is_first, is_last)
                     self._done_keys.add(key)
                 except Exception:
                     self.stats["errors"] += 1

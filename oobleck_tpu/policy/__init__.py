@@ -1,0 +1,33 @@
+"""Adaptive fault-tolerance policy plane (ROADMAP item 2).
+
+The repo has three independent recovery mechanisms — bubble rerouting
+(degrade/, ~0.6 s), template re-instantiation (~0.7 s warm in-place /
+~21 s respawn), and checkpoint restore (ckpt/) — and until this package
+the choice between them was a single env var. The policy engine scores
+each *feasible* mechanism per incident from live signals (measured
+recovery-latency history, the degrade planner's projected survivor
+slowdown, checkpoint staleness, an online per-host MTBF estimator) and
+picks the cheapest, so the cluster self-tunes under churn instead of
+replaying one fixed reflex.
+
+Chameleon-style real-time policy selection (PAPERS.md, arxiv 2508.21613)
+layered over ReCycle-style pipeline adaptation (arxiv 2405.14009).
+
+``OOBLECK_POLICY`` forces a fixed arm (``reroute`` | ``reinstantiate`` |
+``restore``) for baselines/benchmarks; the default ``adaptive`` scores.
+"""
+
+from oobleck_tpu.policy.engine import (  # noqa: F401
+    DECISION_KEY,
+    ENV_POLICY,
+    MECH_REINSTANTIATE,
+    MECH_REROUTE,
+    MECH_RESTORE,
+    MODE_ADAPTIVE,
+    PolicyDecision,
+    PolicyEngine,
+    decision_from_payload,
+)
+from oobleck_tpu.policy.health import HostHealthTracker  # noqa: F401
+from oobleck_tpu.policy.scorer import score_arms  # noqa: F401
+from oobleck_tpu.policy.signals import ArmSignals, build_arms  # noqa: F401

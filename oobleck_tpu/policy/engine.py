@@ -1,0 +1,263 @@
+"""PolicyEngine: one auditable, flight-recorded decision per incident.
+
+Consulted at the master's failure-detection point (and by the engine for
+in-process losses that never cross the control plane), it gates each
+mechanism on feasibility, scores the survivors with the churn-aware cost
+model, and returns a PolicyDecision that rides the recovery broadcast —
+so every process applies the *same* verdict and the flight recorder can
+later compare projected cost against what the recovery actually took.
+
+``OOBLECK_POLICY=reroute|reinstantiate|restore`` forces a fixed arm
+(benchmark baselines); the default ``adaptive`` scores. A forced arm
+that is infeasible for the incident at hand falls back to
+re-instantiation — the one mechanism that is always available.
+"""
+
+from __future__ import annotations
+
+import collections
+import logging
+import os
+import time
+from dataclasses import dataclass, field
+
+from oobleck_tpu.obs import spans
+from oobleck_tpu.policy.health import HostHealthTracker
+from oobleck_tpu.policy.scorer import cheapest_feasible, score_arms
+from oobleck_tpu.policy.signals import build_arms
+from oobleck_tpu.utils import metrics
+
+logger = logging.getLogger("oobleck.policy")
+
+ENV_POLICY = "OOBLECK_POLICY"
+
+MECH_REROUTE = "reroute"
+MECH_REINSTANTIATE = "reinstantiate"
+MECH_RESTORE = "restore"
+MODE_ADAPTIVE = "adaptive"
+MODES = (MODE_ADAPTIVE, MECH_REROUTE, MECH_REINSTANTIATE, MECH_RESTORE)
+
+# Payload key the recovery broadcast carries the decision under (legacy
+# receivers ignore unknown keys, like spans.TRACE_KEY).
+DECISION_KEY = "policy"
+
+# Decisions kept for /status (bounded like the master's incident digest).
+MAX_DECISIONS = 16
+# EWMA weight of the newest measured recovery latency.
+EWMA_ALPHA = 0.5
+
+
+@dataclass
+class PolicyDecision:
+    """What the policy plane chose for one incident, and what it knew."""
+
+    mechanism: str
+    lost_ips: list[str]
+    reason: str = "cheapest"       # "cheapest" | "forced:<m>" | fallback
+    projected_cost_s: float | None = None
+    measured_recovery_s: float | None = None
+    costs: dict = field(default_factory=dict)       # mechanism -> cost_s
+    infeasible: dict = field(default_factory=dict)  # mechanism -> reason
+    arms: dict = field(default_factory=dict)        # mechanism -> record
+    mtbf_s: float | None = None
+    quarantined: list = field(default_factory=list)
+    proactive: bool = False        # preemption-notice-triggered
+    inplace: bool = False          # multihost survivors reroute in place
+    trace_id: str | None = None
+    decided_at: float = field(default_factory=time.time)
+
+    def as_payload(self) -> dict:
+        """Compact dict that rides the recovery broadcast under
+        DECISION_KEY and the /status decision log."""
+        return {
+            "mechanism": self.mechanism,
+            "lost_ips": list(self.lost_ips),
+            "reason": self.reason,
+            "projected_cost_s": self.projected_cost_s,
+            "measured_recovery_s": self.measured_recovery_s,
+            "costs": {m: round(c, 6) for m, c in self.costs.items()},
+            "infeasible": dict(self.infeasible),
+            "mtbf_s": self.mtbf_s,
+            "quarantined": list(self.quarantined),
+            "proactive": self.proactive,
+            "inplace": self.inplace,
+            "trace_id": self.trace_id,
+            "decided_at": self.decided_at,
+        }
+
+    def as_record(self) -> dict:
+        rec = self.as_payload()
+        rec["arms"] = dict(self.arms)
+        return rec
+
+    def record(self) -> None:
+        """Flight-record the decision and bump the oobleck_policy_*
+        family in one call, so the two views cannot disagree."""
+        metrics.flight_recorder().record("policy_decision",
+                                         **self.as_record())
+        reg = metrics.registry()
+        reg.counter(
+            "oobleck_policy_decisions_total",
+            "Policy-plane decisions by mechanism and reason",
+        ).inc(mechanism=self.mechanism, reason=self.reason)
+        if self.projected_cost_s is not None:
+            reg.gauge(
+                "oobleck_policy_projected_cost_seconds",
+                "Projected cost of the last policy decision",
+            ).set(self.projected_cost_s, mechanism=self.mechanism)
+
+
+def decision_from_payload(payload) -> PolicyDecision | None:
+    """Rebuild a broadcast decision on the receiving side; tolerant of
+    legacy peers (no payload) and future extra keys."""
+    if not isinstance(payload, dict) or "mechanism" not in payload:
+        return None
+    d = PolicyDecision(mechanism=str(payload["mechanism"]),
+                       lost_ips=list(payload.get("lost_ips") or []))
+    for k in ("reason", "projected_cost_s", "costs", "infeasible", "mtbf_s",
+              "quarantined", "proactive", "inplace", "trace_id",
+              "decided_at"):
+        if k in payload and payload[k] is not None:
+            setattr(d, k, payload[k])
+    return d
+
+
+class PolicyEngine:
+    """Per-process policy state: mode, host health, latency EWMAs, and the
+    bounded decision log surfaced in /status."""
+
+    def __init__(self, *, multihost: bool = False, clock=time.monotonic,
+                 mode: str | None = None):
+        if mode is None:
+            mode = os.environ.get(ENV_POLICY, "").strip().lower()
+        self.mode = mode or MODE_ADAPTIVE
+        if self.mode not in MODES:
+            raise ValueError(
+                f"bad {ENV_POLICY}={self.mode!r}: want one of {MODES}")
+        self.multihost = multihost
+        self.health = HostHealthTracker(clock=clock)
+        self._ewma: dict[str, float] = {}
+        self._decisions: collections.deque = collections.deque(
+            maxlen=MAX_DECISIONS)
+
+    # -- signal feeds ------------------------------------------------------- #
+
+    def observe_failure(self, ip: str, cause: str = "") -> None:
+        self.health.record_failure(ip, cause)
+        metrics.registry().gauge(
+            "oobleck_policy_quarantined_hosts",
+            "Hosts currently quarantined by the flap detector",
+        ).set(len(self.health.quarantined()))
+
+    def observe_measured(self, mechanism: str, seconds: float) -> None:
+        """Feed one measured recovery latency: updates the EWMA the next
+        decision scores with, and closes the projected-vs-measured loop on
+        the latest matching decision."""
+        prev = self._ewma.get(mechanism)
+        self._ewma[mechanism] = (seconds if prev is None else
+                                 (1 - EWMA_ALPHA) * prev
+                                 + EWMA_ALPHA * seconds)
+        metrics.registry().histogram(
+            "oobleck_policy_measured_recovery_seconds",
+            "Measured recovery latency by mechanism (policy feedback)",
+        ).observe(seconds, mechanism=mechanism)
+        for d in reversed(self._decisions):
+            if d.mechanism == mechanism and d.measured_recovery_s is None:
+                d.measured_recovery_s = seconds
+                metrics.flight_recorder().record(
+                    "policy_decision_measured", mechanism=mechanism,
+                    trace_id=d.trace_id,
+                    projected_cost_s=d.projected_cost_s,
+                    measured_recovery_s=seconds)
+                break
+
+    def is_quarantined(self, ip: str) -> bool:
+        return self.health.is_quarantined(ip)
+
+    # -- the decision ------------------------------------------------------- #
+
+    def decide(self, lost_ips: list[str], *,
+               degrade_enabled: bool = True,
+               reroute_retention: float | None = None,
+               reroute_feasible: bool = True,
+               reroute_reason: str = "",
+               survivor_frac: float = 1.0,
+               staleness_steps: float | None = None,
+               step_seconds: float | None = None,
+               proactive: bool = False,
+               cause: str = "") -> PolicyDecision:
+        """Score the arms for one incident and pick. ``lost_ips`` with more
+        than one entry is a correlated failure (reroute infeasible).
+        ``staleness_steps`` None means no durable checkpoint."""
+        with spans.span("policy.decide", lost_ips=",".join(lost_ips),
+                        cause=cause) as ctx:
+            arms = build_arms(
+                multihost=self.multihost,
+                degrade_enabled=degrade_enabled,
+                correlated=len(lost_ips) > 1,
+                reroute_retention=reroute_retention,
+                reroute_feasible=reroute_feasible,
+                reroute_reason=reroute_reason,
+                survivor_frac=survivor_frac,
+                staleness_steps=staleness_steps,
+                step_seconds=step_seconds,
+                latency_overrides=self._ewma,
+            )
+            mtbfs = [m for m in (self.health.mtbf(ip) for ip in lost_ips)
+                     if m is not None]
+            mtbf_s = min(mtbfs) if mtbfs else self.health.fleet_mtbf()
+            scored = score_arms(arms, mtbf_s=mtbf_s)
+
+            if self.mode != MODE_ADAPTIVE:
+                if scored[self.mode].feasible:
+                    chosen, reason = scored[self.mode], f"forced:{self.mode}"
+                else:
+                    chosen = scored[MECH_REINSTANTIATE]
+                    reason = (f"forced:{self.mode}:infeasible:"
+                              f"{scored[self.mode].reason}")
+            else:
+                chosen = cheapest_feasible(scored)
+                reason = "cheapest"
+                if chosen is None:  # cannot happen: reinstantiate is
+                    chosen = scored[MECH_REINSTANTIATE]  # always feasible
+                    reason = "fallback"
+
+            decision = PolicyDecision(
+                mechanism=chosen.mechanism,
+                lost_ips=list(lost_ips),
+                reason=reason,
+                projected_cost_s=chosen.cost_s,
+                costs={m: a.cost_s for m, a in scored.items()},
+                infeasible={m: a.reason for m, a in scored.items()
+                            if not a.feasible},
+                arms={m: dict(arms[m].as_record(),
+                              **scored[m].as_record())
+                      for m in arms},
+                mtbf_s=mtbf_s,
+                quarantined=self.health.quarantined(),
+                proactive=proactive,
+                inplace=(chosen.mechanism == MECH_REROUTE
+                         and (not self.multihost or proactive)),
+                trace_id=ctx["trace_id"],
+            )
+        logger.info(
+            "policy: %s for loss of %s (reason=%s cost=%.3fs mtbf=%s)",
+            decision.mechanism, lost_ips, reason, chosen.cost_s,
+            f"{mtbf_s:.1f}s" if mtbf_s is not None else "n/a")
+        self._decisions.append(decision)
+        decision.record()
+        return decision
+
+    # -- /status ------------------------------------------------------------ #
+
+    def status(self) -> dict:
+        """Bounded policy block for the master's /status."""
+        health = self.health.snapshot()
+        return {
+            "mode": self.mode,
+            "quarantined": health["quarantined"],
+            "hosts": health["hosts"],
+            "latency_ewma_s": {m: round(v, 6)
+                               for m, v in self._ewma.items()},
+            "decisions": [d.as_payload() for d in self._decisions],
+        }

@@ -1,0 +1,117 @@
+"""Online per-host MTBF estimation + flap quarantine with hysteresis.
+
+A host that fails once is unlucky; a host that fails twice inside its own
+mean-time-between-failures window is flapping, and readmitting it to the
+next re-plan just schedules the next incident. The tracker quarantines
+such hosts and only lifts the quarantine after the host has stayed quiet
+for ``hysteresis_factor`` times its window — the asymmetry (quick to
+quarantine, slow to forgive) is the hysteresis that stops a
+2-second-period flapper from oscillating in and out of the plan.
+
+The clock is injectable so quarantine enter/exit is unit-testable
+without sleeping; all other consumers use the monotonic default.
+"""
+
+from __future__ import annotations
+
+import time
+
+# A host's first failure gives no interval to estimate MTBF from; until a
+# second one lands, "twice within its MTBF window" is judged against this
+# default window instead.
+DEFAULT_WINDOW_S = 300.0
+# Quarantine lifts only after hysteresis_factor * window of silence.
+HYSTERESIS_FACTOR = 2.0
+# Failure timestamps kept per host (MTBF over at most this many events).
+MAX_EVENTS_PER_HOST = 32
+
+
+class HostHealthTracker:
+    """Failure-log-fed MTBF estimates and a quarantine set for the policy
+    engine. Not thread-safe by itself — callers (the master's single event
+    loop, the engine's reconfigure lock) already serialize access."""
+
+    def __init__(self, clock=time.monotonic, *,
+                 default_window_s: float = DEFAULT_WINDOW_S,
+                 hysteresis_factor: float = HYSTERESIS_FACTOR):
+        self._clock = clock
+        self._default_window_s = default_window_s
+        self._hysteresis_factor = hysteresis_factor
+        self._failures: dict[str, list[float]] = {}
+        self._causes: dict[str, str] = {}
+        self._quarantined_at: dict[str, float] = {}
+
+    # -- failure log -------------------------------------------------------- #
+
+    def record_failure(self, ip: str, cause: str = "") -> None:
+        """Feed one observed failure; may enter quarantine (two failures
+        within the host's window)."""
+        now = self._clock()
+        log = self._failures.setdefault(ip, [])
+        window = self.window(ip)
+        if log and now - log[-1] <= window:
+            self._quarantined_at[ip] = now
+        log.append(now)
+        del log[:-MAX_EVENTS_PER_HOST]
+        if cause:
+            self._causes[ip] = cause
+
+    def failure_count(self, ip: str) -> int:
+        return len(self._failures.get(ip, ()))
+
+    # -- MTBF --------------------------------------------------------------- #
+
+    def mtbf(self, ip: str) -> float | None:
+        """Mean seconds between this host's observed failures; None until
+        two failures give a first interval."""
+        log = self._failures.get(ip, ())
+        if len(log) < 2:
+            return None
+        return (log[-1] - log[0]) / (len(log) - 1)
+
+    def window(self, ip: str) -> float:
+        """The "failed twice within" judgment window for this host."""
+        return self.mtbf(ip) or self._default_window_s
+
+    def fleet_mtbf(self) -> float | None:
+        """Shortest per-host MTBF across the fleet — the churn-storm signal
+        the scorer's risk term keys on (the next failure comes from the
+        worst host, not the average one)."""
+        vals = [m for m in (self.mtbf(ip) for ip in self._failures)
+                if m is not None]
+        return min(vals) if vals else None
+
+    # -- quarantine --------------------------------------------------------- #
+
+    def is_quarantined(self, ip: str) -> bool:
+        """Whether this host is currently excluded from re-plans. Lifts
+        lazily once the host has stayed quiet for hysteresis_factor * its
+        window (proven stable)."""
+        entered = self._quarantined_at.get(ip)
+        if entered is None:
+            return False
+        last = self._failures[ip][-1]
+        if self._clock() - last >= self._hysteresis_factor * self.window(ip):
+            del self._quarantined_at[ip]
+            return False
+        return True
+
+    def quarantined(self) -> list[str]:
+        return sorted(ip for ip in list(self._quarantined_at)
+                      if self.is_quarantined(ip))
+
+    # -- /status ------------------------------------------------------------ #
+
+    def snapshot(self) -> dict:
+        """Bounded per-host view for the master's /status policy block."""
+        hosts = {}
+        for ip, log in self._failures.items():
+            hosts[ip] = {
+                "failures": len(log),
+                "mtbf_s": self.mtbf(ip),
+                "last_failure_age_s": round(self._clock() - log[-1], 3),
+                "quarantined": self.is_quarantined(ip),
+            }
+            if ip in self._causes:
+                hosts[ip]["last_cause"] = self._causes[ip]
+        return {"hosts": hosts, "quarantined": self.quarantined()}

@@ -1,0 +1,101 @@
+"""Cost model: seconds-of-training-lost per mechanism, per incident.
+
+    cost(m) = L(m)                      recovery latency
+            + W(m)                      replayed work (restore staleness)
+            + (1 - retention(m)) * T    degraded throughput, amortized
+                                        until the next reconfiguration
+                                        opportunity (T = min(MTBF, cap))
+            + risk * (L(restore) + W(restore))   in-memory arms only
+
+The risk term is the churn hedge: under a churn storm (MTBF shorter than
+the risk horizon) every in-memory recovery just schedules the next one,
+and the cascade ends in a checkpoint restore anyway — at *worse*
+staleness than restoring now, while the checkpoint is fresh. risk =
+clamp(horizon / MTBF, 0, 1) prices that in: a host failing every few
+seconds drives risk to 1 and the scorer to restore; rising MTBF decays
+the term and flips the choice back to the cheap in-memory arms. With no
+failure history at all (first incident) risk is 0 and T falls back to
+the cap — the scorer then reduces to "cheapest latency at equal
+retention", which is the reroute-first behavior the fixed policy had.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+
+from oobleck_tpu.policy.signals import ArmSignals, PRIOR_LATENCY_S
+
+# Amortization horizon cap: past this, degraded throughput is assumed to
+# be fixed by a scheduled re-plan / checkpoint cycle anyway.
+AMORT_CAP_S = 300.0
+# Churn risk saturates when MTBF drops below this horizon.
+RISK_HORIZON_S = 60.0
+
+
+@dataclass
+class ScoredArm:
+    mechanism: str
+    cost_s: float
+    feasible: bool
+    reason: str = ""
+    breakdown: dict = field(default_factory=dict)
+
+    def as_record(self) -> dict:
+        return {
+            "cost_s": round(self.cost_s, 6),
+            "feasible": self.feasible,
+            "reason": self.reason,
+            "breakdown": {k: round(v, 6) for k, v in self.breakdown.items()},
+        }
+
+
+def score_arms(arms: dict[str, ArmSignals], *,
+               mtbf_s: float | None = None,
+               amort_cap_s: float = AMORT_CAP_S,
+               risk_horizon_s: float = RISK_HORIZON_S
+               ) -> dict[str, ScoredArm]:
+    """Score every arm (including infeasible ones, so decisions record
+    what the road not taken would have cost)."""
+    if mtbf_s is not None and mtbf_s > 0:
+        t_amort = min(mtbf_s, amort_cap_s)
+        risk = min(max(risk_horizon_s / mtbf_s, 0.0), 1.0)
+    else:
+        t_amort = amort_cap_s
+        risk = 0.0
+
+    restore = arms.get("restore")
+    if restore is not None:
+        restore_total = restore.latency_s + restore.lost_work_s
+    else:
+        restore_total = PRIOR_LATENCY_S["restore"]
+
+    scored: dict[str, ScoredArm] = {}
+    for name, arm in arms.items():
+        latency = arm.latency_s
+        lost_work = arm.lost_work_s
+        degraded = (1.0 - min(arm.retention, 1.0)) * t_amort
+        churn = risk * restore_total if arm.in_memory else 0.0
+        scored[name] = ScoredArm(
+            mechanism=name,
+            cost_s=latency + lost_work + degraded + churn,
+            feasible=arm.feasible,
+            reason=arm.reason,
+            breakdown={
+                "latency_s": latency,
+                "lost_work_s": lost_work,
+                "degraded_s": degraded,
+                "churn_risk_s": churn,
+                "t_amort_s": t_amort,
+                "risk": risk,
+            },
+        )
+    return scored
+
+
+def cheapest_feasible(scored: dict[str, ScoredArm]) -> ScoredArm | None:
+    """The cheapest feasible arm, ties broken by (cost, mechanism name)
+    for determinism; None if nothing is feasible."""
+    candidates = sorted(
+        (a for a in scored.values() if a.feasible),
+        key=lambda a: (a.cost_s, a.mechanism))
+    return candidates[0] if candidates else None

@@ -1,0 +1,151 @@
+"""Live per-mechanism signals the scorer consumes.
+
+Each recovery mechanism becomes one ArmSignals record: its expected
+recovery latency (measured history when the metrics plane has any,
+documented priors otherwise — the source is carried so decisions are
+honest about what they knew), its projected post-recovery throughput
+retention, the work a checkpoint restore would replay, and feasibility
+(a reroute around two correlated losses, or a restore with no durable
+checkpoint, is not an option however cheap it looks).
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+
+from oobleck_tpu.utils import metrics
+
+# Latency priors (seconds) used until a mechanism has measured history.
+# reroute/reinstantiate-warm come from the degrade bench (~0.56 s / ~0.64 s
+# on the reference shape, rounded up); reinstantiate-respawn and restore
+# from the multiprocess recovery runs (~21 s respawn; restore adds durable
+# read + re-instantiation on top).
+PRIOR_LATENCY_S = {
+    "reroute": 0.6,
+    "reinstantiate": 0.7,          # warm in-place re-instantiation
+    "reinstantiate_respawn": 21.0,  # multihost: respawn + re-init
+    "restore": 25.0,
+}
+# Step-time prior when no measured step seconds are available yet (only
+# used to price checkpoint staleness in lost-work seconds).
+PRIOR_STEP_S = 1.0
+
+# Histogram families that hold measured recovery latencies by mechanism.
+_LATENCY_HISTOGRAMS = (
+    "oobleck_degrade_recovery_seconds",
+    "oobleck_policy_measured_recovery_seconds",
+)
+
+
+@dataclass
+class ArmSignals:
+    """Everything the scorer needs to know about one recovery mechanism
+    for one incident."""
+
+    mechanism: str
+    latency_s: float
+    latency_source: str            # "measured" | "prior"
+    retention: float               # projected throughput after recovery
+    lost_work_s: float = 0.0       # replayed work (checkpoint restore)
+    in_memory: bool = True         # state survives in RAM -> churn risk
+    feasible: bool = True
+    reason: str = ""               # why infeasible ("" when feasible)
+
+    def as_record(self) -> dict:
+        return {
+            "latency_s": round(self.latency_s, 6),
+            "latency_source": self.latency_source,
+            "retention": round(self.retention, 6),
+            "lost_work_s": round(self.lost_work_s, 6),
+            "feasible": self.feasible,
+            "reason": self.reason,
+        }
+
+
+def measured_latency(mechanism: str, registry=None) -> float | None:
+    """Mean measured recovery latency for a mechanism across the metric
+    families that observe it, or None with no history."""
+    reg = registry or metrics.registry()
+    total = count = 0.0
+    for name in _LATENCY_HISTOGRAMS:
+        for s in reg.histogram(name, "").series():
+            if s["labels"].get("mechanism") == mechanism and s["count"]:
+                total += s["sum"]
+                count += s["count"]
+    return total / count if count else None
+
+
+def _latency(mechanism: str, prior_key: str, overrides, registry):
+    if overrides and mechanism in overrides:
+        return float(overrides[mechanism]), "measured"
+    m = measured_latency(mechanism, registry)
+    if m is not None:
+        return m, "measured"
+    return PRIOR_LATENCY_S[prior_key], "prior"
+
+
+def build_arms(*,
+               multihost: bool = False,
+               warm_reinstantiate: bool | None = None,
+               degrade_enabled: bool = True,
+               correlated: bool = False,
+               reroute_retention: float | None = None,
+               reroute_feasible: bool = True,
+               reroute_reason: str = "",
+               survivor_frac: float = 1.0,
+               staleness_steps: float | None = None,
+               step_seconds: float | None = None,
+               latency_overrides: dict[str, float] | None = None,
+               registry=None) -> dict[str, ArmSignals]:
+    """Assemble the three arms for one incident.
+
+    staleness_steps is None when there is no durable checkpoint (restore
+    infeasible), else current_step - last_durable_step. reroute_retention
+    is the degrade planner's replay-projected survivor throughput when a
+    projection exists; survivor_frac ((n-lost)/n) is the fallback for it
+    and the default for the other in-memory arm — re-instantiated
+    templates run on the same survivors, so absent measurements the arms
+    are not fabricated apart on retention.
+    """
+    if warm_reinstantiate is None:
+        warm_reinstantiate = not multihost
+
+    reroute = ArmSignals(
+        mechanism="reroute",
+        latency_s=0.0, latency_source="",
+        retention=(reroute_retention if reroute_retention is not None
+                   else survivor_frac),
+    )
+    reroute.latency_s, reroute.latency_source = _latency(
+        "reroute", "reroute", latency_overrides, registry)
+    if not degrade_enabled:
+        reroute.feasible, reroute.reason = False, "degrade_disabled"
+    elif correlated:
+        reroute.feasible, reroute.reason = False, "correlated_failure"
+    elif not reroute_feasible:
+        reroute.feasible, reroute.reason = False, (reroute_reason
+                                                   or "reroute_infeasible")
+    reinst = ArmSignals(
+        mechanism="reinstantiate",
+        latency_s=0.0, latency_source="",
+        retention=survivor_frac,
+    )
+    reinst.latency_s, reinst.latency_source = _latency(
+        "reinstantiate",
+        "reinstantiate" if warm_reinstantiate else "reinstantiate_respawn",
+        latency_overrides, registry)
+
+    restore = ArmSignals(
+        mechanism="restore",
+        latency_s=0.0, latency_source="",
+        retention=survivor_frac,
+        in_memory=False,
+    )
+    restore.latency_s, restore.latency_source = _latency(
+        "restore", "restore", latency_overrides, registry)
+    if staleness_steps is None:
+        restore.feasible, restore.reason = False, "no_durable_checkpoint"
+    else:
+        restore.lost_work_s = max(float(staleness_steps), 0.0) * (
+            step_seconds if step_seconds else PRIOR_STEP_S)
+    return {"reroute": reroute, "reinstantiate": reinst, "restore": restore}

@@ -1,0 +1,58 @@
+"""Process-wide fence between background XLA work and the train thread.
+
+Root cause of the PR-3 slow-suite flake (tests/elastic/test_multiprocess.py:
+a respawned multihost worker died one step after its first post-restore
+checkpoint save — loss went NaN then SIGABRT, or SIGSEGV inside the step's
+``float(loss)`` readback): after a restore the warm-recovery precompiler
+re-arms and starts AOT-compiling predicted stage programs on a daemon
+thread, while the train thread is dispatching steps, reading losses back,
+and staging checkpoint snapshots to host. On the XLA CPU runtime those
+call classes are not reliably safe to interleave — the readback can
+observe buffers the concurrent compile's constant-folding evaluator is
+touching, and the process dies exactly one step after the save that
+re-armed the precompiler. The flake reproduces at PR-2 HEAD and goes
+quiet with warm compile caches (nothing left to compile), which is what
+pinned the compile thread as the other party.
+
+``device_work(owner)`` is the ordering fence: the precompiler holds it
+per chunk lower+compile, the train loop holds it across one step, the
+checkpoint path holds it around snapshot staging, and the mirror writer
+holds it around its off-thread device_get. Uncontended it is one lock
+acquire per step; contended, the wait is bounded by one chunk compile
+(the precompiler yields between chunks) and is flight-recorded as
+``background_work_wait`` so the trade shows up in incident forensics
+instead of disappearing into step time.
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+from contextlib import contextmanager
+
+# Flight-record waits longer than this; shorter ones are scheduler noise.
+WAIT_RECORD_S = 0.05
+
+# RLock: the train step may re-enter (a step that triggers an inline
+# reconfigure can reach the checkpoint staging path while already holding
+# the fence).
+_lock = threading.RLock()
+
+
+@contextmanager
+def device_work(owner: str):
+    """Serialize one unit of XLA-touching work against every other
+    holder. `owner` names the party for the flight recorder."""
+    t0 = time.perf_counter()
+    _lock.acquire()
+    waited = time.perf_counter() - t0
+    try:
+        if waited >= WAIT_RECORD_S:
+            from oobleck_tpu.utils import metrics
+
+            metrics.flight_recorder().record(
+                "background_work_wait", owner=owner,
+                waited_s=round(waited, 4))
+        yield
+    finally:
+        _lock.release()

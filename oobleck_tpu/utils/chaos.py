@@ -30,6 +30,21 @@ directives; each directive is ``action=arg[:qual][@ip]``:
                                 tests need (single-controller: the engine
                                 synthesizes the host loss in place of an
                                 out-of-band SIGKILL)
+    flap_host=10.0.0.1:2        churn: host 10.0.0.1 flaps — its agent
+                                drops the master connection every 2 s and
+                                re-registers, repeatedly (the policy
+                                plane's quarantine-with-hysteresis case)
+    kill_hosts=10.0.0.1+10.0.0.2  correlated simultaneous failure: both
+                                hosts declared lost in the SAME step
+                                boundary, once (rerouting around two
+                                losses at once is usually infeasible —
+                                the policy plane must see them together)
+    preempt_notice=5:1@10.0.0.1 spot preemption with advance warning:
+                                1 s after startup host 10.0.0.1 sends a
+                                SIGTERM-style notice to the master, then
+                                dies for real 5 s later — the window the
+                                proactive drain + checkpoint flush must
+                                fit inside
 
 Barriers are explicit calls (``chaos().barrier("step_end", ip=...)``)
 placed at recovery-relevant points: worker start, step start/end, and
@@ -56,7 +71,8 @@ logger = logging.getLogger("oobleck.chaos")
 ENV_VAR = "OOBLECK_CHAOS"
 
 _KNOWN_ACTIONS = ("delay_send", "drop_send", "stall_heartbeat", "kill_at",
-                  "delay_at", "kill_stage")
+                  "delay_at", "kill_stage", "flap_host", "kill_hosts",
+                  "preempt_notice")
 
 
 @dataclass
@@ -100,6 +116,24 @@ def parse_spec(spec: str) -> list[Rule]:
         elif action == "kill_stage":
             int(rule.arg)           # kill_stage=<stage>:<replica>
             int(rule.qual or 0)
+        elif action == "flap_host":
+            if not rule.arg:        # flap_host=<ip>:<period>
+                raise ValueError(f"flap_host needs a host ip: {directive!r}")
+            if float(rule.qual or 0) <= 0:
+                raise ValueError(
+                    f"flap_host needs a positive period: {directive!r}")
+        elif action == "kill_hosts":
+            if not all(p for p in rule.arg.split("+")) or not rule.arg:
+                raise ValueError(
+                    f"kill_hosts needs '+'-joined host ips: {directive!r}")
+        elif action == "preempt_notice":
+            if float(rule.arg) <= 0:  # preempt_notice=<secs>[:<delay>]@ip
+                raise ValueError(
+                    f"preempt_notice needs positive seconds: {directive!r}")
+            float(rule.qual or 0)
+            if not rule.ip:
+                raise ValueError(
+                    f"preempt_notice needs a victim @ip: {directive!r}")
         elif rule.qual is not None:
             int(rule.qual)
         rules.append(rule)
@@ -182,6 +216,73 @@ class Chaos:
                 "chaos_injection", action="kill_stage", stage=stage,
                 replica=replica)
             return stage, replica
+        return None
+
+    # -- churn directives (policy-plane faults) ----------------------------- #
+
+    def flap_period(self, ip: str | None) -> float | None:
+        """Seconds between connection flaps for this host, or None if no
+        flap_host rule targets it. The agent owns the flap loop; this is
+        read once at startup (flight-recorded on first read only)."""
+        for r in self.rules:
+            if r.action == "flap_host" and r.arg == ip:
+                period = float(r.qual or 0)
+                i = self.rules.index(r)
+                if not self._counts.get(i):
+                    self._counts[i] = 1
+                    logger.warning(
+                        "chaos: host %s will flap every %.2fs", ip, period)
+                    from oobleck_tpu.utils import metrics
+
+                    metrics.flight_recorder().record(
+                        "chaos_injection", action="flap_host", ip=ip,
+                        period=period)
+                return period
+        return None
+
+    def kill_hosts_target(self) -> list[str] | None:
+        """One-shot list of hosts to declare lost in the SAME step boundary
+        (correlated failure), or None. Consuming, like kill_stage_target:
+        dead hosts cannot die again."""
+        for r in self.rules:
+            if r.action != "kill_hosts":
+                continue
+            i = self.rules.index(r)
+            if self._counts.get(i, 0):
+                continue
+            self._counts[i] = 1
+            ips = [p for p in r.arg.split("+") if p]
+            logger.warning("chaos: correlated kill of hosts %s", ips)
+            from oobleck_tpu.utils import metrics
+
+            metrics.flight_recorder().record(
+                "chaos_injection", action="kill_hosts", ips=ips)
+            return ips
+        return None
+
+    def preempt_notice(self, ip: str | None) -> tuple[float, float] | None:
+        """One-shot (warn_seconds, startup_delay_seconds) if this host has a
+        pending spot-preemption injection, else None. The agent sends the
+        advance notice after the startup delay, then dies warn_seconds
+        later — the window proactive drain + checkpoint flush must fit
+        inside. Consuming."""
+        for r in self.rules:
+            if r.action != "preempt_notice" or not r.matches_ip(ip):
+                continue
+            i = self.rules.index(r)
+            if self._counts.get(i, 0):
+                continue
+            self._counts[i] = 1
+            warn, delay = float(r.arg), float(r.qual or 0)
+            logger.warning(
+                "chaos: preemption notice on %s in %.2fs, death %.2fs later",
+                ip, delay, warn)
+            from oobleck_tpu.utils import metrics
+
+            metrics.flight_recorder().record(
+                "chaos_injection", action="preempt_notice", ip=ip,
+                warn_seconds=warn, delay_seconds=delay)
+            return warn, delay
         return None
 
     # -- named barriers ---------------------------------------------------- #

@@ -42,12 +42,32 @@ def test_parse_spec_grammar():
     assert parse_spec("") == []
 
 
+def test_parse_spec_churn_directives():
+    rules = parse_spec(
+        "flap_host=10.0.0.1:2, kill_hosts=10.0.0.1+10.0.0.2,"
+        "preempt_notice=5:1@10.0.0.3"
+    )
+    assert [(r.action, r.arg, r.qual, r.ip) for r in rules] == [
+        ("flap_host", "10.0.0.1", "2", None),
+        ("kill_hosts", "10.0.0.1+10.0.0.2", None, None),
+        ("preempt_notice", "5", "1", "10.0.0.3"),
+    ]
+
+
 @pytest.mark.parametrize("bad", [
     "explode=now",            # unknown action
     "delay_send",             # no '='
     "delay_send=soon",        # non-numeric delay
     "drop_send=ping:always",  # non-integer ordinal
     "kill_at=step_end:x",     # non-integer ordinal
+    "flap_host=10.0.0.1",     # no flap period
+    "flap_host=10.0.0.1:0",   # non-positive period
+    "flap_host=:2",           # no host ip
+    "kill_hosts=",            # no hosts
+    "kill_hosts=10.0.0.1++10.0.0.2",  # empty segment
+    "preempt_notice=5",       # no victim @ip
+    "preempt_notice=0@10.0.0.1",      # non-positive warning
+    "preempt_notice=soon@10.0.0.1",   # non-numeric warning
 ])
 def test_parse_spec_rejects_typos_eagerly(bad):
     # A typo'd injection spec must fail the run at parse time, not
@@ -78,6 +98,29 @@ def test_heartbeat_stall_threshold_and_ip_filter():
     assert not c.heartbeat_stalled("10.0.0.1")
     assert c.heartbeat_stalled("10.0.0.1")
     assert not c.heartbeat_stalled("10.0.0.2")
+
+
+def test_churn_directive_semantics():
+    """flap_period is per-victim and repeatable (the agent owns the loop);
+    kill_hosts / preempt_notice are one-shot — dead hosts cannot die
+    again. Every injection lands a chaos_injection flight event."""
+    from oobleck_tpu.utils import metrics
+
+    c = Chaos("flap_host=10.0.0.1:2,kill_hosts=10.0.0.2+10.0.0.3,"
+              "preempt_notice=5:1@10.0.0.4")
+    assert c.flap_period("10.0.0.1") == pytest.approx(2.0)
+    assert c.flap_period("10.0.0.1") == pytest.approx(2.0)  # idempotent read
+    assert c.flap_period("10.0.0.9") is None
+    assert c.kill_hosts_target() == ["10.0.0.2", "10.0.0.3"]
+    assert c.kill_hosts_target() is None                    # consumed
+    assert c.preempt_notice("10.0.0.9") is None             # wrong victim
+    assert c.preempt_notice("10.0.0.4") == (5.0, 1.0)
+    assert c.preempt_notice("10.0.0.4") is None             # consumed
+    injected = {(e.get("action"), e.get("ip"))
+                for e in metrics.flight_recorder().events()
+                if e["event"] == "chaos_injection"}
+    assert {("flap_host", "10.0.0.1"), ("kill_hosts", None),
+            ("preempt_notice", "10.0.0.4")} <= injected
 
 
 def test_inactive_chaos_is_a_noop():

@@ -436,12 +436,18 @@ def test_multiprocess_mpmd_checkpoint_free_recovery(tmp_path, n_hosts,
                     return r.read()
 
             # The post-recovery worker push is in flight (pipe -> agent ->
-            # TCP); poll until the cluster-wide view shows it.
+            # TCP); poll until the cluster-wide view shows it. A worker
+            # gauge alone is not enough — a pre-kill survivor snapshot
+            # already carries one — so also wait for the recovery-latency
+            # observation that only the post-recovery first_step mark emits.
             prom = ""
             while time.monotonic() < deadline:
                 prom = _get("/metrics").decode()
-                if re.search(r'oobleck_engine_tokens_per_sec\{[^}]*'
-                             r'role="worker"', prom):
+                if (re.search(r'oobleck_engine_tokens_per_sec\{[^}]*'
+                              r'role="worker"', prom)
+                        and re.search(
+                            r'oobleck_recovery_latency_seconds_count'
+                            r'\{[^}]*\} [1-9]', prom)):
                     break
                 time.sleep(0.5)
             assert re.search(

@@ -1,0 +1,126 @@
+"""Regression guard for the background-work fence (utils/background.py).
+
+The PR-3 slow-suite flake was a race between the recovery precompiler's
+daemon-thread AOT compiles and the train thread's dispatch/readback/
+checkpoint staging on the XLA CPU runtime (a respawned worker died one
+step after its first post-restore save — exactly when the precompiler
+re-arms). These tests pin the fence's contract so a refactor can't
+silently drop it: mutual exclusion, re-entrancy from the train thread,
+contended waits surfacing in the flight recorder, and the checkpoint
+writer's staging actually routing through the fence.
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+
+import numpy as np
+
+from oobleck_tpu.ckpt import snapshot as snp
+from oobleck_tpu.ckpt.writer import SnapshotWriter
+from oobleck_tpu.utils import background, metrics
+
+
+def test_device_work_mutual_exclusion():
+    """Two threads doing device work never overlap inside the fence."""
+    inside = 0
+    max_inside = 0
+    guard = threading.Lock()
+
+    def work(_):
+        nonlocal inside, max_inside
+        for _ in range(20):
+            with background.device_work("test"):
+                with guard:
+                    inside += 1
+                    max_inside = max(max_inside, inside)
+                time.sleep(0.001)
+                with guard:
+                    inside -= 1
+
+    threads = [threading.Thread(target=work, args=(i,)) for i in range(3)]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join()
+    assert max_inside == 1
+
+
+def test_device_work_reentrant():
+    """The train thread may re-enter (a step that triggers an inline
+    checkpoint reaches the staging fence while already holding it)."""
+    done = []
+    with background.device_work("train_step"):
+        with background.device_work("ckpt_stage"):
+            done.append(True)
+    assert done == [True]
+
+
+def test_contended_wait_flight_recorded():
+    """A wait past WAIT_RECORD_S lands in the flight recorder with the
+    waiting owner's name, so contention shows up in incident forensics."""
+    release = threading.Event()
+    held = threading.Event()
+
+    def holder():
+        with background.device_work("holder"):
+            held.set()
+            release.wait(timeout=5.0)
+
+    t = threading.Thread(target=holder)
+    t.start()
+    assert held.wait(timeout=5.0)
+    try:
+        timer = threading.Timer(background.WAIT_RECORD_S + 0.1, release.set)
+        timer.start()
+        with background.device_work("waiter"):
+            pass
+    finally:
+        release.set()
+        t.join()
+    waits = [e for e in metrics.flight_recorder().events()
+             if e["event"] == "background_work_wait"
+             and e.get("owner") == "waiter"]
+    assert waits, "contended fence wait was not flight-recorded"
+    assert waits[-1]["waited_s"] >= background.WAIT_RECORD_S
+
+
+def test_ckpt_submit_routes_through_fence(tmp_path):
+    """writer.submit's staging must hold the fence: while a background
+    party (stand-in for the precompiler) holds it, submit blocks; once
+    released, the snapshot stages and the write completes."""
+    w = SnapshotWriter(tmp_path, asynchronous=False)
+    snap = snp.Snapshot(
+        step=1, kind="layers", meta={"step": 1},
+        entries=[("p/0/w", np.arange(4, dtype=np.float32))])
+
+    submitted = threading.Event()
+
+    def do_submit():
+        w.submit(snap)
+        submitted.set()
+
+    release = threading.Event()
+    held = threading.Event()
+
+    def holder():
+        with background.device_work("precompile"):
+            held.set()
+            release.wait(timeout=10.0)
+
+    h = threading.Thread(target=holder)
+    h.start()
+    assert held.wait(timeout=5.0)
+    s = threading.Thread(target=do_submit)
+    s.start()
+    try:
+        # Fence held -> staging (and the sync write behind it) can't run.
+        assert not submitted.wait(timeout=0.3)
+    finally:
+        release.set()
+        h.join()
+    s.join(timeout=10.0)
+    assert submitted.is_set()
+    assert w.last_durable_step == 1
+    assert all(isinstance(v, snp.HostValue) for _, v in snap.entries)

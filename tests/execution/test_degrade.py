@@ -291,7 +291,7 @@ def test_chaos_kill_stage_resolves_to_replica_host(cache_env, devices8):
     try:
         chaos_mod.reset("kill_stage=0:1")
         eng._maybe_chaos_kill_stage()
-        assert [ip for ip, _ in eng._pending_lost] == ["10.0.0.1"]
+        assert [ip for ip, _, _ in eng._pending_lost] == ["10.0.0.1"]
         # In-process detection mints the incident trace right here.
         assert eng._pending_lost[0][1]["trace_id"]
         eng._maybe_reconfigure()
