@@ -2,12 +2,13 @@
 
 PY ?= python
 
-.PHONY: lint test test-slow tier1 bench bench-diff trace-report ckpt-bench serve-bench pipeline-bench degrade-bench policy-bench
+.PHONY: lint analyze gen-registry test test-slow tier1 bench bench-diff trace-report ckpt-bench serve-bench pipeline-bench degrade-bench policy-bench
 
-# Lint via ruff (config in pyproject.toml). Degrades to a skip when ruff
-# is not installed — the hermetic CI image does not ship it, and the gate
-# must not fail on a missing optional tool.
-lint:
+# Lint = the project-native analyzer (always available, stdlib-only)
+# plus ruff (config in pyproject.toml). Ruff degrades to a skip when not
+# installed — the hermetic CI image does not ship it, and the gate must
+# not fail on a missing optional tool.
+lint: analyze
 	@if command -v ruff >/dev/null 2>&1; then \
 		ruff check . && echo "lint OK"; \
 	elif $(PY) -c "import ruff" >/dev/null 2>&1; then \
@@ -15,6 +16,19 @@ lint:
 	else \
 		echo "ruff not installed; skipping lint (config: pyproject.toml [tool.ruff])"; \
 	fi
+
+# oobleck-lint: rules OBL001-OBL006 (oobleck_tpu/analysis). Exit nonzero
+# on any finding that is neither suppressed inline nor baselined. Also
+# verifies the generated observability registry is fresh.
+analyze:
+	$(PY) -m oobleck_tpu.analysis
+	$(PY) -m oobleck_tpu.analysis.genregistry --check
+
+# Regenerate oobleck_tpu/obs/registry.py from the tree's literal metric/
+# flight-event/span names (rule OBL005 checks against it; strict runtime
+# enforcement via OOBLECK_STRICT_REGISTRY=1).
+gen-registry:
+	$(PY) -m oobleck_tpu.analysis.genregistry
 
 test:
 	JAX_PLATFORMS=cpu $(PY) -m pytest tests/ -q -m 'not slow' \

@@ -591,6 +591,29 @@ def _cpu_proxy_env() -> dict:
     }
 
 
+def _analysis_summary() -> dict:
+    """One oobleck-lint run over the tree: rule inventory plus finding
+    counts, so the bench line records the static-analysis posture the
+    build shipped with (and a diff catches a finding-count creep)."""
+    from pathlib import Path
+
+    from oobleck_tpu.analysis import all_rules, run_analysis
+
+    result = run_analysis(Path(__file__).resolve().parent)
+    s = result.summary()
+    return {
+        "rules": s["rules"],
+        "rule_codes": [r.code for r in all_rules()],
+        "files_scanned": s["files"],
+        "findings": s["findings_new"],
+        # Deliberately NOT named *findings*: a new justified suppression
+        # is not a regression, and the diff keys direction off the name.
+        "suppressed": s["findings_suppressed"],
+        "baselined": s["findings_baselined"],
+        "parse_errors": s["parse_errors"],
+    }
+
+
 def _emit(result: dict) -> None:
     # Fold in the JSONL metrics sink (engine gauges, recovery-latency
     # percentiles) so the perf trajectory is tracked from real counters
@@ -628,6 +651,14 @@ def _emit(result: dict) -> None:
         result["policy"] = _policy_summary()
     except Exception as exc:  # noqa: BLE001 — emit must never fail
         result["policy"] = {"error": f"{type(exc).__name__}: {exc}"}
+    # Static-analysis posture (oobleck_tpu/analysis): in-process, cheap.
+    # `findings` counts NEW findings — anything nonzero means the tree
+    # regressed against the lint gate, so the diff treats it lower-is-
+    # better (see _LOWER_BETTER).
+    try:
+        result["analysis"] = _analysis_summary()
+    except Exception as exc:  # noqa: BLE001 — emit must never fail
+        result["analysis"] = {"error": f"{type(exc).__name__}: {exc}"}
     _stamp_provenance(result)
     print(json.dumps(result))
 
@@ -663,7 +694,7 @@ DIFF_THRESHOLD = 0.05
 _HIGHER_BETTER = ("per_sec", "per_second", "speedup", "retention",
                   "throughput")
 _LOWER_BETTER = ("latency", "seconds", "ttft", "pause", "bubble", "stall",
-                 "p50", "p90", "p99")
+                 "p50", "p90", "p99", "findings", "parse_errors")
 _LOWER_BETTER_SUFFIXES = ("_s", "_ms")
 
 

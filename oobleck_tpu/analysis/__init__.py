@@ -1,0 +1,55 @@
+"""oobleck-lint: project-native static analysis.
+
+Generic linters cannot see this repo's load-bearing invariants — the
+``device_work`` fence between background XLA work and the train thread
+(PR-9 flake), the zero-steady-state-host-sync hot-path contract (PR 5),
+the no-views-of-donated-buffers rule (PR-3 checkpoint corruption), the
+legacy-tolerant wire protocol (PR 9), the single metric/flight-event
+namespace, and no-blocking-I/O-in-async control planes. This package
+turns each of them into a machine-checked rule:
+
+    OBL001  fence-discipline     device calls on background threads must
+                                 hold ``utils/background.py:device_work``
+    OBL002  host-sync leak       float()/.item()/np.asarray/
+                                 block_until_ready in step-loop modules
+                                 outside the DeferredLoss funnel
+    OBL003  use-after-donation   views of arguments passed to jitted
+                                 callables with donate_argnums
+    OBL004  verb exhaustiveness  every ResponseType verb dispatched in
+                                 agent + engine; broadcast payload keys
+                                 through named-constant helpers
+    OBL005  name registry        metric families / flight-event kinds
+                                 declared in obs/registry.py (generated)
+    OBL006  blocking-in-async    time.sleep / blocking file + socket I/O
+                                 inside ``async def``
+
+Run ``python -m oobleck_tpu.analysis`` (wired as ``make analyze``, part
+of ``make lint``). Inline suppressions: ``# oobleck: allow[OBL002] --
+reason`` on the offending line or the comment line just above it.
+Grandfathered findings live in ``analysis/baseline.json`` with a reason
+each; the analyzer exits non-zero only on NEW findings.
+"""
+
+from oobleck_tpu.analysis.core import (
+    AnalysisResult,
+    Finding,
+    ModuleInfo,
+    Project,
+    Rule,
+    all_rules,
+    default_baseline_path,
+    load_baseline,
+    run_analysis,
+)
+
+__all__ = [
+    "AnalysisResult",
+    "Finding",
+    "ModuleInfo",
+    "Project",
+    "Rule",
+    "all_rules",
+    "default_baseline_path",
+    "load_baseline",
+    "run_analysis",
+]

@@ -1,0 +1,160 @@
+"""Shared AST helpers for the oobleck-lint rules.
+
+Everything here is stdlib-``ast`` only: the analyzer must never import
+the code under analysis (importing the engine drags in jax), and must
+run in well under a second on the whole tree so ``make lint`` stays
+cheap.
+"""
+
+from __future__ import annotations
+
+import ast
+from collections.abc import Iterator
+
+
+def attach_parents(tree: ast.AST) -> None:
+    """Stamp a ``_oobleck_parent`` backlink on every node so rules can
+    walk ancestor chains (enclosing function, enclosing With, ...)."""
+    for node in ast.walk(tree):
+        for child in ast.iter_child_nodes(node):
+            child._oobleck_parent = node  # type: ignore[attr-defined]
+
+
+def parent(node: ast.AST) -> ast.AST | None:
+    return getattr(node, "_oobleck_parent", None)
+
+
+def ancestors(node: ast.AST) -> Iterator[ast.AST]:
+    cur = parent(node)
+    while cur is not None:
+        yield cur
+        cur = parent(cur)
+
+
+def enclosing_function(node: ast.AST) -> ast.FunctionDef | ast.AsyncFunctionDef | None:
+    for anc in ancestors(node):
+        if isinstance(anc, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            return anc
+    return None
+
+
+def enclosing_class(node: ast.AST) -> ast.ClassDef | None:
+    for anc in ancestors(node):
+        if isinstance(anc, ast.ClassDef):
+            return anc
+    return None
+
+
+def scope_name(node: ast.AST) -> str:
+    """Dotted enclosing scope, e.g. ``DeviceStager._grab`` — the stable
+    half of a finding fingerprint (line numbers churn, scopes rarely)."""
+    parts: list[str] = []
+    for anc in ancestors(node):
+        if isinstance(anc, (ast.FunctionDef, ast.AsyncFunctionDef, ast.ClassDef)):
+            parts.append(anc.name)
+    return ".".join(reversed(parts)) or "<module>"
+
+
+def call_name(call: ast.Call) -> str:
+    """Last path segment of the callee: ``jax.jit`` -> ``jit``,
+    ``self.engine.decode`` -> ``decode``, ``float`` -> ``float``."""
+    func = call.func
+    if isinstance(func, ast.Attribute):
+        return func.attr
+    if isinstance(func, ast.Name):
+        return func.id
+    return ""
+
+
+def dotted_name(node: ast.AST) -> str:
+    """Best-effort dotted rendering of a Name/Attribute chain
+    (``self.engine.decode``); '' for anything non-trivial."""
+    parts: list[str] = []
+    cur = node
+    while isinstance(cur, ast.Attribute):
+        parts.append(cur.attr)
+        cur = cur.value
+    if isinstance(cur, ast.Name):
+        parts.append(cur.id)
+    elif isinstance(cur, ast.Call):
+        parts.append(dotted_name(cur.func) + "()")
+    else:
+        return ""
+    return ".".join(reversed(parts))
+
+
+def receiver_name(call: ast.Call) -> str:
+    """The attribute segment the method hangs off: ``self.engine.decode``
+    -> ``engine``, ``re.compile`` -> ``re``, ``decode(x)`` -> ''."""
+    func = call.func
+    if not isinstance(func, ast.Attribute):
+        return ""
+    value = func.value
+    if isinstance(value, ast.Attribute):
+        return value.attr
+    if isinstance(value, ast.Name):
+        return value.id
+    return ""
+
+
+def first_str_arg(call: ast.Call) -> str | None:
+    """The literal first positional argument, or None when absent or
+    dynamic (f-string, variable)."""
+    if not call.args:
+        return None
+    arg = call.args[0]
+    if isinstance(arg, ast.Constant) and isinstance(arg.value, str):
+        return arg.value
+    return None
+
+
+def inside_with_call(node: ast.AST, callee_names: set[str]) -> bool:
+    """True when any ancestor ``with`` statement's context manager is a
+    call whose name is in ``callee_names`` (e.g. {"device_work"})."""
+    for anc in ancestors(node):
+        if isinstance(anc, (ast.With, ast.AsyncWith)):
+            for item in anc.items:
+                expr = item.context_expr
+                if isinstance(expr, ast.Call) and call_name(expr) in callee_names:
+                    return True
+    return False
+
+
+def functions_of(tree: ast.AST) -> dict[str, list[ast.FunctionDef | ast.AsyncFunctionDef]]:
+    """All function/method defs in a module keyed by bare name. Collisions
+    (same method name on two classes) keep every definition — callers over-
+    approximate, which for a reachability lint errs on the safe side."""
+    out: dict[str, list] = {}
+    for node in ast.walk(tree):
+        if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            out.setdefault(node.name, []).append(node)
+    return out
+
+
+def called_names(fn: ast.AST) -> set[str]:
+    """Bare names of everything a function calls, including ``self.x()``
+    method calls (-> ``x``) — the intra-module call-graph edge set."""
+    names: set[str] = set()
+    for node in ast.walk(fn):
+        if isinstance(node, ast.Call):
+            name = call_name(node)
+            if name:
+                names.add(name)
+    return names
+
+
+def resolve_recorder_vars(fn: ast.AST, factory_names: set[str]) -> set[str]:
+    """Local variable names assigned from a factory call anywhere in
+    ``fn`` — e.g. ``fr = metrics.flight_recorder()`` with
+    factory_names={"flight_recorder"} yields {"fr"}. Also follows
+    ``self._x = flight_recorder()`` to ``_x``."""
+    out: set[str] = set()
+    for node in ast.walk(fn):
+        if isinstance(node, ast.Assign) and isinstance(node.value, ast.Call):
+            if call_name(node.value) in factory_names:
+                for tgt in node.targets:
+                    if isinstance(tgt, ast.Name):
+                        out.add(tgt.id)
+                    elif isinstance(tgt, ast.Attribute):
+                        out.add(tgt.attr)
+    return out

@@ -1,0 +1,98 @@
+"""Generate ``oobleck_tpu/obs/registry.py`` from the source tree.
+
+Usage: ``python -m oobleck_tpu.analysis.genregistry [--check]``
+
+Scans every name-introducing call site (the same collection logic rule
+OBL005 lints with — see ``rules/registry_names.py``) and writes the
+three frozensets the observability plane treats as its schema:
+``METRIC_FAMILIES``, ``FLIGHT_EVENT_KINDS``, ``SPAN_NAMES``. Output is
+deterministic (sorted, no timestamps) so the file diffs cleanly and a
+``--check`` run can assert freshness in CI.
+
+The generated module is imported lazily by ``utils/metrics.py`` when
+``OOBLECK_STRICT_REGISTRY=1``, turning the same schema into a runtime
+assertion for debug/test runs.
+"""
+
+from __future__ import annotations
+
+import argparse
+import sys
+from pathlib import Path
+
+from oobleck_tpu.analysis.core import DEFAULT_TARGETS, build_project
+from oobleck_tpu.analysis.rules.registry_names import (
+    CollectedNames,
+    collect_names,
+)
+
+HEADER = '''\
+"""Observability name registry — GENERATED, do not edit by hand.
+
+Regenerate with ``make gen-registry`` (or
+``python -m oobleck_tpu.analysis.genregistry``) after adding a metric
+family, flight-event kind, or span name. Rule OBL005 fails the lint when
+a literal name in the tree is missing here; ``OOBLECK_STRICT_REGISTRY=1``
+makes ``utils/metrics.py`` enforce membership at runtime.
+"""
+
+from __future__ import annotations
+
+'''
+
+
+def _render_set(name: str, values: set[str]) -> str:
+    lines = [f"{name} = frozenset({{"]
+    lines.extend(f'    "{v}",' for v in sorted(values))
+    lines.append("})")
+    return "\n".join(lines)
+
+
+def render(names: CollectedNames) -> str:
+    return HEADER + "\n\n".join([
+        _render_set("METRIC_FAMILIES", names.metrics),
+        _render_set("FLIGHT_EVENT_KINDS", names.flight_events),
+        _render_set("SPAN_NAMES", names.spans),
+    ]) + "\n"
+
+
+def registry_path(root: Path) -> Path:
+    return root / "oobleck_tpu" / "obs" / "registry.py"
+
+
+def generate(root: Path) -> str:
+    project = build_project(root, DEFAULT_TARGETS)
+    return render(collect_names(project))
+
+
+def main(argv: list[str] | None = None) -> int:
+    parser = argparse.ArgumentParser(
+        prog="python -m oobleck_tpu.analysis.genregistry")
+    parser.add_argument("--root", type=Path, default=None)
+    parser.add_argument("--check", action="store_true",
+                        help="exit 1 if the checked-in registry is stale "
+                             "instead of rewriting it")
+    args = parser.parse_args(argv)
+
+    root = args.root
+    if root is None:
+        from oobleck_tpu.analysis.__main__ import _find_root
+        root = _find_root(Path.cwd())
+    root = root.resolve()
+
+    content = generate(root)
+    out = registry_path(root)
+    if args.check:
+        current = out.read_text() if out.is_file() else ""
+        if current != content:
+            print(f"{out} is stale — run `make gen-registry`")
+            return 1
+        print(f"{out} is up to date")
+        return 0
+    out.write_text(content)
+    print(f"wrote {out}")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -1,0 +1,22 @@
+"""The six project-native rules, in code order. Each encodes one
+invariant a past incident proved this repo cannot keep by review alone —
+see the class docstrings (and README "Static analysis") for the history.
+"""
+
+from oobleck_tpu.analysis.rules.asyncio_blocking import AsyncBlockingRule
+from oobleck_tpu.analysis.rules.donation import DonationRule
+from oobleck_tpu.analysis.rules.fence import FenceRule
+from oobleck_tpu.analysis.rules.hotpath import HotPathRule
+from oobleck_tpu.analysis.rules.protocol import ProtocolRule
+from oobleck_tpu.analysis.rules.registry_names import RegistryNamesRule
+
+RULES = [
+    FenceRule,
+    HotPathRule,
+    DonationRule,
+    ProtocolRule,
+    RegistryNamesRule,
+    AsyncBlockingRule,
+]
+
+__all__ = ["RULES"]

@@ -1,0 +1,91 @@
+"""OBL006 — blocking calls inside ``async def`` bodies.
+
+History: the elastic master is a single asyncio event loop multiplexing
+heartbeats, agent connections, and recovery broadcasts. One blocking
+call in a coroutine stalls every timer on the loop — a stalled heartbeat
+scan reads as a dead agent and can trigger a spurious (expensive)
+recovery. This nearly shipped in PR 9: a synchronous ``open()`` in the
+SSH launch path, invisible in tests because the loop was otherwise idle.
+
+The rule is lexical: inside an ``async def`` body (NOT descending into
+nested ``def``/``lambda``, which run wherever they are called), flag
+``time.sleep``, builtin ``open``, ``subprocess.run/call/check_output/
+check_call``, ``os.system``, and ``socket.create_connection``. The
+sanctioned escapes are ``await asyncio.to_thread(...)`` and
+``loop.run_in_executor(...)`` — both take the callable uncalled, so they
+never match. ``Popen`` (non-blocking spawn) and pipe ``send``/``recv``
+are deliberately not flagged.
+
+Scope: ``elastic/master.py`` — the only event loop in the tree.
+"""
+
+from __future__ import annotations
+
+import ast
+from collections.abc import Iterator
+
+from oobleck_tpu.analysis import astutil
+from oobleck_tpu.analysis.core import Finding, ModuleInfo, Project, Rule
+
+ASYNC_MODULES = ("oobleck_tpu/elastic/master.py",)
+
+# bare-name builtins that block
+BLOCKING_BUILTINS = {"open"}
+# receiver -> blocking attribute calls
+BLOCKING_METHODS = {
+    "time": {"sleep"},
+    "subprocess": {"run", "call", "check_output", "check_call"},
+    "os": {"system"},
+    "socket": {"create_connection"},
+}
+
+
+def _async_body_calls(fn: ast.AsyncFunctionDef) -> Iterator[ast.Call]:
+    """Calls lexically in the coroutine body, skipping nested function
+    definitions (they execute in whatever context calls them)."""
+
+    def walk(node: ast.AST) -> Iterator[ast.Call]:
+        for child in ast.iter_child_nodes(node):
+            if isinstance(child, (ast.FunctionDef, ast.AsyncFunctionDef,
+                                  ast.Lambda)):
+                continue
+            if isinstance(child, ast.Call):
+                yield child
+            yield from walk(child)
+
+    yield from walk(fn)
+
+
+def _blocking_kind(call: ast.Call) -> str | None:
+    func = call.func
+    if isinstance(func, ast.Name) and func.id in BLOCKING_BUILTINS:
+        return func.id + "()"
+    if isinstance(func, ast.Attribute):
+        recv = astutil.receiver_name(call)
+        if func.attr in BLOCKING_METHODS.get(recv, ()):
+            return f"{recv}.{func.attr}()"
+    return None
+
+
+class AsyncBlockingRule(Rule):
+    code = "OBL006"
+    name = "blocking-in-async"
+    rationale = ("no blocking I/O or sleeps on the master's event loop — "
+                 "a stalled heartbeat scan looks like a dead agent")
+
+    def check_module(self, module: ModuleInfo,
+                     project: Project) -> Iterator[Finding]:
+        if not module.relpath.endswith(ASYNC_MODULES):
+            return
+        for node in ast.walk(module.tree):
+            if not isinstance(node, ast.AsyncFunctionDef):
+                continue
+            for call in _async_body_calls(node):
+                kind = _blocking_kind(call)
+                if kind is None:
+                    continue
+                yield module.finding(
+                    self, call,
+                    f"{kind} blocks the event loop inside "
+                    f"`async def {node.name}`; use "
+                    f"`await asyncio.to_thread(...)` or an executor")

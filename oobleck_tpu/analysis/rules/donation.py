@@ -1,0 +1,161 @@
+"""OBL003 — use-after-donation views.
+
+History: the PR-3 checkpoint-corruption bug. The snapshot path captured
+``np.asarray(...)`` views of train state; on the CPU backend asarray is
+zero-copy, the train step donates its state buffers
+(``donate_argnums``), and by the next step the "checkpoint" was reading
+recycled memory — silent corruption, caught only by restore checksums
+(``ckpt/snapshot.py`` documents the mandatory-copies rule).
+
+This rule connects the two halves inside one function: if a variable is
+passed at a donated position of a callable jitted with
+``donate_argnums``, then capturing a view of that variable in the same
+function — ``np.asarray(v)``, a slice (``v[...]``), or a bare aliasing
+assignment (``w = v``) — is flagged. ``np.array`` / explicit ``.copy()``
+are the sanctioned escape hatches (they materialize real copies).
+"""
+
+from __future__ import annotations
+
+import ast
+from collections.abc import Iterator
+
+from oobleck_tpu.analysis import astutil
+from oobleck_tpu.analysis.core import Finding, ModuleInfo, Project, Rule
+
+NP_RECEIVERS = {"np", "numpy"}
+JIT_NAMES = {"jit"}
+
+
+def _donating_defs(tree: ast.AST) -> dict[str, tuple[int, ...] | None]:
+    """{bare name: donated positions or None-for-unknown} for every
+    assignment of a ``jit(..., donate_argnums=...)`` result — module
+    globals, locals, and ``self._x`` attributes alike — plus functions
+    decorated with a donating jit."""
+    out: dict[str, tuple[int, ...] | None] = {}
+
+    def positions(call: ast.Call) -> tuple[int, ...] | None:
+        for kw in call.keywords:
+            if kw.arg in ("donate_argnums", "donate_argnames"):
+                v = kw.value
+                if isinstance(v, ast.Constant) and isinstance(v.value, int):
+                    return (v.value,)
+                if isinstance(v, (ast.Tuple, ast.List)):
+                    got = []
+                    for el in v.elts:
+                        if isinstance(el, ast.Constant) \
+                                and isinstance(el.value, int):
+                            got.append(el.value)
+                        else:
+                            return None
+                    return tuple(got)
+                return None  # dynamic → every position treated as donated
+        return ()
+
+    def is_donating_jit(call: ast.Call) -> bool:
+        return astutil.call_name(call) in JIT_NAMES and positions(call) != ()
+
+    for node in ast.walk(tree):
+        if isinstance(node, ast.Assign) and isinstance(node.value, ast.Call):
+            call = node.value
+            if is_donating_jit(call):
+                for tgt in node.targets:
+                    if isinstance(tgt, ast.Name):
+                        out[tgt.id] = positions(call)
+                    elif isinstance(tgt, ast.Attribute):
+                        out[tgt.attr] = positions(call)
+        elif isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            for dec in node.decorator_list:
+                if isinstance(dec, ast.Call) and (
+                        is_donating_jit(dec)
+                        or (astutil.call_name(dec) == "partial" and any(
+                            isinstance(a, (ast.Name, ast.Attribute))
+                            and astutil.dotted_name(a).endswith("jit")
+                            for a in dec.args) and positions(dec) != ())):
+                    out[node.name] = positions(dec)
+    return out
+
+
+def _identifier(node: ast.AST) -> str | None:
+    """Bare identifier of a Name or self-attribute (self._cache -> _cache)."""
+    if isinstance(node, ast.Name):
+        return node.id
+    if isinstance(node, ast.Attribute):
+        return node.attr
+    return None
+
+
+class DonationRule(Rule):
+    code = "OBL003"
+    name = "use-after-donation"
+    rationale = ("no zero-copy views of buffers donated to jit — the "
+                 "PR-3 checkpoint-corruption bug")
+
+    def check_module(self, module: ModuleInfo,
+                     project: Project) -> Iterator[Finding]:
+        donating = _donating_defs(module.tree)
+        if not donating:
+            return
+        for fns in astutil.functions_of(module.tree).values():
+            for fn in fns:
+                yield from self._check_function(module, fn, donating)
+
+    def _check_function(self, module: ModuleInfo, fn: ast.AST,
+                        donating: dict[str, tuple[int, ...] | None],
+                        ) -> Iterator[Finding]:
+        donated_vars: dict[str, str] = {}  # identifier -> donating callee
+        for call in ast.walk(fn):
+            if not isinstance(call, ast.Call):
+                continue
+            callee = astutil.call_name(call)
+            if callee not in donating:
+                continue
+            pos = donating[callee]
+            args = call.args
+            picked = (args if pos is None
+                      else [args[i] for i in pos if i < len(args)])
+            for arg in picked:
+                ident = _identifier(arg)
+                if ident is not None:
+                    donated_vars[ident] = callee
+        if not donated_vars:
+            return
+
+        for node in ast.walk(fn):
+            # np.asarray(v) — zero-copy view, unless .copy()'d right away.
+            if isinstance(node, ast.Call) \
+                    and astutil.call_name(node) == "asarray" \
+                    and astutil.receiver_name(node) in NP_RECEIVERS \
+                    and node.args:
+                ident = _identifier(node.args[0])
+                if ident in donated_vars and not self._copied(node):
+                    yield module.finding(
+                        self, node,
+                        f"np.asarray(`{ident}`) captures a zero-copy view "
+                        f"of a buffer donated to `{donated_vars[ident]}` "
+                        f"(donate_argnums); use np.array / .copy() — the "
+                        f"buffer is recycled by the next step")
+            # w = v  /  w = v[...] — aliasing capture of a donated buffer.
+            elif isinstance(node, ast.Assign):
+                src = node.value
+                if isinstance(src, ast.Subscript):
+                    ident = _identifier(src.value)
+                    label = "a slice view"
+                elif isinstance(src, (ast.Name, ast.Attribute)):
+                    ident = _identifier(src)
+                    label = "an alias"
+                else:
+                    continue
+                if ident in donated_vars:
+                    yield module.finding(
+                        self, node,
+                        f"assignment captures {label} of `{ident}`, which "
+                        f"is donated to `{donated_vars[ident]}` "
+                        f"(donate_argnums); copy before donating")
+
+    @staticmethod
+    def _copied(node: ast.AST) -> bool:
+        """True for np.asarray(v).copy() — the immediate-copy idiom."""
+        p = astutil.parent(node)
+        return (isinstance(p, ast.Attribute) and p.attr == "copy"
+                and isinstance(astutil.parent(p), ast.Call))

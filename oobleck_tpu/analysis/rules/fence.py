@@ -1,0 +1,152 @@
+"""OBL001 — fence discipline for background device work.
+
+History: the PR-3 slow-suite flake. A respawned worker died one step
+after its first post-restore checkpoint save — the warm-recovery
+precompiler was AOT-compiling on a daemon thread while the train thread
+dispatched steps and read losses back, and the XLA CPU runtime does not
+tolerate that interleaving (``utils/background.py`` has the full
+postmortem). The fix was the process-wide ``device_work(owner)`` fence;
+this rule makes holding it a checked obligation, not a convention:
+
+    any device-touching call reachable from a ``threading.Thread``
+    target or an ``Executor.submit`` callback must be lexically inside
+    ``with device_work(...)`` — either in the function itself or in the
+    call frame that reached it.
+
+Reachability is intra-module (call graph by bare name, depth-bounded).
+"Fenced by the caller" propagates: if every call edge into a helper sits
+inside a ``device_work`` block, the helper's device calls pass.
+"""
+
+from __future__ import annotations
+
+import ast
+from collections.abc import Iterator
+
+from oobleck_tpu.analysis import astutil
+from oobleck_tpu.analysis.core import Finding, ModuleInfo, Project, Rule
+
+# Unambiguous device-touching callables (bare or attribute form).
+DEVICE_CALLS = {
+    "jit", "device_put", "device_get", "block_until_ready",
+    "aot_compile", "stage_to_host",
+}
+# `.compile()` is device work (AOT executable build) except `re.compile`.
+COMPILE_NAME = "compile"
+COMPILE_SAFE_RECEIVERS = {"re", "regex"}
+# Project device entry points that only count behind a `.engine` receiver
+# (serve plane: `self.engine.decode(...)`); bare `decode` would collide
+# with bytes.decode.
+ENGINE_QUALIFIED = {"decode", "prefill", "set_params", "stage_params",
+                    "warmup"}
+ENGINE_RECEIVERS = {"engine"}
+# Placement callbacks (DeviceStager et al.): device_put under any name.
+PLACE_CALLS = {"place_fn", "_place_fn", "place_batch", "_place_batch"}
+
+FENCE_NAMES = {"device_work"}
+MAX_VISITS = 4096  # worklist bound: call graphs here are tiny
+
+
+def _is_device_call(call: ast.Call) -> bool:
+    name = astutil.call_name(call)
+    if name in DEVICE_CALLS or name in PLACE_CALLS:
+        return True
+    if name == COMPILE_NAME:
+        return astutil.receiver_name(call) not in COMPILE_SAFE_RECEIVERS
+    if name in ENGINE_QUALIFIED:
+        return astutil.receiver_name(call) in ENGINE_RECEIVERS
+    return False
+
+
+def _entry_targets(tree: ast.AST) -> list[ast.AST | str]:
+    """Thread(target=...) / pool.submit(fn, ...) callbacks: bare names
+    for Name/Attribute callbacks, the Lambda node itself for lambdas."""
+    out: list[ast.AST | str] = []
+    for node in ast.walk(tree):
+        if not isinstance(node, ast.Call):
+            continue
+        name = astutil.call_name(node)
+        cb: ast.AST | None = None
+        if name == "Thread":
+            for kw in node.keywords:
+                if kw.arg == "target":
+                    cb = kw.value
+        elif name == "submit" and node.args:
+            cb = node.args[0]
+        if cb is None:
+            continue
+        if isinstance(cb, ast.Lambda):
+            out.append(cb)
+        elif isinstance(cb, ast.Name):
+            out.append(cb.id)
+        elif isinstance(cb, ast.Attribute):
+            out.append(cb.attr)
+    return out
+
+
+class FenceRule(Rule):
+    code = "OBL001"
+    name = "fence-discipline"
+    rationale = ("device calls on Thread/submit paths must hold "
+                 "device_work() — the PR-9 precompile x checkpoint race")
+
+    def check_module(self, module: ModuleInfo,
+                     project: Project) -> Iterator[Finding]:
+        functions = astutil.functions_of(module.tree)
+        entries = _entry_targets(module.tree)
+        if not entries:
+            return
+
+        # Worklist over (function node, fenced-on-this-path). A function
+        # counts as unfenced if ANY path reaches it unfenced; `state`
+        # holds True ("all observed paths fenced") / False.
+        state: dict[int, bool] = {}
+        nodes: dict[int, ast.AST] = {}
+        work: list[tuple[ast.AST, bool]] = []
+        for entry in entries:
+            if isinstance(entry, str):
+                work.extend((fn, False) for fn in functions.get(entry, ()))
+            else:
+                work.append((entry, False))
+
+        visits = 0
+        while work and visits < MAX_VISITS:
+            visits += 1
+            fn, fenced = work.pop()
+            prev = state.get(id(fn))
+            if prev is not None and prev <= fenced:
+                continue  # already seen at least this unfenced
+            state[id(fn)] = fenced if prev is None else (prev and fenced)
+            nodes[id(fn)] = fn
+            for call in ast.walk(fn):
+                if not isinstance(call, ast.Call):
+                    continue
+                edge_fenced = fenced or astutil.inside_with_call(
+                    call, FENCE_NAMES)
+                for callee in functions.get(astutil.call_name(call), ()):
+                    if callee is not fn:
+                        work.append((callee, edge_fenced))
+
+        reported: set[tuple[int, int]] = set()
+        for fn_id, fenced in state.items():
+            if fenced:
+                continue
+            yield from self._check_body(module, nodes[fn_id], reported)
+
+    def _check_body(self, module: ModuleInfo, fn: ast.AST,
+                    reported: set[tuple[int, int]]) -> Iterator[Finding]:
+        for call in ast.walk(fn):
+            if not isinstance(call, ast.Call) or not _is_device_call(call):
+                continue
+            if astutil.inside_with_call(call, FENCE_NAMES):
+                continue
+            key = (call.lineno, call.col_offset)
+            if key in reported:
+                continue
+            reported.add(key)
+            yield module.finding(
+                self, call,
+                f"device-touching call `{astutil.call_name(call)}` is "
+                f"reachable from a background-thread entry point but not "
+                f"inside `with device_work(...)` "
+                f"(utils/background.py fence)")

@@ -1,0 +1,108 @@
+"""OBL002 — host-sync leak in step-loop modules.
+
+History: PR 5's overlap-everything hot path holds a zero-steady-state-
+host-syncs contract — losses stay on device (``DeferredLoss``) and are
+drained every ``loss_readback_every`` steps; the only sanctioned readback
+funnel is ``_host_sync`` (which increments ``host_sync_counter``, the
+contract's test hook). One stray ``float(loss)`` anywhere in the step
+loop silently re-serializes dispatch and the 942-vs-805 tok/s win
+evaporates without any test failing.
+
+This rule flags host-synchronizing constructs in the step-loop modules —
+``float(x)`` / ``x.item()`` on plausible device values, ``np.asarray``,
+``jax.device_get``, ``block_until_ready`` — anywhere outside the funnel
+(the ``DeferredLoss`` class, ``_host_sync`` itself, or any function that
+touches ``host_sync_counter``). Cold paths that legitimately sync
+(profiling, eval sweeps, reconfiguration) carry inline
+``# oobleck: allow[OBL002] -- reason`` annotations: the rule is
+fail-closed so NEW code in these modules is born compliant.
+"""
+
+from __future__ import annotations
+
+import ast
+from collections.abc import Iterator
+
+from oobleck_tpu.analysis import astutil
+from oobleck_tpu.analysis.core import Finding, ModuleInfo, Project, Rule
+
+HOT_MODULES = (
+    "oobleck_tpu/execution/engine.py",
+    "oobleck_tpu/execution/pipeline.py",
+    "oobleck_tpu/parallel/train.py",
+)
+
+FUNNEL_CLASSES = {"DeferredLoss"}
+FUNNEL_FUNCTIONS = {"_host_sync"}
+FUNNEL_MARKER = "host_sync_counter"
+
+NP_RECEIVERS = {"np", "numpy"}
+
+
+def _references_marker(fn: ast.AST) -> bool:
+    for node in ast.walk(fn):
+        if isinstance(node, ast.Name) and node.id == FUNNEL_MARKER:
+            return True
+        if isinstance(node, ast.Attribute) and node.attr == FUNNEL_MARKER:
+            return True
+    return False
+
+
+def _in_funnel(node: ast.AST, marker_fns: set[int]) -> bool:
+    fn = astutil.enclosing_function(node)
+    if fn is not None and (fn.name in FUNNEL_FUNCTIONS
+                           or id(fn) in marker_fns):
+        return True
+    cls = astutil.enclosing_class(node)
+    return cls is not None and cls.name in FUNNEL_CLASSES
+
+
+def _sync_kind(call: ast.Call) -> str | None:
+    """Name of the host-sync construct, or None."""
+    name = astutil.call_name(call)
+    func = call.func
+    if isinstance(func, ast.Name) and name == "float":
+        # Only plausible device values: a bare name, attribute, or
+        # subscript. float(literal) / float(a * b) / float(fn()) are
+        # host arithmetic, not readbacks.
+        if len(call.args) == 1 and isinstance(
+                call.args[0], (ast.Name, ast.Attribute, ast.Subscript)):
+            return "float()"
+        return None
+    if name == "item" and not call.args and not call.keywords \
+            and isinstance(func, ast.Attribute):
+        return ".item()"
+    if name == "asarray" and astutil.receiver_name(call) in NP_RECEIVERS:
+        return "np.asarray()"
+    if name == "block_until_ready":
+        return "block_until_ready()"
+    if name == "device_get":
+        return "device_get()"
+    return None
+
+
+class HotPathRule(Rule):
+    code = "OBL002"
+    name = "host-sync-leak"
+    rationale = ("step-loop modules must route host syncs through the "
+                 "DeferredLoss/_host_sync funnel — the PR-5 contract")
+
+    def check_module(self, module: ModuleInfo,
+                     project: Project) -> Iterator[Finding]:
+        if not module.relpath.endswith(HOT_MODULES):
+            return
+        marker_fns = {
+            id(fn) for fns in astutil.functions_of(module.tree).values()
+            for fn in fns if _references_marker(fn)
+        }
+        for call in ast.walk(module.tree):
+            if not isinstance(call, ast.Call):
+                continue
+            kind = _sync_kind(call)
+            if kind is None or _in_funnel(call, marker_fns):
+                continue
+            yield module.finding(
+                self, call,
+                f"{kind} forces a host sync in a step-loop module outside "
+                f"the DeferredLoss/_host_sync funnel; steady-state steps "
+                f"must not read device values back")

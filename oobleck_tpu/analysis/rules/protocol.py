@@ -1,0 +1,173 @@
+"""OBL004 — wire-protocol verb exhaustiveness and payload-key hygiene.
+
+History: PR 7 added the DEGRADE verb and PR 9 added RESTORE plus the
+policy payload. Each rode the legacy-tolerance rules of
+``elastic/message.py`` — receivers that predate a verb fall back to
+RECONFIGURATION, and extra payload keys are carried by named constants
+(``spans.TRACE_KEY``, ``policy.DECISION_KEY``) that old receivers
+ignore. Those rules lived in reviewer memory; the PR-8 cleanup found
+stale dispatch code precisely because nothing machine-checked them.
+
+Three checks, all cross-file:
+
+1. every ``ResponseType`` member is dispatched in the agent
+   (``ResponseType.X`` must appear in ``elastic/agent.py``);
+2. every verb the engine is expected to receive has its pipe-kind
+   literal in ``ReconfigurationEngine`` (``execution/engine.py``); a new
+   verb outside the known map needs BOTH a dispatch arm and a map entry
+   here — that forced stop is the point;
+3. broadcast payload construction in ``elastic/master.py`` may only use
+   the core literal keys; anything new must be a named constant
+   (the TRACE_KEY / DECISION_KEY legacy-tolerant pattern).
+"""
+
+from __future__ import annotations
+
+import ast
+from collections.abc import Iterator
+
+from oobleck_tpu.analysis import astutil
+from oobleck_tpu.analysis.core import Finding, ModuleInfo, Project, Rule
+
+MESSAGE_MODULE = "elastic/message.py"
+AGENT_MODULE = "elastic/agent.py"
+ENGINE_MODULE = "execution/engine.py"
+MASTER_MODULE = "elastic/master.py"
+
+# ResponseType member -> the pipe-kind literal the engine's listener
+# (ReconfigurationEngine._listen) must dispatch on. Members absent here
+# and not in CONTROL_PLANE_ONLY are NEW verbs: the rule fails until the
+# engine arm exists and this map says so.
+VERB_TO_ENGINE_KIND = {
+    "RECONFIGURATION": "reconfigure",
+    "DEGRADE": "degrade",
+    "RESTORE": "restore",
+}
+# Verbs the worker/engine never sees (absorbed by the agent/master).
+CONTROL_PLANE_ONLY = {"SUCCESS", "FAILURE", "PONG", "FORWARD_COORDINATOR"}
+
+# Literal keys allowed in broadcast payload dicts; everything else goes
+# through a named constant so legacy receivers can ignore it knowingly.
+CORE_BROADCAST_KEYS = {"lost_ip", "kind"}
+ENGINE_LISTENER_CLASS = "ReconfigurationEngine"
+
+
+def _enum_members(module: ModuleInfo, enum_name: str) -> dict[str, ast.AST]:
+    for node in ast.walk(module.tree):
+        if isinstance(node, ast.ClassDef) and node.name == enum_name:
+            members: dict[str, ast.AST] = {}
+            for stmt in node.body:
+                if isinstance(stmt, ast.Assign):
+                    for tgt in stmt.targets:
+                        if isinstance(tgt, ast.Name):
+                            members[tgt.id] = stmt
+            return members
+    return {}
+
+
+def _attr_accesses(module: ModuleInfo, base: str) -> set[str]:
+    out: set[str] = set()
+    for node in ast.walk(module.tree):
+        if isinstance(node, ast.Attribute) \
+                and isinstance(node.value, ast.Name) \
+                and node.value.id == base:
+            out.add(node.attr)
+    return out
+
+
+def _class_strings(module: ModuleInfo, class_name: str) -> set[str]:
+    for node in ast.walk(module.tree):
+        if isinstance(node, ast.ClassDef) and node.name == class_name:
+            return {c.value for c in ast.walk(node)
+                    if isinstance(c, ast.Constant)
+                    and isinstance(c.value, str)}
+    return set()
+
+
+class ProtocolRule(Rule):
+    code = "OBL004"
+    name = "verb-exhaustiveness"
+    rationale = ("every ResponseType verb dispatched in agent + engine; "
+                 "broadcast keys via named constants (legacy tolerance)")
+
+    def check_project(self, project: Project) -> Iterator[Finding]:
+        msg_mods = project.modules_matching(MESSAGE_MODULE)
+        if not msg_mods:
+            return  # not analyzing the elastic plane (e.g. rule fixtures)
+        msg = msg_mods[0]
+        members = _enum_members(msg, "ResponseType")
+        if not members:
+            return
+
+        agent_mods = project.modules_matching(AGENT_MODULE)
+        if agent_mods:
+            dispatched = _attr_accesses(agent_mods[0], "ResponseType")
+            for name, node in members.items():
+                if name not in dispatched:
+                    yield msg.finding(
+                        self, node,
+                        f"ResponseType.{name} has no dispatch arm in "
+                        f"{agent_mods[0].relpath} (response_loop must "
+                        f"handle or explicitly absorb every verb)")
+
+        engine_mods = project.modules_matching(ENGINE_MODULE)
+        if engine_mods:
+            kinds = _class_strings(engine_mods[0], ENGINE_LISTENER_CLASS)
+            for name, node in members.items():
+                expected = VERB_TO_ENGINE_KIND.get(name)
+                if expected is not None:
+                    if kinds and expected not in kinds:
+                        yield msg.finding(
+                            self, node,
+                            f"ResponseType.{name} maps to pipe kind "
+                            f"'{expected}' but {ENGINE_LISTENER_CLASS} in "
+                            f"{engine_mods[0].relpath} never dispatches it")
+                elif name not in CONTROL_PLANE_ONLY:
+                    yield msg.finding(
+                        self, node,
+                        f"ResponseType.{name} is a new verb: add an engine "
+                        f"dispatch arm and extend VERB_TO_ENGINE_KIND (or "
+                        f"CONTROL_PLANE_ONLY) in analysis/rules/protocol.py "
+                        f"— legacy receivers must have a declared fallback")
+
+        for master in project.modules_matching(MASTER_MODULE):
+            yield from self._check_broadcast_keys(master)
+
+    def _check_broadcast_keys(self, master: ModuleInfo) -> Iterator[Finding]:
+        for fns in astutil.functions_of(master.tree).values():
+            for fn in fns:
+                if not fn.name.startswith("_broadcast"):
+                    continue
+                for node in ast.walk(fn):
+                    # payload = {"literal": ...} — literal keys beyond the
+                    # core set must be named constants.
+                    if isinstance(node, ast.Assign) \
+                            and isinstance(node.value, ast.Dict) \
+                            and any(isinstance(t, ast.Name)
+                                    and t.id == "payload"
+                                    for t in node.targets):
+                        for key in node.value.keys:
+                            if isinstance(key, ast.Constant) \
+                                    and isinstance(key.value, str) \
+                                    and key.value not in CORE_BROADCAST_KEYS:
+                                yield master.finding(
+                                    self, key,
+                                    f"broadcast payload key "
+                                    f"'{key.value}' is a raw literal; new "
+                                    f"keys ride named constants (the "
+                                    f"TRACE_KEY/DECISION_KEY pattern) so "
+                                    f"legacy receivers skip them knowingly")
+                    # payload["literal"] = ... — same contract.
+                    elif isinstance(node, ast.Assign) and any(
+                            isinstance(t, ast.Subscript)
+                            and isinstance(t.value, ast.Name)
+                            and t.value.id == "payload"
+                            and isinstance(t.slice, ast.Constant)
+                            and isinstance(t.slice.value, str)
+                            and t.slice.value not in CORE_BROADCAST_KEYS
+                            for t in node.targets):
+                        yield master.finding(
+                            self, node,
+                            "broadcast payload key assigned from a raw "
+                            "string literal; use a named constant (the "
+                            "TRACE_KEY/DECISION_KEY pattern)")

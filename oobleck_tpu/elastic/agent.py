@@ -463,6 +463,12 @@ class OobleckAgent:
                     self.worker.pipe.send(
                         {"kind": "dist_info", "dist_info": msg["dist_info"]}
                     )
+            elif kind == ResponseType.FAILURE.value:
+                # Explicit absorb: a FAILURE reply to an in-band request
+                # (e.g. a forward the master refused) is diagnostic, not
+                # fatal — log it so the verb never vanishes silently.
+                logger.warning("master replied FAILURE: %s",
+                               msg.get("error", msg))
 
     async def on_reconfiguration(self, lost_ip: str,
                                  degrade: bool = False,

@@ -142,7 +142,9 @@ class SSHLauncher:
             f"--agent-ip {ip}"
         )
         path = self._log_path(ip, args)
-        logf = open(path, "ab")
+        # open() can block on slow/remote filesystems (the log dir may be
+        # NFS); never stall the heartbeat loop for it.
+        logf = await asyncio.to_thread(open, path, "ab")
         try:
             proc = await asyncio.create_subprocess_exec(
                 "ssh", "-p", str(self.node_port), target, cmd,
@@ -432,7 +434,7 @@ class OobleckMasterDaemon:
             return
         try:
             args = OobleckArguments.from_dict(msg["args"])
-        except Exception as e:
+        except Exception as e:  # noqa: BLE001 — any parse failure becomes FAILURE
             await send_response(writer, ResponseType.FAILURE, {"error": str(e)})
             return
         if len(args.dist.node_ips) > MAX_NUM_HOSTS:

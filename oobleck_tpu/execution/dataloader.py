@@ -27,6 +27,8 @@ from enum import Enum
 
 import numpy as np
 
+from oobleck_tpu.utils import background
+
 
 class LoaderType(Enum):
     TRAINING = 0
@@ -276,9 +278,31 @@ class DeviceStager:
 
     def _grab(self):
         batch = self.loader.next_batch()
-        placed = self._place_fn(batch)
+        # place_fn dispatches device_puts from the stager thread; the
+        # process-wide fence (utils/background.py) keeps that from
+        # interleaving with the train step's own XLA dispatch — the same
+        # runtime race as the PR-9 precompile x checkpoint flake.
+        with background.device_work("stager_place"):
+            placed = self._place_fn(batch)
         return batch, placed, (self.loader.num_iterations_done,
                                self.loader.epoch)
+
+    def wait_staged(self, timeout: float | None = None) -> None:
+        """Block until the in-flight grab (if any) finishes placing.
+
+        The train loop MUST call this before taking the step's
+        device_work fence: _grab places under its own fence hold, so
+        waiting on its future while the caller already holds the fence
+        deadlocks (stager blocked on the fence, caller blocked on the
+        future). Exceptions are deliberately not raised here — the
+        consumption points (next_placed / advance) re-wait on the same
+        future and surface them where they are handled today.
+        """
+        from concurrent.futures import wait as futures_wait
+
+        if self._fut is None:
+            self._fut = self._pool.submit(self._grab)
+        futures_wait([self._fut], timeout=timeout)
 
     def next_placed(self):
         """(host_batch, placed) for the next iteration; kicks off staging

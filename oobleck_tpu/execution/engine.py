@@ -478,6 +478,9 @@ class MultiHostDataParallelEngine:
         for i, pipe in enumerate(self.pipelines):
             if pipe.pipeline_id in local_losses:
                 loss, weight = local_losses[pipe.pipeline_id]
+                # The multihost loss rides the host-side group_sum;
+                # _defer_losses() documents this path cannot defer.
+                # oobleck: allow[OBL002] -- multihost loss allreduce
                 loss_vec[2 * i] = float(loss) * weight
                 loss_vec[2 * i + 1] = weight
         tail = self.comm.group_sum(
@@ -899,8 +902,10 @@ class OobleckEngine:
             vec.extend(v for _, v in sorted(p.allreduce_across_hosts.items()))
             for v in (p.mem_params, p.mem_activation):
                 ints.extend([v & 0x7FFFFFFF, v >> 31])  # lo, hi (< 2**62)
-        arr = np.asarray(vec, np.float32)
-        iarr = np.asarray(ints, np.int32)
+        # Profile broadcast happens once per reconfiguration, off the step
+        # loop; the inputs are host floats, not device buffers.
+        arr = np.asarray(vec, np.float32)  # oobleck: allow[OBL002] -- cold reconfigure path
+        iarr = np.asarray(ints, np.int32)  # oobleck: allow[OBL002] -- cold reconfigure path
         if self.comm.process_index != 0:
             arr = np.zeros_like(arr)
             iarr = np.zeros_like(iarr)
@@ -981,6 +986,7 @@ class OobleckEngine:
         flat = self.comm.group_sum(flat, flat.shape[0], range(P))[1:]
         by_size = {
             nbytes: {
+                # oobleck: allow[OBL002] -- one-shot startup microbenchmark
                 n: float(flat[i * (P - 1) + (n - 2)])
                 for n in range(2, P + 1)
             }
@@ -1042,6 +1048,7 @@ class OobleckEngine:
             for n in range(2, P + 1):
                 if str(n) not in row:
                     return None
+                # oobleck: allow[OBL002] -- parses JSON floats, no device value
                 out[(p.mem_params, n)] = float(row[str(n)])
         return out
 
@@ -1483,6 +1490,16 @@ class OobleckEngine:
         return (self.args.execution.loss_readback_every > 1
                 and not self.multihost)
 
+    def _wait_staged_inputs(self) -> None:
+        """Pre-fence handshake with the input stagers: let every
+        in-flight DeviceStager grab finish placing before the train
+        thread takes the step's device_work fence (the stager needs the
+        fence to place, so waiting on its future while holding the fence
+        is a deadlock)."""
+        for dl in self.dataloaders:
+            if isinstance(dl, DeviceStager):
+                dl.wait_staged()
+
     def _staged_batch(self, dl):
         """(host_batch, placed_or_None) from a loader, observing the input
         wait when a DeviceStager fronted it."""
@@ -1711,18 +1728,22 @@ class OobleckEngine:
             return
         if max_steps is None:
             max_steps = self.args.job.steps
-        for step_i, pending in self._pending_losses:
-            try:
-                val = pending.resolve()
-            except Exception as e:  # backing buffers gone (reconfig)
-                logger.warning(
-                    "step %d loss unavailable (deferred readback: %s)",
-                    step_i, e,
-                )
-                continue
-            self.loss_history.append((step_i, val))
-            self._m_loss.set(val)
-            logger.info("step %d/%d loss %.4f", step_i, max_steps, val)
+        # The readbacks are device work: fence them so they can't
+        # interleave with a stager placing the next batch (same runtime
+        # race class as the precompile x checkpoint flake).
+        with background.device_work("loss_drain"):
+            for step_i, pending in self._pending_losses:
+                try:
+                    val = pending.resolve()
+                except Exception as e:  # backing buffers gone (reconfig)
+                    logger.warning(
+                        "step %d loss unavailable (deferred readback: %s)",
+                        step_i, e,
+                    )
+                    continue
+                self.loss_history.append((step_i, val))
+                self._m_loss.set(val)
+                logger.info("step %d/%d loss %.4f", step_i, max_steps, val)
         self._pending_losses.clear()
 
     def _commit_incident(self) -> None:
@@ -1820,10 +1841,14 @@ class OobleckEngine:
                 # exactly one step boundary.
                 chaos().barrier("step_start", ip=self.agent_ip)
                 # Fence the step dispatch against background XLA work
-                # (recovery precompiles, mirror device_get) — see
-                # utils/background.py. t0 sits inside the fence so step_s
-                # measures the step, not lock contention (the wait is
-                # flight-recorded separately as background_work_wait).
+                # (recovery precompiles, mirror device_get, input staging)
+                # — see utils/background.py. The stagers place under their
+                # own fence hold, so the in-flight grab must finish BEFORE
+                # we take the fence; waiting inside it would deadlock.
+                # t0 sits inside the fence so step_s measures the step,
+                # not lock contention (the wait is flight-recorded
+                # separately as background_work_wait).
+                self._wait_staged_inputs()
                 with background.device_work("train_step"):
                     t0 = time.perf_counter()
                     loss = self._train_step()
@@ -2301,7 +2326,7 @@ class OobleckEngine:
                     step, self._MAX_MIRROR_STEP,
                 )
                 step = self._MAX_MIRROR_STEP
-            have = np.asarray(local["have"], bool)
+            have = np.asarray(local["have"], bool)  # oobleck: allow[OBL002] -- recovery path, host mirror
         # Round 0: the global step S = min over survivors' mirror steps.
         svec = np.full(1, INF, np.float32)
         if local is not None:
@@ -2343,6 +2368,7 @@ class OobleckEngine:
         bufs = {dt: np.zeros(layout.lengths[dt], dt)
                 for dt in layout.dtypes}
         if local is not None:
+            # oobleck: allow[OBL002] -- recovery path, host mirror buffers
             raw = {dt: np.asarray(local[f"buf_{dt.name}"]).view(dt)
                    for dt in layout.dtypes}
             for i, li in enumerate(layout.layers):
@@ -2531,11 +2557,14 @@ class OobleckEngine:
         self._eval_state = (samplers[0].num_iterations_done, samplers[0].epoch)
         if self.multihost:
             total = self.comm.group_sum(
+                # oobleck: allow[OBL002] -- eval sweep, off the step loop
                 np.asarray([loss_sum, weight_sum, correct_sum, count_sum],
                            np.float32), 4,
                 range(self.comm.process_count),
             )
+            # oobleck: allow[OBL002] -- eval sweep, off the step loop
             loss_sum, weight_sum = float(total[0]), float(total[1])
+            # oobleck: allow[OBL002] -- eval sweep, off the step loop
             correct_sum, count_sum = float(total[2]), float(total[3])
         mean_loss = loss_sum / weight_sum
         # Task metric alongside the loss (reference builds accuracy via

@@ -707,8 +707,11 @@ class PipelineInstance:
     def _as_batch_dict(batch) -> dict[str, np.ndarray]:
         """Accept legacy [num_mb, mb, seq] token arrays or batch dicts."""
         if isinstance(batch, dict):
+            # Loader output is already host numpy; asarray is shape
+            # normalization, not a device readback.
+            # oobleck: allow[OBL002] -- host batch normalization
             return {k: np.asarray(v) for k, v in batch.items()}
-        return {"input_ids": np.asarray(batch)}
+        return {"input_ids": np.asarray(batch)}  # oobleck: allow[OBL002] -- host batch normalization
 
     def _place_batch(self, batch: dict[str, np.ndarray]):
         """Per-microbatch batch placement onto every stage that reads it
@@ -891,10 +894,12 @@ class PipelineInstance:
                 x = None if is_first else acts[key]
                 mb = stage_batch[m] if stage_batch is not None else None
                 if self.sync_op_timing and x is not None:
+                    # oobleck: allow[OBL002] -- opt-in per-op profiling mode
                     jax.block_until_ready(x)  # exclude upstream wait
                 t0 = time.perf_counter()
                 out = st.fwd[c](chunk_params(st, c), x, mb)
                 if self.sync_op_timing:
+                    # oobleck: allow[OBL002] -- opt-in per-op profiling mode
                     jax.block_until_ready(out)
                 record_op(ins.stage, c, "f", time.perf_counter() - t0)
                 stash[key] = x
@@ -925,6 +930,7 @@ class PipelineInstance:
                 if self.sync_op_timing:
                     dy_wait = gacts.get(key)
                     if dy_wait is not None:
+                        # oobleck: allow[OBL002] -- opt-in per-op profiling mode
                         jax.block_until_ready(dy_wait)
                 t0 = time.perf_counter()
                 if is_last:
@@ -933,6 +939,7 @@ class PipelineInstance:
                     dy = gacts.pop(key)
                     stage_grads, dx = st.bwd[c](chunk_params(st, c), x, mb, dy)
                 if self.sync_op_timing:
+                    # oobleck: allow[OBL002] -- opt-in per-op profiling mode
                     jax.block_until_ready(stage_grads)
                 record_op(ins.stage, c, "b", time.perf_counter() - t0)
                 accumulate(st.chunks[c], stage_grads)
@@ -1012,6 +1019,7 @@ class PipelineInstance:
                         x = None
         self.last_eval_metrics = (
             None if count is None
+            # oobleck: allow[OBL002] -- eval step, off the train loop
             else (float(correct), float(count))
         )
         if not losses:

@@ -316,7 +316,7 @@ def build_train_step(model, mesh, *, num_microbatches: int, optimizer=None,
         on a background thread (the DeviceStager), so by the time the
         train loop calls the step the inputs are already in flight to the
         devices."""
-        tokens = np.asarray(tokens)
+        tokens = np.asarray(tokens)  # oobleck: allow[OBL002] -- input is host memory already
         b, seq = tokens.shape
         assert b % num_mb == 0, f"batch {b} not divisible by {num_mb} microbatches"
         assert seq % sp == 0, f"seq {seq} not divisible by seq-parallel {sp}"

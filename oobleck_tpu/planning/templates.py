@@ -238,7 +238,7 @@ class TemplateGenerator:
                 return _native.create_pipeline_templates(
                     profiles, num_hosts, chips_per_host
                 )
-            except Exception:
+            except Exception:  # noqa: BLE001 — auto mode falls back to python
                 if self.engine == "native":
                     raise
         return _python_create_templates(profiles, num_hosts, chips_per_host,

@@ -68,6 +68,9 @@ def measured_latency(mechanism: str, registry=None) -> float | None:
     reg = registry or metrics.registry()
     total = count = 0.0
     for name in _LATENCY_HISTOGRAMS:
+        # Reads families registered (literally) elsewhere; the loop
+        # variable is what makes the name dynamic here.
+        # oobleck: allow[OBL005] -- iterates the registered name list
         for s in reg.histogram(name, "").series():
             if s["labels"].get("mechanism") == mechanism and s["count"]:
                 total += s["sum"]

@@ -26,7 +26,7 @@ import time
 import numpy as np
 
 from oobleck_tpu.obs import spans
-from oobleck_tpu.utils import metrics
+from oobleck_tpu.utils import background, metrics
 from oobleck_tpu.utils.metrics import SERVE_LATENCY_BUCKETS
 
 logger = logging.getLogger("oobleck.serve")
@@ -237,7 +237,11 @@ class ContinuousBatcher:
             return
         step, params = pending
         t0 = time.perf_counter()
-        self.engine.set_params(params, step)
+        # The swap runs on the scheduler thread while the reload watcher
+        # may be staging the NEXT checkpoint — fence the device call
+        # (utils/background.py) so their XLA dispatch cannot interleave.
+        with background.device_work("serve_swap"):
+            self.engine.set_params(params, step)
         pause = time.perf_counter() - t0
         self.m_reloads.inc()
         self.m_reload_pause.observe(pause)
@@ -265,7 +269,8 @@ class ContinuousBatcher:
                 self._finish(req, "deadline")
                 continue
             req.t_admit_wall = time.time()
-            logits = self.engine.prefill(req.tokens, i)
+            with background.device_work("serve_prefill"):
+                logits = self.engine.prefill(req.tokens, i)
             req.t_prefill_wall = time.time()
             now = time.monotonic()
             token = self._sample(logits, req.temperature)
@@ -276,7 +281,8 @@ class ContinuousBatcher:
 
     def _decode_step(self) -> None:
         t0 = time.perf_counter()
-        logits = self.engine.decode(self._token, self._pos)
+        with background.device_work("serve_decode"):
+            logits = self.engine.decode(self._token, self._pos)
         self.m_step.observe(time.perf_counter() - t0)
         now = time.monotonic()
         for i, req in enumerate(self._slots):
@@ -308,7 +314,7 @@ class ContinuousBatcher:
                 else:
                     time.sleep(self._idle_sleep)
                 self._update_gauges()
-            except Exception:
+            except Exception:  # noqa: BLE001
                 # A scheduler death would hang every waiting client; fail
                 # the in-flight requests and keep serving.
                 logger.exception("batcher iteration failed")

@@ -29,7 +29,7 @@ import numpy as np
 
 from oobleck_tpu.ckpt import manifest as mf
 from oobleck_tpu.ckpt import restore
-from oobleck_tpu.utils import metrics
+from oobleck_tpu.utils import background, metrics
 from oobleck_tpu.utils.chaos import chaos
 
 logger = logging.getLogger("oobleck.serve")
@@ -124,7 +124,7 @@ class CheckpointWatcher:
         while not self._stop.wait(self.poll_secs):
             try:
                 self.poll_once()
-            except Exception:
+            except Exception:  # noqa: BLE001
                 # The watcher must outlive any single bad poll: serving
                 # the current weights beats dying on a reload error.
                 logger.exception("reload poll failed")
@@ -152,7 +152,11 @@ class CheckpointWatcher:
                 self.m_failures.inc()
                 continue
             params = params_from_payload(self.model, payload)
-            staged = self.engine.stage_params(params)
+            # Staging device_puts run on the watcher thread while the
+            # batcher decodes — fence them (utils/background.py) so the
+            # two can't interleave inside the XLA runtime.
+            with background.device_work("serve_stage"):
+                staged = self.engine.stage_params(params)
             self.batcher.post_swap(step, staged)
             self.current_step = step
             self.m_step.set(step)

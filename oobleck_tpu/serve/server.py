@@ -99,7 +99,7 @@ class ServeHTTPServer:
                             "text/plain; version=0.0.4; charset=utf-8")
                     else:
                         self.send_error(404)
-                except Exception:  # the endpoint must never kill the server
+                except Exception:  # noqa: BLE001 — endpoint must never kill the server
                     logger.exception("serve GET failed")
                     self.send_error(500)
 
@@ -117,7 +117,7 @@ class ServeHTTPServer:
                     except ValueError as e:
                         code, payload = 400, {"error": str(e)}
                     self._reply(code, payload)
-                except Exception:
+                except Exception:  # noqa: BLE001 — endpoint must never kill the server
                     logger.exception("serve POST failed")
                     self.send_error(500)
 

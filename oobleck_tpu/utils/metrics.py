@@ -35,6 +35,32 @@ logger = logging.getLogger(__name__)
 ENV_METRICS_DIR = "OOBLECK_METRICS_DIR"
 ENV_METRICS_PORT = "OOBLECK_METRICS_PORT"
 ENV_FLIGHT_CAPACITY = "OOBLECK_FLIGHT_CAPACITY"
+ENV_STRICT_REGISTRY = "OOBLECK_STRICT_REGISTRY"
+
+
+def _strict_registry_check(kind: str, name: str) -> None:
+    """Debug/test-run schema enforcement: with OOBLECK_STRICT_REGISTRY=1,
+    a metric family or flight-event kind missing from the generated
+    ``obs/registry.py`` raises instead of minting a silent, never-read
+    parallel series (the OBL005 invariant, enforced at runtime for names
+    lint cannot see). Off by default: tests record ad-hoc event kinds.
+    Fail-open on import problems — the registry module is generated, and
+    a half-built checkout must not take the metrics plane down."""
+    if os.environ.get(ENV_STRICT_REGISTRY, "") not in ("1", "true", "yes"):
+        return
+    try:
+        # Deferred import: obs -> metrics at module load, never the
+        # reverse (registry is leaf, but the package __init__ is not).
+        from oobleck_tpu.obs import registry
+        allowed = (registry.METRIC_FAMILIES if kind == "metric"
+                   else registry.FLIGHT_EVENT_KINDS)
+    except (ImportError, AttributeError):
+        return
+    if name not in allowed:
+        raise ValueError(
+            f"{kind} name {name!r} is not in obs/registry.py — a typo "
+            f"would emit a series nothing reads; regenerate with "
+            f"`make gen-registry` if the name is intentional")
 
 # Step/region wall times: sub-millisecond CPU smoke runs up to multi-second
 # real steps.
@@ -187,6 +213,7 @@ class Registry:
         with self._lock:
             metric = self._metrics.get(name)
             if metric is None:
+                _strict_registry_check("metric", name)
                 metric = cls(name, help_text, **kwargs)
                 self._metrics[name] = metric
             elif not isinstance(metric, cls):
@@ -441,6 +468,7 @@ class FlightRecorder:
         self._seq = 0
 
     def record(self, event: str, **fields) -> None:
+        _strict_registry_check("flight event", event)
         entry = {"t": time.time(), "event": event}
         entry.update(fields)
         with self._lock:
@@ -513,7 +541,7 @@ class MetricsHTTPServer:
                     else:
                         self.send_error(404)
                         return
-                except Exception:  # endpoint must never take the master down
+                except Exception:  # noqa: BLE001 — endpoint must never take the master down
                     logger.exception("metrics http handler failed")
                     self.send_error(500)
                     return

@@ -79,6 +79,7 @@ def mark(event: str, **fields) -> float:
     # not at module top, purely to keep this leaf module import-light.
     from oobleck_tpu.obs import spans as _spans
 
+    # oobleck: allow[OBL005] -- recovery.* span vocabulary is open by design
     _spans.event(f"recovery.{event}", t=t,
                  **{k: v for k, v in fields.items() if v is not None})
     reg = metrics.registry()

@@ -41,6 +41,7 @@ def traced(name: str, **attrs):
     distributed timeline, stitched to whatever trace is current/ambient)."""
     from oobleck_tpu.obs import spans
 
+    # oobleck: allow[OBL005] -- generic helper, the caller owns the name
     with jax.profiler.TraceAnnotation(name), spans.span(name, **attrs):
         yield
 

@@ -1,0 +1,28 @@
+"""Fixture harness for the oobleck-lint tests: write a small source tree
+under tmp_path, run the analyzer over it, return the result."""
+
+from __future__ import annotations
+
+import textwrap
+
+import pytest
+
+from oobleck_tpu.analysis import run_analysis
+
+
+@pytest.fixture
+def analyze(tmp_path):
+    def _run(files: dict[str, str], rules=None, baseline=None):
+        for rel, src in files.items():
+            p = tmp_path / rel
+            p.parent.mkdir(parents=True, exist_ok=True)
+            p.write_text(textwrap.dedent(src))
+        return run_analysis(tmp_path, targets=sorted(files), rules=rules,
+                            baseline=baseline or {})
+
+    _run.root = tmp_path
+    return _run
+
+
+def codes(result) -> list[str]:
+    return [f.rule for f in result.new]

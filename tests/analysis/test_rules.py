@@ -1,0 +1,457 @@
+"""Per-rule fixtures: each of OBL001-OBL006 has a firing case, a
+non-firing case, and (where the mechanism differs) a suppressed case."""
+
+from __future__ import annotations
+
+from tests.analysis.conftest import codes
+
+# --------------------------------------------------------------------------
+# OBL001 — fence discipline
+
+
+FENCED = """\
+    import threading
+    from oobleck_tpu.utils.background import device_work
+
+    def work():
+        with device_work("w"):
+            jax.device_put(x)
+
+    def start():
+        threading.Thread(target=work).start()
+"""
+
+UNFENCED = """\
+    import threading
+
+    def work():
+        jax.device_put(x)
+
+    def start():
+        threading.Thread(target=work).start()
+"""
+
+CALLER_FENCED = """\
+    import threading
+    from oobleck_tpu.utils.background import device_work
+
+    def helper():
+        jax.device_put(x)
+
+    def work():
+        with device_work("w"):
+            helper()
+
+    def start():
+        threading.Thread(target=work).start()
+"""
+
+CALLER_UNFENCED = """\
+    import threading
+
+    def helper():
+        jax.device_put(x)
+
+    def work():
+        helper()
+
+    def start():
+        pool.submit(work)
+"""
+
+NO_THREADS = """\
+    def main():
+        jax.device_put(x)
+"""
+
+
+def test_obl001_fires_on_unfenced_thread_target(analyze):
+    result = analyze({"mod.py": UNFENCED})
+    assert codes(result) == ["OBL001"]
+
+
+def test_obl001_quiet_when_fenced(analyze):
+    assert codes(analyze({"mod.py": FENCED})) == []
+
+
+def test_obl001_fence_propagates_through_call_edges(analyze):
+    assert codes(analyze({"mod.py": CALLER_FENCED})) == []
+
+
+def test_obl001_fires_through_submit_callback_chain(analyze):
+    result = analyze({"mod.py": CALLER_UNFENCED})
+    assert codes(result) == ["OBL001"]
+
+
+def test_obl001_ignores_main_thread_device_calls(analyze):
+    assert codes(analyze({"mod.py": NO_THREADS})) == []
+
+
+# --------------------------------------------------------------------------
+# OBL002 — host-sync leak (only fires in the step-loop modules)
+
+
+HOT = "oobleck_tpu/execution/engine.py"
+
+LEAK = """\
+    def step(loss):
+        return float(loss)
+"""
+
+FUNNELED = """\
+    class DeferredLoss:
+        def resolve(self):
+            return float(self.value)
+
+    def _host_sync(x):
+        return float(x)
+
+    def drain(self, x):
+        self.host_sync_counter += 1
+        return float(x)
+"""
+
+SUPPRESSED = """\
+    def step(loss):
+        return float(loss)  # oobleck: allow[OBL002] -- eval path
+"""
+
+
+def test_obl002_fires_in_hot_module(analyze):
+    assert codes(analyze({HOT: LEAK})) == ["OBL002"]
+
+
+def test_obl002_quiet_outside_hot_modules(analyze):
+    assert codes(analyze({"oobleck_tpu/utils/misc.py": LEAK})) == []
+
+
+def test_obl002_funnel_is_exempt(analyze):
+    assert codes(analyze({HOT: FUNNELED})) == []
+
+
+def test_obl002_inline_suppression(analyze):
+    result = analyze({HOT: SUPPRESSED})
+    assert codes(result) == []
+    assert [f.rule for f in result.suppressed] == ["OBL002"]
+
+
+# --------------------------------------------------------------------------
+# OBL003 — use-after-donation
+
+
+DONATED_VIEW = """\
+    import numpy as np
+
+    step = jit(train_step, donate_argnums=(0,))
+
+    def train(state, batch):
+        new_state = step(state, batch)
+        snap = np.asarray(state)
+        return new_state, snap
+"""
+
+DONATED_COPY = """\
+    import numpy as np
+
+    step = jit(train_step, donate_argnums=(0,))
+
+    def train(state, batch):
+        new_state = step(state, batch)
+        snap = np.asarray(state).copy()
+        return new_state, snap
+"""
+
+NOT_DONATED = """\
+    import numpy as np
+
+    step = jit(train_step)
+
+    def train(state, batch):
+        new_state = step(state, batch)
+        snap = np.asarray(state)
+        return new_state, snap
+"""
+
+DONATED_OTHER_ARG = """\
+    import numpy as np
+
+    step = jit(train_step, donate_argnums=(1,))
+
+    def train(state, batch):
+        new_state = step(state, batch)
+        snap = np.asarray(state)
+        return new_state, snap
+"""
+
+DONATED_ALIAS = """\
+    step = jit(train_step, donate_argnums=(0,))
+
+    def train(state):
+        out = step(state)
+        stale = state
+        return out, stale
+"""
+
+
+def test_obl003_fires_on_asarray_of_donated_arg(analyze):
+    assert codes(analyze({"mod.py": DONATED_VIEW})) == ["OBL003"]
+
+
+def test_obl003_copy_is_the_escape_hatch(analyze):
+    assert codes(analyze({"mod.py": DONATED_COPY})) == []
+
+
+def test_obl003_quiet_without_donation(analyze):
+    assert codes(analyze({"mod.py": NOT_DONATED})) == []
+
+
+def test_obl003_position_sensitive(analyze):
+    assert codes(analyze({"mod.py": DONATED_OTHER_ARG})) == []
+
+
+def test_obl003_fires_on_alias_capture(analyze):
+    assert codes(analyze({"mod.py": DONATED_ALIAS})) == ["OBL003"]
+
+
+# --------------------------------------------------------------------------
+# OBL004 — verb exhaustiveness (cross-file)
+
+
+def _protocol_files(agent_refs: str, engine_strings: str,
+                    members: tuple[str, ...] = ("SUCCESS",
+                                                "RECONFIGURATION"),
+                    master: str = "") -> dict[str, str]:
+    message = "class ResponseType:\n" + "".join(
+        f"    {m} = '{m.lower()}'\n" for m in members)
+    files = {
+        "oobleck_tpu/elastic/message.py": message,
+        "oobleck_tpu/elastic/agent.py": (
+            "from oobleck_tpu.elastic.message import ResponseType\n\n"
+            f"def response_loop(kind):\n    {agent_refs}\n"),
+        "oobleck_tpu/execution/engine.py": (
+            "class ReconfigurationEngine:\n"
+            "    def _listen(self, kind):\n"
+            f"        {engine_strings}\n"),
+    }
+    if master:
+        files["oobleck_tpu/elastic/master.py"] = master
+    return files
+
+
+def test_obl004_fires_on_undispatched_verb(analyze):
+    files = _protocol_files(
+        agent_refs="return kind == ResponseType.SUCCESS.value",
+        engine_strings="return kind == 'reconfigure'")
+    result = analyze(files)
+    assert codes(result) == ["OBL004"]
+    assert "RECONFIGURATION" in result.new[0].message
+
+
+def test_obl004_quiet_when_exhaustive(analyze):
+    files = _protocol_files(
+        agent_refs="return (ResponseType.SUCCESS, "
+                   "ResponseType.RECONFIGURATION)",
+        engine_strings="return kind == 'reconfigure'")
+    assert codes(analyze(files)) == []
+
+
+def test_obl004_fires_on_missing_engine_pipe_kind(analyze):
+    files = _protocol_files(
+        agent_refs="return (ResponseType.SUCCESS, "
+                   "ResponseType.RECONFIGURATION)",
+        engine_strings="return kind == 'something_else'")
+    result = analyze(files)
+    assert codes(result) == ["OBL004"]
+    assert "reconfigure" in result.new[0].message
+
+
+def test_obl004_fires_on_unknown_new_verb(analyze):
+    files = _protocol_files(
+        agent_refs="return (ResponseType.SUCCESS, "
+                   "ResponseType.RECONFIGURATION, ResponseType.TELEPORT)",
+        engine_strings="return kind == 'reconfigure'",
+        members=("SUCCESS", "RECONFIGURATION", "TELEPORT"))
+    result = analyze(files)
+    assert codes(result) == ["OBL004"]
+    assert "new verb" in result.new[0].message
+
+
+def test_obl004_broadcast_payload_literal_key(analyze):
+    files = _protocol_files(
+        agent_refs="return (ResponseType.SUCCESS, "
+                   "ResponseType.RECONFIGURATION)",
+        engine_strings="return kind == 'reconfigure'",
+        master="""\
+            def _broadcast_recovery(ip):
+                payload = {"lost_ip": ip}
+                payload["surprise"] = 1
+                return payload
+        """)
+    result = analyze(files)
+    assert codes(result) == ["OBL004"]
+    assert "named constant" in result.new[0].message
+
+
+def test_obl004_broadcast_named_constant_ok(analyze):
+    files = _protocol_files(
+        agent_refs="return (ResponseType.SUCCESS, "
+                   "ResponseType.RECONFIGURATION)",
+        engine_strings="return kind == 'reconfigure'",
+        master="""\
+            TRACE_KEY = "trace"
+
+            def _broadcast_recovery(ip, ctx):
+                payload = {"lost_ip": ip}
+                payload[TRACE_KEY] = ctx
+                return payload
+        """)
+    assert codes(analyze(files)) == []
+
+
+# --------------------------------------------------------------------------
+# OBL005 — registry names (cross-file, needs obs/registry.py)
+
+
+REGISTRY = """\
+    METRIC_FAMILIES = frozenset({
+        "oobleck_known_total",
+    })
+
+    FLIGHT_EVENT_KINDS = frozenset({
+        "known_event",
+    })
+
+    SPAN_NAMES = frozenset({
+        "known.span",
+    })
+"""
+
+
+def _registry_files(user_src: str) -> dict[str, str]:
+    return {
+        "oobleck_tpu/obs/registry.py": REGISTRY,
+        "oobleck_tpu/user.py": user_src,
+    }
+
+
+def test_obl005_quiet_on_registered_names(analyze):
+    files = _registry_files("""\
+        from oobleck_tpu.utils import metrics
+        from oobleck_tpu.obs import spans
+
+        def f():
+            metrics.registry().counter("oobleck_known_total").inc()
+            metrics.flight_recorder().record("known_event", step=1)
+            with spans.span("known.span"):
+                pass
+    """)
+    assert codes(analyze(files)) == []
+
+
+def test_obl005_fires_on_unregistered_metric(analyze):
+    files = _registry_files("""\
+        from oobleck_tpu.utils import metrics
+
+        def f():
+            metrics.registry().counter("oobleck_typo_total").inc()
+    """)
+    result = analyze(files)
+    assert codes(result) == ["OBL005"]
+    assert "oobleck_typo_total" in result.new[0].message
+
+
+def test_obl005_fires_on_unregistered_flight_event_via_var(analyze):
+    files = _registry_files("""\
+        from oobleck_tpu.utils import metrics
+
+        def f():
+            fr = metrics.flight_recorder()
+            fr.record("unknwon_event", step=1)
+    """)
+    assert codes(analyze(files)) == ["OBL005"]
+
+
+def test_obl005_flags_dynamic_names(analyze):
+    files = _registry_files("""\
+        from oobleck_tpu.utils import metrics
+
+        def f(name):
+            metrics.registry().counter(name).inc()
+    """)
+    result = analyze(files)
+    assert codes(result) == ["OBL005"]
+    assert "dynamic" in result.new[0].message
+
+
+def test_obl005_dynamic_name_suppressible(analyze):
+    files = _registry_files("""\
+        from oobleck_tpu.utils import metrics
+
+        def f(name):
+            # oobleck: allow[OBL005] -- open vocabulary by design
+            metrics.registry().counter(name).inc()
+    """)
+    result = analyze(files)
+    assert codes(result) == []
+    assert [f.rule for f in result.suppressed] == ["OBL005"]
+
+
+def test_obl005_quiet_without_registry_module(analyze):
+    assert codes(analyze({"oobleck_tpu/user.py": """\
+        from oobleck_tpu.utils import metrics
+
+        def f():
+            metrics.registry().counter("anything_goes").inc()
+    """})) == []
+
+
+# --------------------------------------------------------------------------
+# OBL006 — blocking in async (scoped to elastic/master.py)
+
+
+MASTER = "oobleck_tpu/elastic/master.py"
+
+BLOCKING = """\
+    import time
+
+    async def heartbeat_loop():
+        time.sleep(1.0)
+"""
+
+TO_THREAD = """\
+    import asyncio
+    import time
+
+    async def heartbeat_loop():
+        await asyncio.to_thread(time.sleep, 1.0)
+        logf = await asyncio.to_thread(open, "x", "ab")
+"""
+
+NESTED_DEF = """\
+    import asyncio
+    import time
+
+    async def launch():
+        def slow():
+            time.sleep(1.0)
+            return open("x", "rb")
+        await asyncio.to_thread(slow)
+"""
+
+
+def test_obl006_fires_on_blocking_sleep(analyze):
+    result = analyze({MASTER: BLOCKING})
+    assert codes(result) == ["OBL006"]
+    assert "time.sleep()" in result.new[0].message
+
+
+def test_obl006_to_thread_is_the_escape_hatch(analyze):
+    assert codes(analyze({MASTER: TO_THREAD})) == []
+
+
+def test_obl006_nested_defs_not_flagged(analyze):
+    assert codes(analyze({MASTER: NESTED_DEF})) == []
+
+
+def test_obl006_scoped_to_master_module(analyze):
+    assert codes(analyze({"oobleck_tpu/elastic/other.py": BLOCKING})) == []
