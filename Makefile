@@ -2,7 +2,7 @@
 
 PY ?= python
 
-.PHONY: lint analyze gen-registry test test-slow tier1 bench bench-diff trace-report ckpt-bench serve-bench pipeline-bench degrade-bench policy-bench
+.PHONY: lint analyze gen-registry test test-slow tier1 bench bench-diff trace-report ckpt-bench serve-bench pipeline-bench degrade-bench policy-bench sim-bench
 
 # Lint = the project-native analyzer (always available, stdlib-only)
 # plus ruff (config in pyproject.toml). Ruff degrades to a skip when not
@@ -85,6 +85,14 @@ degrade-bench:
 	JAX_PLATFORMS=cpu OOBLECK_METRICS_DIR= \
 		XLA_FLAGS="--xla_force_host_platform_device_count=4" \
 		$(PY) -m oobleck_tpu.degrade.bench
+
+# Simulated-SLO bench: every scenario family at 64 hosts plus the
+# 1024-host churn storm, with an in-run determinism check (also under
+# bench.py's "sim" key, diffed by bench --diff). Jax-free, CPU-only,
+# bounded well under a minute.
+sim-bench:
+	JAX_PLATFORMS=cpu OOBLECK_METRICS_DIR= \
+		$(PY) -m oobleck_tpu.sim.bench
 
 # Adaptive recovery policy vs each forced mechanism under scripted churn
 # (single-host loss + correlated double loss). 8 virtual devices: 4 hosts.

@@ -591,6 +591,37 @@ def _cpu_proxy_env() -> dict:
     }
 
 
+SIM_BENCH_TIMEOUT_S = 120
+
+
+def _sim_summary() -> dict:
+    """Simulated-SLO bench (oobleck_tpu/sim/bench.py): the scenario suite
+    plus its in-run determinism gate, in a throwaway CPU subprocess. The
+    simulator is jax-free, but a subprocess keeps the hermetic-registry
+    guarantee airtight — nothing it records can leak into this process's
+    metrics sink or vice versa."""
+    env = dict(os.environ)
+    env.update({"JAX_PLATFORMS": "cpu", "PALLAS_AXON_POOL_IPS": "",
+                "OOBLECK_METRICS_DIR": ""})
+    env.pop(_INNER_ENV, None)
+    env.pop(_PIPELINE_ENV, None)
+    proc = subprocess.Popen(
+        [sys.executable, "-m", "oobleck_tpu.sim.bench"],
+        stdout=subprocess.PIPE, stderr=subprocess.PIPE, text=True, env=env)
+    try:
+        out, err = proc.communicate(timeout=SIM_BENCH_TIMEOUT_S)
+    except subprocess.TimeoutExpired:
+        proc.kill()
+        return {"error": f"sim bench hung >{SIM_BENCH_TIMEOUT_S}s"}
+    if proc.returncode != 0:
+        tail = (err or "").strip().splitlines()[-1:] or ["no stderr"]
+        return {"error": f"sim bench exit {proc.returncode}: {tail[0][:160]}"}
+    try:
+        return json.loads(out.strip().splitlines()[-1])
+    except Exception as exc:  # noqa: BLE001
+        return {"error": f"unparseable sim bench output: {exc}"}
+
+
 def _analysis_summary() -> dict:
     """One oobleck-lint run over the tree: rule inventory plus finding
     counts, so the bench line records the static-analysis posture the
@@ -651,6 +682,13 @@ def _emit(result: dict) -> None:
         result["policy"] = _policy_summary()
     except Exception as exc:  # noqa: BLE001 — emit must never fail
         result["policy"] = {"error": f"{type(exc).__name__}: {exc}"}
+    # Simulated SLOs (recovery percentiles, goodput under churn, regret
+    # vs the hindsight oracle, determinism gate): CPU subprocess, jax-
+    # free, bounded, best-effort — see _sim_summary.
+    try:
+        result["sim"] = _sim_summary()
+    except Exception as exc:  # noqa: BLE001 — emit must never fail
+        result["sim"] = {"error": f"{type(exc).__name__}: {exc}"}
     # Static-analysis posture (oobleck_tpu/analysis): in-process, cheap.
     # `findings` counts NEW findings — anything nonzero means the tree
     # regressed against the lint gate, so the diff treats it lower-is-
@@ -692,9 +730,9 @@ DIFF_THRESHOLD = 0.05
 # substring would swallow "_sec"/"_speedup" and invert the headline
 # throughput keys, so unit suffixes are matched as suffixes only.
 _HIGHER_BETTER = ("per_sec", "per_second", "speedup", "retention",
-                  "throughput")
+                  "throughput", "goodput", "agreement")
 _LOWER_BETTER = ("latency", "seconds", "ttft", "pause", "bubble", "stall",
-                 "p50", "p90", "p99", "findings", "parse_errors")
+                 "p50", "p90", "p99", "findings", "parse_errors", "regret")
 _LOWER_BETTER_SUFFIXES = ("_s", "_ms")
 
 

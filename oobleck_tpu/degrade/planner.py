@@ -163,17 +163,28 @@ def plan_reroute(report: FailureReport, specs: list[PipelineSpec],
         for i in report.surviving
     }
 
+    # One replay per distinct schedule shape, not per replica: homogeneous
+    # DP fleets (the sim runs this planner at 1024 replicas) share one
+    # op_times dict, and replay_schedule is pure in (S, M, v, durations),
+    # so the memo changes nothing but the wall clock. Scoped to this call:
+    # no cross-call staleness when calibration moves between incidents.
+    memo: dict = {}
+
+    def makespan(spec: PipelineSpec, microbatches: int) -> float:
+        key = (spec.num_stages, microbatches, spec.virtual_stages,
+               id(spec.op_times))
+        if key not in memo:
+            memo[key] = replay_schedule(spec.num_stages, microbatches,
+                                        spec.virtual_stages,
+                                        spec.duration_fn())[0]
+        return memo[key]
+
     # Pre-failure step time: max over ALL replicas (they run concurrently).
     plan.makespan_before = max(
-        replay_schedule(s.num_stages, s.num_microbatches, s.virtual_stages,
-                        s.duration_fn())[0]
-        for s in specs
-    )
+        makespan(s, s.num_microbatches) for s in specs)
     plan.makespan_after = max(
-        replay_schedule(specs[i].num_stages, plan.new_microbatches[i],
-                        specs[i].virtual_stages, specs[i].duration_fn())[0]
-        for i in report.surviving
-    )
+        makespan(specs[i], plan.new_microbatches[i])
+        for i in report.surviving)
     if plan.makespan_before > 0 and plan.slowdown > max_slowdown:
         plan.reason = "exceeds_max_slowdown"
     return plan

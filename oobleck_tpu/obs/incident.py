@@ -40,6 +40,11 @@ from oobleck_tpu.utils import metrics
 
 logger = logging.getLogger("oobleck.obs")
 
+# Version stamped into every committed record. Readers (the sim corpus
+# loader, future forensics tooling) skip-with-warning on versions they do
+# not know rather than misparse them; bump on incompatible shape changes.
+SCHEMA_VERSION = 1
+
 # Canonical mark names, in chain order.
 MARK_ORDER = ("detect", "broadcast", "notified", "apply_start", "apply_end",
               "first_step")
@@ -100,6 +105,7 @@ class IncidentBuilder:
                   if any(m.get("name", "").startswith(p)
                          for p in _METRIC_PREFIXES)]
         rec = {
+            "schema_version": SCHEMA_VERSION,
             "trace_id": self.trace_id,
             "lost_ip": self.lost_ip,
             "cause": self.cause,

@@ -24,7 +24,7 @@ from dataclasses import dataclass, field
 from oobleck_tpu.obs import spans
 from oobleck_tpu.policy.health import HostHealthTracker
 from oobleck_tpu.policy.scorer import cheapest_feasible, score_arms
-from oobleck_tpu.policy.signals import build_arms
+from oobleck_tpu.policy.signals import build_arms, priors_provenance
 from oobleck_tpu.utils import metrics
 
 logger = logging.getLogger("oobleck.policy")
@@ -127,7 +127,8 @@ class PolicyEngine:
     bounded decision log surfaced in /status."""
 
     def __init__(self, *, multihost: bool = False, clock=time.monotonic,
-                 mode: str | None = None):
+                 mode: str | None = None, registry=None,
+                 priors_path: str | None = None):
         if mode is None:
             mode = os.environ.get(ENV_POLICY, "").strip().lower()
         self.mode = mode or MODE_ADAPTIVE
@@ -136,6 +137,12 @@ class PolicyEngine:
                 f"bad {ENV_POLICY}={self.mode!r}: want one of {MODES}")
         self.multihost = multihost
         self.health = HostHealthTracker(clock=clock)
+        # Injectable metrics registry (like the clock): the cluster
+        # simulator runs each scenario on a fresh Registry so measured
+        # history from one run can never leak into the next; production
+        # callers keep the process-global default.
+        self._registry = registry
+        self._priors_path = priors_path
         self._ewma: dict[str, float] = {}
         self._decisions: collections.deque = collections.deque(
             maxlen=MAX_DECISIONS)
@@ -144,7 +151,8 @@ class PolicyEngine:
 
     def observe_failure(self, ip: str, cause: str = "") -> None:
         self.health.record_failure(ip, cause)
-        metrics.registry().gauge(
+        reg = self._registry or metrics.registry()
+        reg.gauge(
             "oobleck_policy_quarantined_hosts",
             "Hosts currently quarantined by the flap detector",
         ).set(len(self.health.quarantined()))
@@ -157,7 +165,8 @@ class PolicyEngine:
         self._ewma[mechanism] = (seconds if prev is None else
                                  (1 - EWMA_ALPHA) * prev
                                  + EWMA_ALPHA * seconds)
-        metrics.registry().histogram(
+        reg = self._registry or metrics.registry()
+        reg.histogram(
             "oobleck_policy_measured_recovery_seconds",
             "Measured recovery latency by mechanism (policy feedback)",
         ).observe(seconds, mechanism=mechanism)
@@ -202,6 +211,8 @@ class PolicyEngine:
                 staleness_steps=staleness_steps,
                 step_seconds=step_seconds,
                 latency_overrides=self._ewma,
+                registry=self._registry,
+                priors_path=self._priors_path,
             )
             mtbfs = [m for m in (self.health.mtbf(ip) for ip in lost_ips)
                      if m is not None]
@@ -255,6 +266,7 @@ class PolicyEngine:
         health = self.health.snapshot()
         return {
             "mode": self.mode,
+            "priors": priors_provenance(self._priors_path),
             "quarantined": health["quarantined"],
             "hosts": health["hosts"],
             "latency_ewma_s": {m: round(v, 6)

@@ -7,13 +7,25 @@ honest about what they knew), its projected post-recovery throughput
 retention, the work a checkpoint restore would replay, and feasibility
 (a reroute around two correlated losses, or a restore with no durable
 checkpoint, is not an option however cheap it looks).
+
+Priors come in two flavors, and every arm records which one it used
+(``prior_source``): the hardcoded PRIOR_LATENCY_S table below, or a
+``learned_priors.json`` fitted from the incident corpus by
+``oobleck_tpu.sim.priors`` and activated via ``$OOBLECK_POLICY_PRIORS``
+(or an explicit ``priors_path``) — so a decision made from fitted priors
+is distinguishable in forensics from one made from the shipped table.
 """
 
 from __future__ import annotations
 
+import json
+import logging
+import os
 from dataclasses import dataclass, field
 
 from oobleck_tpu.utils import metrics
+
+logger = logging.getLogger("oobleck.policy")
 
 # Latency priors (seconds) used until a mechanism has measured history.
 # reroute/reinstantiate-warm come from the degrade bench (~0.56 s / ~0.64 s
@@ -36,6 +48,61 @@ _LATENCY_HISTOGRAMS = (
     "oobleck_policy_measured_recovery_seconds",
 )
 
+# Path to a learned_priors.json fitted from the incident corpus (see
+# oobleck_tpu/sim/priors.py); unset means the hardcoded table above.
+ENV_PRIORS = "OOBLECK_POLICY_PRIORS"
+# The priors-file format version this loader understands.
+PRIORS_VERSION = 1
+
+# (path, mtime) -> parsed latency table, so build_arms on the decision hot
+# path never re-reads an unchanged file.
+_priors_cache: dict = {"path": None, "mtime": None, "latency": None}
+
+
+def learned_priors(path: str | None = None) -> tuple[dict, str] | None:
+    """(latency_s table, "learned:<path>") from an explicit ``path`` or
+    ``$OOBLECK_POLICY_PRIORS``; None when unset, unreadable, or of an
+    unknown version (logged once per file change, never raised — a bad
+    priors file must not take down the decision path)."""
+    path = path or os.environ.get(ENV_PRIORS, "").strip() or None
+    if not path:
+        return None
+    try:
+        mtime = os.stat(path).st_mtime
+    except OSError:
+        return None
+    if _priors_cache["path"] == path and _priors_cache["mtime"] == mtime:
+        lat = _priors_cache["latency"]
+        return (lat, f"learned:{path}") if lat else None
+    _priors_cache.update(path=path, mtime=mtime, latency=None)
+    try:
+        with open(path) as f:
+            rec = json.load(f)
+    except (OSError, ValueError) as e:
+        logger.warning("policy: cannot read priors file %s: %s", path, e)
+        return None
+    if not isinstance(rec, dict) or rec.get("version") != PRIORS_VERSION:
+        logger.warning("policy: skipping priors file %s: unknown version %r",
+                       path, rec.get("version") if isinstance(rec, dict)
+                       else type(rec).__name__)
+        return None
+    latency = {k: float(v) for k, v in (rec.get("latency_s") or {}).items()
+               if isinstance(v, (int, float)) and v > 0}
+    if not latency:
+        logger.warning("policy: priors file %s has no usable latency_s", path)
+        return None
+    _priors_cache["latency"] = latency
+    return latency, f"learned:{path}"
+
+
+def priors_provenance(path: str | None = None) -> dict:
+    """Which priors the next decision would fall back to — surfaced in the
+    /status policy block so fitted-priors deployments are visible."""
+    lp = learned_priors(path)
+    if lp is not None:
+        return {"source": lp[1], "mechanisms": sorted(lp[0])}
+    return {"source": "hardcoded", "mechanisms": sorted(PRIOR_LATENCY_S)}
+
 
 @dataclass
 class ArmSignals:
@@ -50,11 +117,13 @@ class ArmSignals:
     in_memory: bool = True         # state survives in RAM -> churn risk
     feasible: bool = True
     reason: str = ""               # why infeasible ("" when feasible)
+    prior_source: str = ""         # "hardcoded" | "learned:<path>" | ""
 
     def as_record(self) -> dict:
         return {
             "latency_s": round(self.latency_s, 6),
             "latency_source": self.latency_source,
+            "prior_source": self.prior_source,
             "retention": round(self.retention, 6),
             "lost_work_s": round(self.lost_work_s, 6),
             "feasible": self.feasible,
@@ -78,13 +147,20 @@ def measured_latency(mechanism: str, registry=None) -> float | None:
     return total / count if count else None
 
 
-def _latency(mechanism: str, prior_key: str, overrides, registry):
+def _latency(mechanism: str, prior_key: str, overrides, registry,
+             priors_path=None):
+    """(seconds, latency_source, prior_source). Measurement always wins
+    (EWMA override, then histogram history); the prior fallback prefers a
+    corpus-fitted table over the hardcoded one and names which it used."""
     if overrides and mechanism in overrides:
-        return float(overrides[mechanism]), "measured"
+        return float(overrides[mechanism]), "measured", ""
     m = measured_latency(mechanism, registry)
     if m is not None:
-        return m, "measured"
-    return PRIOR_LATENCY_S[prior_key], "prior"
+        return m, "measured", ""
+    lp = learned_priors(priors_path)
+    if lp is not None and prior_key in lp[0]:
+        return lp[0][prior_key], "prior", lp[1]
+    return PRIOR_LATENCY_S[prior_key], "prior", "hardcoded"
 
 
 def build_arms(*,
@@ -99,7 +175,8 @@ def build_arms(*,
                staleness_steps: float | None = None,
                step_seconds: float | None = None,
                latency_overrides: dict[str, float] | None = None,
-               registry=None) -> dict[str, ArmSignals]:
+               registry=None,
+               priors_path: str | None = None) -> dict[str, ArmSignals]:
     """Assemble the three arms for one incident.
 
     staleness_steps is None when there is no durable checkpoint (restore
@@ -119,8 +196,9 @@ def build_arms(*,
         retention=(reroute_retention if reroute_retention is not None
                    else survivor_frac),
     )
-    reroute.latency_s, reroute.latency_source = _latency(
-        "reroute", "reroute", latency_overrides, registry)
+    reroute.latency_s, reroute.latency_source, reroute.prior_source = \
+        _latency("reroute", "reroute", latency_overrides, registry,
+                 priors_path)
     if not degrade_enabled:
         reroute.feasible, reroute.reason = False, "degrade_disabled"
     elif correlated:
@@ -133,10 +211,10 @@ def build_arms(*,
         latency_s=0.0, latency_source="",
         retention=survivor_frac,
     )
-    reinst.latency_s, reinst.latency_source = _latency(
+    reinst.latency_s, reinst.latency_source, reinst.prior_source = _latency(
         "reinstantiate",
         "reinstantiate" if warm_reinstantiate else "reinstantiate_respawn",
-        latency_overrides, registry)
+        latency_overrides, registry, priors_path)
 
     restore = ArmSignals(
         mechanism="restore",
@@ -144,8 +222,9 @@ def build_arms(*,
         retention=survivor_frac,
         in_memory=False,
     )
-    restore.latency_s, restore.latency_source = _latency(
-        "restore", "restore", latency_overrides, registry)
+    restore.latency_s, restore.latency_source, restore.prior_source = \
+        _latency("restore", "restore", latency_overrides, registry,
+                 priors_path)
     if staleness_steps is None:
         restore.feasible, restore.reason = False, "no_durable_checkpoint"
     else:

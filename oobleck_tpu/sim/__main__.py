@@ -1,0 +1,119 @@
+"""CLI for the cluster simulator.
+
+    python -m oobleck_tpu.sim run --scenario churn_storm --hosts 1024 \
+        --seed 42 --duration-s 600 [--priors learned_priors.json]
+    python -m oobleck_tpu.sim fit-priors --corpus $OOBLECK_METRICS_DIR \
+        --out learned_priors.json
+    python -m oobleck_tpu.sim replay --corpus tests/sim/data/degrade_bench
+    python -m oobleck_tpu.sim scenarios
+
+``run`` prints the canonical one-line SLO report (byte-identical for
+equal seed + corpus — pipe two runs through ``diff`` to audit it).
+``fit-priors`` closes the corpus -> policy loop; point
+``$OOBLECK_POLICY_PRIORS`` at the output to activate it. ``replay``
+cross-validates the simulator against recorded measurements.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import sys
+
+
+def _calibrated_op_times(corpus) -> dict:
+    """Per-op calibration from the first recorded incident that carries
+    one (the degrade-bench fixture does); {} -> the planner's documented
+    fwd=1/bwd=2 fallback model."""
+    for inc in corpus.incidents:
+        op_list = inc.attrs.get("op_times")
+        if op_list:
+            return {(int(s), int(c), str(k)): (float(total), int(count))
+                    for s, c, k, total, count in op_list}
+    return {}
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(prog="python -m oobleck_tpu.sim",
+                                 description=__doc__)
+    sub = ap.add_subparsers(dest="cmd", required=True)
+
+    runp = sub.add_parser("run", help="run one scenario, print SLO report")
+    runp.add_argument("--scenario", default="churn_storm")
+    runp.add_argument("--hosts", type=int, default=64)
+    runp.add_argument("--seed", type=int, default=0)
+    runp.add_argument("--duration-s", type=float, default=600.0)
+    runp.add_argument("--chips-per-host", type=int, default=2)
+    runp.add_argument("--hosts-per-pipeline", type=int, default=1)
+    runp.add_argument("--microbatches", type=int, default=8,
+                      help="microbatches per pipeline replica")
+    runp.add_argument("--virtual-stages", type=int, default=1)
+    runp.add_argument("--checkpoint-period-s", type=float, default=300.0)
+    runp.add_argument("--mode", default="adaptive",
+                      help="policy mode (adaptive|reroute|...)")
+    runp.add_argument("--corpus", default=None,
+                      help="trace dir for op-duration calibration")
+    runp.add_argument("--priors", default=None,
+                      help="learned_priors.json to decide with")
+
+    fitp = sub.add_parser("fit-priors",
+                          help="fit latency priors from a trace corpus")
+    fitp.add_argument("--corpus", required=True)
+    fitp.add_argument("--out", required=True)
+    fitp.add_argument("--min-samples", type=int, default=1)
+
+    repp = sub.add_parser("replay",
+                          help="cross-validate sim vs recorded incidents")
+    repp.add_argument("--corpus", required=True)
+
+    sub.add_parser("scenarios", help="list scenario generators")
+
+    args = ap.parse_args(argv)
+
+    from oobleck_tpu.sim import corpus as corpus_mod
+    from oobleck_tpu.sim import priors as priors_mod
+    from oobleck_tpu.sim import slo
+    from oobleck_tpu.sim.cluster import SimCluster, SimConfig
+    from oobleck_tpu.sim.scenarios import GENERATORS, make_scenario
+
+    if args.cmd == "scenarios":
+        print(json.dumps(sorted(GENERATORS)))
+        return 0
+
+    if args.cmd == "fit-priors":
+        corpus = corpus_mod.load_corpus(args.corpus)
+        priors = priors_mod.fit_priors(corpus,
+                                       min_samples=args.min_samples)
+        priors_mod.write_priors(args.out, priors)
+        print(json.dumps({"out": args.out,
+                          "latency_s": priors["latency_s"],
+                          "corpus": corpus.stats()}, sort_keys=True))
+        return 0
+
+    if args.cmd == "replay":
+        corpus = corpus_mod.load_corpus(args.corpus)
+        print(json.dumps(slo.crossval_report(corpus), sort_keys=True))
+        return 0
+
+    op_times = {}
+    if args.corpus:
+        op_times = _calibrated_op_times(corpus_mod.load_corpus(args.corpus))
+    config = SimConfig(
+        hosts=args.hosts,
+        chips_per_host=args.chips_per_host,
+        hosts_per_pipeline=args.hosts_per_pipeline,
+        microbatches_per_pipeline=args.microbatches,
+        virtual_stages=args.virtual_stages,
+        op_times=op_times,
+        checkpoint_period_s=args.checkpoint_period_s,
+        mode=args.mode,
+        priors_path=args.priors)
+    scenario = make_scenario(args.scenario, seed=args.seed,
+                             hosts=args.hosts, duration_s=args.duration_s)
+    run = SimCluster(config, scenario).run()
+    print(slo.render(slo.slo_report(run)))
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

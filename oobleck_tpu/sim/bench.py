@@ -1,0 +1,78 @@
+"""Simulated-SLO bench: the scenario suite the perf gate runs per PR.
+
+Small-but-real: every scenario family at 64 hosts, plus the
+1024-host churn storm the acceptance bar names, plus an in-run
+determinism check (the 64-host storm executed twice from fresh state and
+byte-compared). CPU-only, jax-free, and bounded well under the tier-1
+budget; ``bench.py`` records the output under its ``sim`` key and
+``bench --diff`` compares it round-over-round (goodput/agreement up is
+good, recovery/regret seconds down is good).
+
+Run as ``python -m oobleck_tpu.sim.bench`` (or ``make sim-bench``).
+Prints ONE JSON line on stdout, like every other bench in the repo.
+"""
+
+from __future__ import annotations
+
+import json
+import time
+
+from oobleck_tpu.sim import slo
+from oobleck_tpu.sim.cluster import SimCluster, SimConfig
+from oobleck_tpu.sim.scenarios import make_scenario
+
+# (label, scenario, hosts, duration_s, seed, generator params)
+SUITE = (
+    ("churn_storm_64", "churn_storm", 64, 600.0, 1117, {}),
+    ("rack_loss_64", "correlated_rack_loss", 64, 600.0, 1117, {}),
+    ("preemption_wave_64", "spot_preemption_wave", 64, 600.0, 1117, {}),
+    ("flap_sequence_64", "flap_sequence", 64, 600.0, 1117, {}),
+    ("diurnal_traffic_64", "diurnal_traffic", 64, 1800.0, 1117, {}),
+    ("churn_storm_1024", "churn_storm", 1024, 600.0, 1117,
+     {"mean_interarrival_s": 4.0}),
+)
+
+
+def _one(label: str, name: str, hosts: int, duration_s: float, seed: int,
+         params: dict) -> tuple[dict, str]:
+    scenario = make_scenario(name, seed=seed, hosts=hosts,
+                             duration_s=duration_s, **params)
+    config = SimConfig(hosts=hosts)
+    t0 = time.perf_counter()
+    report = slo.slo_report(SimCluster(config, scenario).run())
+    elapsed = time.perf_counter() - t0
+    summary = {
+        "incidents": report["incidents"],
+        "recovery_p99_s": report["recovery"]["p99_s"],
+        "goodput_ratio": report["goodput_ratio"],
+        "regret_mean_s": report["regret"]["mean_s"],
+        "oracle_agreement": report["regret"]["oracle_agreement"],
+        "elapsed_s": round(elapsed, 3),
+    }
+    return summary, slo.render(report)
+
+
+def measure() -> dict:
+    out: dict = {}
+    t0 = time.perf_counter()
+    renders: dict[str, str] = {}
+    for label, name, hosts, duration_s, seed, params in SUITE:
+        out[label], renders[label] = _one(label, name, hosts, duration_s,
+                                          seed, params)
+    # Determinism gate: the 64-host storm again, from fresh state; the
+    # canonical render must match byte for byte.
+    _, again = _one("churn_storm_64", *SUITE[0][1:])
+    out["determinism"] = {
+        "scenario": "churn_storm_64",
+        "byte_identical": renders["churn_storm_64"] == again,
+    }
+    out["elapsed_s"] = round(time.perf_counter() - t0, 3)
+    return out
+
+
+def main() -> None:
+    print(json.dumps(measure()))
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,379 @@
+"""Event-driven cluster model costed by the REAL recovery code paths.
+
+SimCluster owns no recovery model: every incident runs through the same
+``degrade.classify.classify_failure`` -> ``degrade.planner.plan_reroute``
+(itself ``execution.schedule.replay_schedule`` dependency replay over the
+calibrated op durations) -> ``policy.PolicyEngine.decide`` chain the live
+system runs, with the simulated clock injected where the live system
+injects ``time.monotonic`` and a fresh hermetic ``metrics.Registry`` so
+measured history can never leak between runs. What the simulator adds is
+only what hardware would have provided: a fleet, a scripted failure
+process, and the passage of time.
+
+Time advances through a heapq of (t, seq, ...) events — scenario-scripted
+failures/preemptions/traffic swings plus the repairs and recovery
+completions they cause. Goodput is integrated piecewise-constant:
+delivered = min(relative_rate, demand); recovery windows deliver zero
+(reconfigure blocks the job, as on the real cluster).
+
+Determinism: the only PRNG is ``random.Random(seed)`` (recovery-latency
+jitter — scenario events pre-draw their own randomness), the clock is the
+event queue, and nothing reads wall time; ``run()`` on equal inputs is
+byte-identical.
+"""
+
+from __future__ import annotations
+
+import heapq
+import random
+from dataclasses import dataclass, field
+
+from oobleck_tpu.degrade.classify import classify_failure
+from oobleck_tpu.degrade.planner import PipelineSpec, plan_reroute
+from oobleck_tpu.execution.schedule import replay_schedule
+from oobleck_tpu.policy.engine import PolicyEngine
+from oobleck_tpu.policy.signals import priors_provenance
+from oobleck_tpu.sim.scenarios import Scenario
+from oobleck_tpu.utils import metrics
+
+# Realized recovery latency jitter band around the scored arm's latency
+# (deterministic: drawn from the run's explicit PRNG). Wide enough that
+# the measured-EWMA feedback loop sees non-constant samples.
+JITTER_LO, JITTER_HI = 0.85, 1.15
+
+
+@dataclass
+class SimConfig:
+    """The candidate configuration under test."""
+
+    hosts: int
+    chips_per_host: int = 2
+    hosts_per_pipeline: int = 1
+    microbatches_per_pipeline: int = 8
+    virtual_stages: int = 1
+    op_times: dict = field(default_factory=dict)
+    checkpoint_period_s: float = 300.0   # <= 0: no durable checkpoint
+    max_slowdown: float = 4.0
+    degrade_enabled: bool = True
+    mode: str = "adaptive"
+    priors_path: str | None = None
+
+    @property
+    def stages(self) -> int:
+        return self.hosts_per_pipeline * self.chips_per_host
+
+    def as_record(self) -> dict:
+        return {
+            "hosts": self.hosts,
+            "chips_per_host": self.chips_per_host,
+            "hosts_per_pipeline": self.hosts_per_pipeline,
+            "microbatches_per_pipeline": self.microbatches_per_pipeline,
+            "virtual_stages": self.virtual_stages,
+            "calibrated_ops": len(self.op_times),
+            "checkpoint_period_s": self.checkpoint_period_s,
+            "max_slowdown": self.max_slowdown,
+            "degrade_enabled": self.degrade_enabled,
+            "mode": self.mode,
+            "priors": priors_provenance(self.priors_path),
+        }
+
+
+@dataclass
+class _Pipeline:
+    hosts: list[int]
+    microbatches: int
+
+
+class SimCluster:
+    """One scenario run over one candidate config. Use ``run()``."""
+
+    def __init__(self, config: SimConfig, scenario: Scenario):
+        if scenario.hosts != config.hosts:
+            raise ValueError(f"scenario generated for {scenario.hosts} hosts,"
+                             f" config has {config.hosts}")
+        self.config = config
+        self.scenario = scenario
+        self.now = 0.0
+        self.registry = metrics.Registry()   # hermetic per run
+        self.engine = PolicyEngine(
+            multihost=True, clock=lambda: self.now, mode=config.mode,
+            registry=self.registry, priors_path=config.priors_path)
+        self.rng = random.Random(scenario.seed ^ 0x51A0C1)
+        self.live: set[int] = set(range(config.hosts))
+        self.pipelines: list[_Pipeline] = []
+        n_pipes = config.hosts // config.hosts_per_pipeline
+        for i in range(n_pipes):
+            self.pipelines.append(_Pipeline(
+                hosts=list(range(i * config.hosts_per_pipeline,
+                                 (i + 1) * config.hosts_per_pipeline)),
+                microbatches=config.microbatches_per_pipeline))
+        self._total_microbatches = n_pipes * config.microbatches_per_pipeline
+        self._makespan_cache: dict[tuple, float] = {}
+        self._base_rate = self._rate()
+        self._recovery_until = 0.0
+        # Piecewise-constant goodput integration state.
+        self._demand = 1.0
+        self._last_t = 0.0
+        self._delivered = 0.0
+        self._demand_integral = 0.0
+        self.incidents: list[dict] = []
+        self.lost_work_s = 0.0
+
+    # -- throughput model (real replay, cached by schedule shape) ----------- #
+
+    def _makespan(self, microbatches: int) -> float:
+        key = (self.config.stages, microbatches, self.config.virtual_stages)
+        if key not in self._makespan_cache:
+            spec = self._spec(microbatches)
+            self._makespan_cache[key] = replay_schedule(
+                spec.num_stages, spec.num_microbatches, spec.virtual_stages,
+                spec.duration_fn())[0]
+        return self._makespan_cache[key]
+
+    def _spec(self, microbatches: int) -> PipelineSpec:
+        return PipelineSpec(
+            num_stages=self.config.stages,
+            num_microbatches=microbatches,
+            virtual_stages=self.config.virtual_stages,
+            op_times=self.config.op_times)
+
+    def _rate(self) -> float:
+        """Microbatches per second at the current layout (replicas run
+        concurrently: the step time is the max replica makespan)."""
+        if not self.pipelines:
+            return 0.0
+        makespan = max(self._makespan(p.microbatches) for p in self.pipelines)
+        if makespan <= 0:
+            return 0.0
+        return sum(p.microbatches for p in self.pipelines) / makespan
+
+    def _rate_rel(self) -> float:
+        if self.now < self._recovery_until or self._base_rate <= 0:
+            return 0.0
+        return self._rate() / self._base_rate
+
+    def _step_seconds(self) -> float:
+        if not self.pipelines:
+            return self._makespan(self.config.microbatches_per_pipeline)
+        return max(self._makespan(p.microbatches) for p in self.pipelines)
+
+    # -- bookkeeping --------------------------------------------------------- #
+
+    def _advance(self, t: float) -> None:
+        dt = t - self._last_t
+        if dt > 0:
+            self._delivered += min(self._rate_rel(), self._demand) * dt
+            self._demand_integral += self._demand * dt
+            self._last_t = t
+        self.now = t
+
+    def _ip(self, host: int) -> str:
+        return f"10.{(host >> 16) & 255}.{(host >> 8) & 255}.{host & 255}"
+
+    def _staleness(self) -> tuple[float | None, float]:
+        """(staleness_steps, staleness_seconds) against the periodic
+        durable checkpoint; (None, 0) when checkpoints are off."""
+        period = self.config.checkpoint_period_s
+        if period <= 0:
+            return None, 0.0
+        stale_s = self.now % period
+        step_s = self._step_seconds()
+        return (stale_s / step_s if step_s > 0 else 0.0), stale_s
+
+    def _spares(self) -> list[int]:
+        assigned = {h for p in self.pipelines for h in p.hosts}
+        return sorted(h for h in self.live - assigned
+                      if not self.engine.is_quarantined(self._ip(h)))
+
+    def _rebuild(self) -> None:
+        """Re-instantiate a balanced layout over every usable live host,
+        spreading the global microbatch budget evenly (remainder to the
+        lowest-indexed pipelines, deterministically)."""
+        usable = sorted({h for p in self.pipelines for h in p.hosts}
+                        | set(self._spares()))
+        hpp = self.config.hosts_per_pipeline
+        n = len(usable) // hpp
+        self.pipelines = []
+        if n == 0:
+            return
+        base, rem = divmod(self._total_microbatches, n)
+        for i in range(n):
+            self.pipelines.append(_Pipeline(
+                hosts=usable[i * hpp:(i + 1) * hpp],
+                microbatches=base + (1 if i < rem else 0)))
+
+    # -- the incident -------------------------------------------------------- #
+
+    def _handle_incident(self, events: list) -> None:
+        events = [e for e in events if e.host in self.live]
+        if not events:
+            return
+        lost = [e.host for e in events]
+        proactive = all(e.kind == "preempt" for e in events)
+        cause = events[0].cause
+        lost_ips = [self._ip(h) for h in lost]
+        for e in events:
+            self.engine.observe_failure(self._ip(e.host), cause=e.cause)
+        self.live -= set(lost)
+
+        dead_idx = [i for i, p in enumerate(self.pipelines)
+                    if any(h in p.hosts for h in lost)]
+        if not dead_idx:
+            return  # spare-only loss: no layout change, no recovery stall
+
+        # Real classifier + planner (single-host incidents only: the
+        # policy plane prices correlated losses reroute-infeasible before
+        # any plan could matter, exactly like the live master).
+        retention = None
+        feasible, reason, plan = True, "", None
+        if len(lost) == 1 and self.config.degrade_enabled:
+            ranks = [[h * self.config.chips_per_host + c
+                      for h in p.hosts
+                      for c in range(self.config.chips_per_host)]
+                     for p in self.pipelines]
+            report = classify_failure(lost[0], ranks,
+                                      self.config.chips_per_host)
+            specs = [self._spec(p.microbatches) for p in self.pipelines]
+            plan = plan_reroute(report, specs,
+                                max_slowdown=self.config.max_slowdown)
+            feasible, reason = plan.feasible, plan.reason
+            if plan.feasible:
+                retention = plan.throughput_retention
+
+        staleness_steps, stale_s = self._staleness()
+        survivor_frac = (len(self.live) / (len(self.live) + len(lost))
+                         if self.live else 0.0)
+        decision = self.engine.decide(
+            lost_ips,
+            degrade_enabled=self.config.degrade_enabled,
+            reroute_retention=retention,
+            reroute_feasible=feasible,
+            reroute_reason=reason,
+            survivor_frac=survivor_frac,
+            staleness_steps=staleness_steps,
+            step_seconds=self._step_seconds(),
+            proactive=proactive,
+            cause=cause)
+
+        rate_before = self._rate()
+        if decision.mechanism == "reroute" and plan is not None \
+                and plan.feasible:
+            survivors = [self.pipelines[i] for i in plan.report.surviving]
+            for i, p in zip(plan.report.surviving, survivors):
+                p.microbatches = plan.new_microbatches[i]
+            self.pipelines = survivors
+        else:
+            # Dropping a dead pipeline releases its surviving hosts into
+            # the spare pool (they are live but unassigned), which the
+            # rebuild folds straight back in.
+            for i in reversed(dead_idx):
+                self.pipelines.pop(i)
+            self._rebuild()
+            if decision.mechanism == "restore":
+                self.lost_work_s += stale_s
+
+        realized = (decision.arms[decision.mechanism]["latency_s"]
+                    * self.rng.uniform(JITTER_LO, JITTER_HI))
+        self.engine.observe_measured(decision.mechanism, realized)
+        self._recovery_until = max(self._recovery_until, self.now + realized)
+        self._push(self._recovery_until, "recovered", None)
+
+        reg = self.registry
+        reg.histogram(
+            "oobleck_sim_recovery_seconds",
+            "Simulated realized recovery latency by mechanism",
+        ).observe(realized, mechanism=decision.mechanism)
+        reg.counter(
+            "oobleck_sim_incidents_total",
+            "Simulated incidents by mechanism and cause",
+        ).inc(mechanism=decision.mechanism, cause=cause)
+        self.incidents.append({
+            "t": round(self.now, 6),
+            "lost_hosts": len(lost),
+            "cause": cause,
+            "correlated": len(lost) > 1,
+            "proactive": proactive,
+            "mechanism": decision.mechanism,
+            "reason": decision.reason,
+            "projected_cost_s": round(decision.projected_cost_s, 6),
+            "realized_recovery_s": round(realized, 6),
+            "arms": decision.arms,
+            "rate_before": round(rate_before, 6),
+            "rate_after": round(self._rate(), 6),
+            "live_hosts": len(self.live),
+            "pipelines": len(self.pipelines),
+        })
+
+    # -- the run ------------------------------------------------------------- #
+
+    def _push(self, t: float, kind: str, payload) -> None:
+        self._seq += 1
+        heapq.heappush(self._heap, (t, self._seq, kind, payload))
+
+    def run(self) -> dict:
+        """Drive the scenario to its end; returns the raw run record the
+        SLO reducer consumes (plain JSON types, deterministic)."""
+        self._heap: list = []
+        self._seq = 0
+        for ev in self.scenario.events:
+            self._push(ev.t, "scenario", ev)
+        duration = self.scenario.duration_s
+        while self._heap:
+            t, _, kind, payload = heapq.heappop(self._heap)
+            if t > duration:
+                break
+            self._advance(t)
+            if kind == "scenario":
+                if payload.kind == "traffic":
+                    self._demand = payload.demand
+                elif payload.kind in ("fail", "preempt"):
+                    batch = [payload]
+                    while (self._heap and self._heap[0][0] == t
+                           and self._heap[0][2] == "scenario"
+                           and getattr(self._heap[0][3], "kind", "")
+                           in ("fail", "preempt")
+                           and self._heap[0][3].incident_id
+                           == payload.incident_id):
+                        batch.append(heapq.heappop(self._heap)[3])
+                    for ev in batch:
+                        if ev.host in self.live:
+                            self._push(t + max(ev.repair_delay_s, 0.0),
+                                       "repair", ev.host)
+                    self._handle_incident(batch)
+            elif kind == "repair":
+                if payload not in self.live:
+                    self.live.add(payload)
+                    # A total outage ends on the first usable capacity;
+                    # otherwise repaired hosts wait as spares for the next
+                    # incident's re-instantiation to fold them in.
+                    if not self.pipelines and len(self._spares()) \
+                            >= self.config.hosts_per_pipeline:
+                        self._rebuild()
+            # "recovered" events change no state: _rate_rel() reads
+            # _recovery_until against the clock; the event exists so the
+            # piecewise integration has a breakpoint at the edge.
+        self._advance(duration)
+        goodput = (self._delivered / self._demand_integral
+                   if self._demand_integral > 0 else 0.0)
+        self.registry.gauge(
+            "oobleck_sim_goodput_ratio",
+            "Delivered/demanded goodput over the scenario",
+        ).set(goodput)
+        return {
+            "scenario": {
+                "name": self.scenario.name,
+                "seed": self.scenario.seed,
+                "hosts": self.scenario.hosts,
+                "duration_s": self.scenario.duration_s,
+                "events": len(self.scenario.events),
+            },
+            "config": self.config.as_record(),
+            "incidents": self.incidents,
+            "goodput_ratio": round(goodput, 6),
+            "lost_work_s": round(self.lost_work_s, 6),
+            "final": {
+                "live_hosts": len(self.live),
+                "pipelines": len(self.pipelines),
+                "quarantined": len(self.engine.health.quarantined()),
+            },
+        }

@@ -1,0 +1,183 @@
+"""Seeded adversarial scenario generators: the scenario-diversity fuzzer.
+
+Each generator turns (rng, fleet size, duration) into a sorted list of
+ScenarioEvents — host failures (with a pre-drawn repair delay so ALL
+randomness lives here, not in the cluster model), spot-preemption
+notices, and traffic-demand swings. Events sharing one ``incident_id``
+land at the same instant and are decided as one correlated incident
+(reroute infeasible, exactly like the live control plane batches them).
+
+Determinism is a hard contract: every draw comes from the explicit
+``random.Random(seed)`` passed in — no wall clock, no ambient entropy —
+so the same (scenario, seed, hosts, duration) triple always produces the
+same event list, byte for byte, which is what makes the SLO report
+diffable across PRs.
+"""
+
+from __future__ import annotations
+
+import random
+from dataclasses import dataclass, field
+
+# Hosts per rack for correlated-loss scenarios (TPU-pod-slice flavored:
+# a rack is the shared failure domain of its power/network feed).
+RACK_SIZE = 8
+
+
+@dataclass(frozen=True)
+class ScenarioEvent:
+    """One scripted occurrence. kind: "fail" (host dies; rejoins after
+    repair_delay_s), "preempt" (spot notice: proactive drain, then the
+    host dies), or "traffic" (demand factor changes)."""
+
+    t: float
+    kind: str
+    host: int = -1
+    incident_id: int = -1          # same id + same t -> correlated batch
+    cause: str = ""
+    repair_delay_s: float = 0.0
+    demand: float = 1.0            # "traffic" only
+
+
+@dataclass
+class Scenario:
+    name: str
+    seed: int
+    hosts: int
+    duration_s: float
+    events: list[ScenarioEvent] = field(default_factory=list)
+
+
+def _exp(rng: random.Random, mean: float) -> float:
+    return rng.expovariate(1.0 / mean) if mean > 0 else 0.0
+
+
+def churn_storm(rng: random.Random, hosts: int, duration_s: float, *,
+                mean_interarrival_s: float = 20.0,
+                mean_repair_s: float = 120.0) -> list[ScenarioEvent]:
+    """Independent host failures with exponential interarrival — the
+    sustained-churn regime where the scorer's risk term must eventually
+    prefer restore over an endless in-memory recovery cascade."""
+    events, t, incident = [], 0.0, 0
+    while True:
+        t += _exp(rng, mean_interarrival_s)
+        if t >= duration_s:
+            break
+        events.append(ScenarioEvent(
+            t=round(t, 6), kind="fail", host=rng.randrange(hosts),
+            incident_id=incident, cause="churn",
+            repair_delay_s=round(_exp(rng, mean_repair_s), 6)))
+        incident += 1
+    return events
+
+
+def correlated_rack_loss(rng: random.Random, hosts: int, duration_s: float, *,
+                         racks_lost: int = 2,
+                         mean_repair_s: float = 300.0) -> list[ScenarioEvent]:
+    """Whole racks fail at once (shared feed): every host of the rack in
+    one correlated incident, so reroute is never an option and the policy
+    plane must choose between re-instantiation and restore."""
+    events = []
+    n_racks = max(1, hosts // RACK_SIZE)
+    times = sorted(round(rng.uniform(0.0, duration_s), 6)
+                   for _ in range(racks_lost))
+    for incident, t in enumerate(times):
+        rack = rng.randrange(n_racks)
+        repair = round(_exp(rng, mean_repair_s), 6)
+        for h in range(rack * RACK_SIZE,
+                       min((rack + 1) * RACK_SIZE, hosts)):
+            events.append(ScenarioEvent(
+                t=t, kind="fail", host=h, incident_id=incident,
+                cause="rack_loss", repair_delay_s=repair))
+    return events
+
+
+def spot_preemption_wave(rng: random.Random, hosts: int, duration_s: float, *,
+                         waves: int = 3, wave_frac: float = 0.1,
+                         mean_repair_s: float = 180.0
+                         ) -> list[ScenarioEvent]:
+    """Capacity-reclaim waves: a slice of the fleet gets preemption
+    notices in a burst (proactive drain window before the kill), then
+    fresh capacity arrives after the repair delay."""
+    events, incident = [], 0
+    per_wave = max(1, int(hosts * wave_frac))
+    for w in range(waves):
+        base = round(rng.uniform(0.0, duration_s * 0.9), 6)
+        victims = rng.sample(range(hosts), min(per_wave, hosts))
+        for h in victims:
+            events.append(ScenarioEvent(
+                t=round(base + rng.uniform(0.0, 2.0), 6), kind="preempt",
+                host=h, incident_id=incident, cause="preemption",
+                repair_delay_s=round(_exp(rng, mean_repair_s), 6)))
+            incident += 1
+    return events
+
+
+def flap_sequence(rng: random.Random, hosts: int, duration_s: float, *,
+                  flappers: int = 2, flaps: int = 5,
+                  mean_period_s: float = 15.0) -> list[ScenarioEvent]:
+    """A few hosts failing on a short period — the flap detector's diet.
+    Repairs return fast (that is what makes a flapper: it comes back and
+    fails again), so quarantine hysteresis is what must end the cycle."""
+    events, incident = [], 0
+    for f in range(min(flappers, hosts)):
+        host = rng.randrange(hosts)
+        t = round(rng.uniform(0.0, duration_s * 0.2), 6)
+        for _ in range(flaps):
+            gap = _exp(rng, mean_period_s)
+            repair = round(min(gap * 0.5, 10.0), 6)
+            events.append(ScenarioEvent(
+                t=round(t, 6), kind="fail", host=host,
+                incident_id=incident, cause="flap",
+                repair_delay_s=repair))
+            incident += 1
+            t += gap
+            if t >= duration_s:
+                break
+    return events
+
+
+def diurnal_traffic(rng: random.Random, hosts: int, duration_s: float, *,
+                    period_s: float = 600.0, swing: float = 0.5,
+                    mean_interarrival_s: float = 60.0,
+                    mean_repair_s: float = 120.0) -> list[ScenarioEvent]:
+    """Background churn under a day/night demand swing: demand steps
+    through a piecewise-sinusoid (precomputed table — no trig drift) so
+    goodput-vs-demand is what the SLO report integrates."""
+    events = churn_storm(rng, hosts, duration_s,
+                         mean_interarrival_s=mean_interarrival_s,
+                         mean_repair_s=mean_repair_s)
+    # 8 steps per period, triangle-ish: 1-swing .. 1.0 and back.
+    steps = [1.0 - swing * abs(1.0 - i / 4.0) for i in range(8)]
+    t, i = 0.0, 0
+    while t < duration_s:
+        events.append(ScenarioEvent(
+            t=round(t, 6), kind="traffic",
+            demand=round(steps[i % len(steps)], 6)))
+        t += period_s / len(steps)
+        i += 1
+    return events
+
+
+GENERATORS = {
+    "churn_storm": churn_storm,
+    "correlated_rack_loss": correlated_rack_loss,
+    "spot_preemption_wave": spot_preemption_wave,
+    "flap_sequence": flap_sequence,
+    "diurnal_traffic": diurnal_traffic,
+}
+
+
+def make_scenario(name: str, *, seed: int, hosts: int,
+                  duration_s: float, **params) -> Scenario:
+    """Build one named scenario from an explicit seed. Events are sorted
+    by (t, host, kind) — a total order, so heap insertion order (and with
+    it the whole run) is reproducible."""
+    if name not in GENERATORS:
+        raise ValueError(f"unknown scenario {name!r}: "
+                         f"want one of {sorted(GENERATORS)}")
+    rng = random.Random(seed)
+    events = GENERATORS[name](rng, hosts, duration_s, **params)
+    events.sort(key=lambda e: (e.t, e.host, e.kind, e.incident_id))
+    return Scenario(name=name, seed=seed, hosts=hosts,
+                    duration_s=duration_s, events=events)

@@ -1,0 +1,136 @@
+"""Cluster model: incidents run the real classify/plan/decide chain, and
+the bookkeeping (goodput, repairs, spares, lost work) stays honest."""
+
+from __future__ import annotations
+
+import pytest
+
+from oobleck_tpu.sim.cluster import SimCluster, SimConfig
+from oobleck_tpu.sim.scenarios import Scenario, ScenarioEvent
+from oobleck_tpu.utils import metrics
+
+
+@pytest.fixture(autouse=True)
+def _fresh_registry(monkeypatch):
+    monkeypatch.setattr(metrics, "_registry", metrics.Registry())
+
+
+def _scenario(events, *, hosts=4, duration_s=600.0, seed=0):
+    return Scenario(name="manual", seed=seed, hosts=hosts,
+                    duration_s=duration_s, events=list(events))
+
+
+def test_hosts_mismatch_rejected():
+    with pytest.raises(ValueError, match="hosts"):
+        SimCluster(SimConfig(hosts=8), _scenario([], hosts=4))
+
+
+def test_single_host_loss_reroutes():
+    sc = _scenario([ScenarioEvent(t=100.0, kind="fail", host=1,
+                                  incident_id=0, cause="test",
+                                  repair_delay_s=1000.0)])
+    run = SimCluster(SimConfig(hosts=4), sc).run()
+    assert len(run["incidents"]) == 1
+    inc = run["incidents"][0]
+    # First failure, feasible plan: the policy plane's documented
+    # cheapest-latency behavior is reroute.
+    assert inc["mechanism"] == "reroute"
+    assert inc["correlated"] is False
+    assert inc["pipelines"] == 3
+    # Survivors absorbed the dead replica's microbatches: the step got
+    # longer, so the fleet rate dropped but work is preserved.
+    assert inc["rate_after"] < inc["rate_before"]
+    assert 0.0 < run["goodput_ratio"] < 1.0
+    assert run["final"]["live_hosts"] == 3
+
+
+def test_correlated_loss_cannot_reroute():
+    sc = _scenario([
+        ScenarioEvent(t=100.0, kind="fail", host=1, incident_id=0,
+                      cause="rack_loss", repair_delay_s=1000.0),
+        ScenarioEvent(t=100.0, kind="fail", host=2, incident_id=0,
+                      cause="rack_loss", repair_delay_s=1000.0),
+    ])
+    run = SimCluster(SimConfig(hosts=4), sc).run()
+    assert len(run["incidents"]) == 1
+    inc = run["incidents"][0]
+    assert inc["correlated"] is True
+    assert inc["lost_hosts"] == 2
+    assert inc["mechanism"] != "reroute"
+    assert inc["arms"]["reroute"]["feasible"] is False
+    # Re-instantiation over the 2 survivors: a balanced smaller fleet.
+    assert inc["pipelines"] == 2
+
+
+def test_spare_only_loss_is_not_an_incident():
+    # 4 hosts at 3 hosts/pipeline: one pipeline (hosts 0-2), host 3 spare.
+    sc = _scenario([ScenarioEvent(t=50.0, kind="fail", host=3,
+                                  incident_id=0, cause="test",
+                                  repair_delay_s=1000.0)])
+    run = SimCluster(SimConfig(hosts=4, hosts_per_pipeline=3), sc).run()
+    assert run["incidents"] == []
+    assert run["final"]["live_hosts"] == 3
+    assert run["final"]["pipelines"] == 1
+
+
+def test_repair_returns_host_to_live_set():
+    sc = _scenario([ScenarioEvent(t=100.0, kind="fail", host=1,
+                                  incident_id=0, cause="test",
+                                  repair_delay_s=50.0)])
+    run = SimCluster(SimConfig(hosts=4), sc).run()
+    assert run["final"]["live_hosts"] == 4
+
+
+def test_forced_restore_accrues_lost_work():
+    sc = _scenario([ScenarioEvent(t=100.0, kind="fail", host=1,
+                                  incident_id=0, cause="test",
+                                  repair_delay_s=1000.0)])
+    run = SimCluster(SimConfig(hosts=4, mode="restore",
+                               checkpoint_period_s=300.0), sc).run()
+    inc = run["incidents"][0]
+    assert inc["mechanism"] == "restore"
+    # Failure at t=100 with a 300 s checkpoint period: 100 s of work since
+    # the last durable checkpoint is replayed.
+    assert run["lost_work_s"] == pytest.approx(100.0)
+
+
+def test_recovery_window_delivers_zero():
+    # Forced restore has a ~25 s recovery; an identical scenario with no
+    # failure delivers strictly more goodput.
+    fail = _scenario([ScenarioEvent(t=100.0, kind="fail", host=1,
+                                    incident_id=0, cause="test",
+                                    repair_delay_s=5.0)])
+    quiet = _scenario([])
+    g_fail = SimCluster(SimConfig(hosts=4, mode="restore"), fail).run()
+    g_quiet = SimCluster(SimConfig(hosts=4, mode="restore"), quiet).run()
+    assert g_quiet["goodput_ratio"] == pytest.approx(1.0)
+    assert g_fail["goodput_ratio"] < g_quiet["goodput_ratio"]
+
+
+def test_traffic_swing_scales_demand():
+    # Demand at 0.5 for the whole run: a fleet losing half its capacity
+    # can still meet it, so goodput stays near 1.
+    sc = _scenario([
+        ScenarioEvent(t=0.0, kind="traffic", demand=0.5),
+        ScenarioEvent(t=100.0, kind="fail", host=1, incident_id=0,
+                      cause="test", repair_delay_s=1000.0),
+        ScenarioEvent(t=100.0, kind="fail", host=2, incident_id=0,
+                      cause="test", repair_delay_s=1000.0),
+    ])
+    run = SimCluster(SimConfig(hosts=4), sc).run()
+    # 2/4 hosts deliver rate 0.5 of base == demand; only the recovery
+    # window itself is lost.
+    assert run["goodput_ratio"] > 0.9
+
+
+def test_hermetic_registry_no_global_leak():
+    sc = _scenario([ScenarioEvent(t=100.0, kind="fail", host=1,
+                                  incident_id=0, cause="test",
+                                  repair_delay_s=1000.0)])
+    cluster = SimCluster(SimConfig(hosts=4), sc)
+    cluster.run()
+    own = {m["name"] for m in cluster.registry.snapshot()["metrics"]}
+    assert "oobleck_sim_incidents_total" in own
+    assert "oobleck_sim_goodput_ratio" in own
+    leaked = {m["name"] for m in metrics.registry().snapshot()["metrics"]}
+    assert "oobleck_sim_incidents_total" not in leaked

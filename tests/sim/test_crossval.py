@@ -1,0 +1,55 @@
+"""Cross-validation: the simulator replayed against the recorded
+degrade-bench fixture must reproduce the hardware measurements within 15%
+(tentpole acceptance bar; the fixture generator self-gates at 10%, so a
+pass here has real margin)."""
+
+from __future__ import annotations
+
+import os
+
+import pytest
+
+from oobleck_tpu.sim.corpus import load_corpus
+from oobleck_tpu.sim.slo import crossval_report, replay_incident
+from oobleck_tpu.utils import metrics
+
+TOLERANCE = 0.15
+FIXTURE_DIR = os.path.join(os.path.dirname(__file__), "data",
+                           "degrade_bench")
+
+
+@pytest.fixture(autouse=True)
+def _fresh_registry(monkeypatch):
+    monkeypatch.setattr(metrics, "_registry", metrics.Registry())
+
+
+def test_fixture_is_loadable():
+    corpus = load_corpus(FIXTURE_DIR)
+    assert len(corpus.incidents) == 1
+    assert corpus.incidents[0].mechanism == "reroute"
+    assert not corpus.skipped
+    inc = corpus.incidents[0]
+    assert inc.attrs["rig"]["hosts"] == 2
+    assert inc.attrs["op_times"], "fixture has no op calibration"
+
+
+def test_replay_reproduces_measurement_within_tolerance():
+    corpus = load_corpus(FIXTURE_DIR)
+    rep = crossval_report(corpus)
+    assert rep["replayable"] == 1
+    replay = rep["replays"][0]
+    assert replay["sim"]["feasible"] is True
+    rel_err = replay["rel_err"]
+    # Both SLOs the issue names: reroute recovery latency (via the
+    # corpus-fitted prior) and survivor slowdown (via real schedule
+    # replay over the recorded calibration).
+    assert set(rel_err) == {"survivor_slowdown", "recovery_s"}
+    for key, err in rel_err.items():
+        assert err <= TOLERANCE, f"{key} off by {err:.1%}"
+
+
+def test_replay_skips_incidents_without_calibration():
+    corpus = load_corpus(FIXTURE_DIR)
+    inc = corpus.incidents[0]
+    inc.attrs = {}  # a live-production incident: marks but no rig freeze
+    assert replay_incident(inc, corpus) is None

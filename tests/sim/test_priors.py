@@ -1,0 +1,127 @@
+"""Corpus -> fitted priors -> policy loop: fit, publish, load, decide."""
+
+from __future__ import annotations
+
+import json
+import os
+
+import pytest
+
+from oobleck_tpu.policy.engine import PolicyEngine
+from oobleck_tpu.policy.signals import (
+    PRIOR_LATENCY_S, build_arms, learned_priors, priors_provenance)
+from oobleck_tpu.sim.corpus import load_corpus
+from oobleck_tpu.sim.priors import fit_priors, write_priors
+from oobleck_tpu.utils import metrics
+
+FIXTURE_DIR = os.path.join(os.path.dirname(__file__), "data",
+                           "degrade_bench")
+
+
+@pytest.fixture(autouse=True)
+def _fresh_registry(monkeypatch):
+    # No measured latency history: arms must price from priors.
+    monkeypatch.setattr(metrics, "_registry", metrics.Registry())
+
+
+def _write_incident(d, trace_id, mechanism, total_s):
+    n = len([x for x in os.listdir(d) if x.startswith("incident-")])
+    with open(os.path.join(d, f"incident-{n}.json"), "w") as f:
+        json.dump({"schema_version": 1, "trace_id": trace_id,
+                   "lost_ip": "10.0.0.1",
+                   "marks": {"detect": 0.0, "first_step": total_s},
+                   "total_s": total_s,
+                   "flight": [{"t": 1.0, "event": "degrade_decision",
+                               "mechanism": mechanism,
+                               "measured_recovery_s": total_s}]}, f)
+
+
+def test_fit_is_median_and_deterministic(tmp_path):
+    d = str(tmp_path)
+    for i, t in enumerate([1.0, 9.0, 2.0]):
+        _write_incident(d, f"t{i}", "reroute", t)
+    corpus = load_corpus(d)
+    a, b = fit_priors(corpus), fit_priors(corpus)
+    assert a == b
+    assert a["latency_s"]["reroute"] == 2.0
+    prov = a["provenance"]["mechanisms"]["reroute"]
+    assert prov["samples"] == 3
+    assert prov["min_s"] == 1.0 and prov["max_s"] == 9.0
+
+
+def test_min_samples_and_unknown_mechanism(tmp_path):
+    d = str(tmp_path)
+    _write_incident(d, "t0", "reroute", 1.0)
+    _write_incident(d, "t1", "teleport", 5.0)
+    priors = fit_priors(load_corpus(d), min_samples=2)
+    assert priors["latency_s"] == {}
+    mechs = priors["provenance"]["mechanisms"]
+    assert mechs["reroute"]["ignored"] == "fewer_than_2_samples"
+    assert mechs["teleport"]["ignored"] == "unknown_mechanism"
+
+
+def test_learned_priors_roundtrip_into_arms(tmp_path):
+    d = str(tmp_path)
+    _write_incident(d, "t0", "restore", 18.0)
+    path = str(tmp_path / "learned_priors.json")
+    write_priors(path, fit_priors(load_corpus(d)))
+
+    loaded = learned_priors(path)
+    assert loaded is not None
+    table, source = loaded
+    assert table == {"restore": 18.0}
+    assert source == f"learned:{path}"
+
+    arms = build_arms(multihost=True, staleness_steps=4.0,
+                      priors_path=path)
+    assert arms["restore"].latency_s == 18.0
+    assert arms["restore"].latency_source == "prior"
+    assert arms["restore"].prior_source == f"learned:{path}"
+    # Mechanisms the fit did not cover keep the hardcoded table and say so.
+    assert arms["reroute"].latency_s == PRIOR_LATENCY_S["reroute"]
+    assert arms["reroute"].prior_source == "hardcoded"
+    assert arms["restore"].as_record()["prior_source"] \
+        == f"learned:{path}"
+
+
+def test_unknown_version_file_ignored(tmp_path):
+    path = str(tmp_path / "bad.json")
+    with open(path, "w") as f:
+        json.dump({"version": 99, "latency_s": {"reroute": 0.1}}, f)
+    assert learned_priors(path) is None
+    arms = build_arms(multihost=True, priors_path=path)
+    assert arms["reroute"].latency_s == PRIOR_LATENCY_S["reroute"]
+    assert arms["reroute"].prior_source == "hardcoded"
+
+
+def test_provenance_in_engine_status(tmp_path):
+    d = str(tmp_path)
+    _write_incident(d, "t0", "reroute", 0.5)
+    path = str(tmp_path / "learned_priors.json")
+    write_priors(path, fit_priors(load_corpus(d)))
+
+    hard = PolicyEngine(multihost=True).status()["priors"]
+    assert hard["source"] == "hardcoded"
+    assert hard["mechanisms"] == sorted(PRIOR_LATENCY_S)
+
+    eng = PolicyEngine(multihost=True, priors_path=path)
+    st = eng.status()["priors"]
+    assert st["source"] == f"learned:{path}"
+    assert st["mechanisms"] == ["reroute"]
+    d = eng.decide(["10.0.0.9"], staleness_steps=2.0)
+    assert d.arms["reroute"]["prior_source"] == f"learned:{path}"
+    assert d.arms["reroute"]["latency_s"] == 0.5
+
+
+def test_provenance_helper_fallback():
+    assert priors_provenance(None)["source"] == "hardcoded"
+
+
+def test_fixture_corpus_fits_measured_recovery():
+    # The committed degrade-bench fixture: the fitted reroute prior IS the
+    # measured failure-to-resume latency (one incident, median == sample).
+    corpus = load_corpus(FIXTURE_DIR)
+    priors = fit_priors(corpus)
+    measured = corpus.incidents[0].attrs["measured"]
+    assert priors["latency_s"]["reroute"] == pytest.approx(
+        measured["recovery_to_next_step_s"], rel=1e-6)

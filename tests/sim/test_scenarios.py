@@ -1,0 +1,60 @@
+"""Scenario generators: seeded determinism and correlation structure."""
+
+from __future__ import annotations
+
+import pytest
+
+from oobleck_tpu.sim.scenarios import (
+    GENERATORS, RACK_SIZE, make_scenario)
+
+
+@pytest.mark.parametrize("name", sorted(GENERATORS))
+def test_same_seed_same_events(name):
+    a = make_scenario(name, seed=7, hosts=32, duration_s=300.0)
+    b = make_scenario(name, seed=7, hosts=32, duration_s=300.0)
+    assert a.events == b.events
+    assert a.events, f"{name} generated an empty scenario"
+
+
+def test_different_seed_different_events():
+    a = make_scenario("churn_storm", seed=1, hosts=32, duration_s=300.0)
+    b = make_scenario("churn_storm", seed=2, hosts=32, duration_s=300.0)
+    assert a.events != b.events
+
+
+def test_events_sorted_and_bounded():
+    sc = make_scenario("diurnal_traffic", seed=3, hosts=32, duration_s=600.0)
+    ts = [e.t for e in sc.events]
+    assert ts == sorted(ts)
+    assert all(0.0 <= t < 600.0 or e.kind == "traffic"
+               for t, e in zip(ts, sc.events))
+    assert all(0 <= e.host < 32 for e in sc.events if e.kind != "traffic")
+
+
+def test_correlated_rack_loss_batches():
+    sc = make_scenario("correlated_rack_loss", seed=5, hosts=64,
+                       duration_s=600.0)
+    by_incident: dict[int, list] = {}
+    for e in sc.events:
+        by_incident.setdefault(e.incident_id, []).append(e)
+    assert by_incident
+    for batch in by_incident.values():
+        # Whole rack at one instant: same t, RACK_SIZE distinct hosts in
+        # one rack-aligned span.
+        assert len(batch) == RACK_SIZE
+        assert len({e.t for e in batch}) == 1
+        hosts = sorted(e.host for e in batch)
+        assert hosts == list(range(hosts[0], hosts[0] + RACK_SIZE))
+        assert hosts[0] % RACK_SIZE == 0
+
+
+def test_preemption_is_proactive_kind():
+    sc = make_scenario("spot_preemption_wave", seed=5, hosts=32,
+                       duration_s=600.0)
+    assert sc.events
+    assert all(e.kind == "preempt" for e in sc.events)
+
+
+def test_unknown_scenario_raises():
+    with pytest.raises(ValueError, match="unknown scenario"):
+        make_scenario("no_such", seed=0, hosts=8, duration_s=10.0)
