@@ -10,7 +10,7 @@ Budget layout (wall-clock caps, enforced with subprocess timeouts):
   probe   : 60 s, one retry            -> is the TPU relay alive at all?
   measure : 240 s on the real device   -> the actual benchmark
   fallback: 120 s tiny CPU proxy       -> sanity signal when TPU unreachable
-  serve   : 75 s CPU subprocess        -> serving microbench under "serve"
+  serve   : 150 s CPU subprocess       -> serving microbench under "serve"
                                           (never on the TPU relay: its
                                           multi-threaded dispatch wedges it)
   pipeline: 120 s CPU subprocess       -> 1F1B vs interleaved schedule
@@ -514,7 +514,7 @@ def _policy_summary() -> dict:
         return {"error": f"unparseable policy bench output: {exc}"}
 
 
-SERVE_BENCH_TIMEOUT_S = 75
+SERVE_BENCH_TIMEOUT_S = 150
 
 
 def _serve_summary() -> dict:
@@ -730,9 +730,11 @@ DIFF_THRESHOLD = 0.05
 # substring would swallow "_sec"/"_speedup" and invert the headline
 # throughput keys, so unit suffixes are matched as suffixes only.
 _HIGHER_BETTER = ("per_sec", "per_second", "speedup", "retention",
-                  "throughput", "goodput", "agreement")
+                  "throughput", "goodput", "agreement", "sustained",
+                  "hit_rate")
 _LOWER_BETTER = ("latency", "seconds", "ttft", "pause", "bubble", "stall",
-                 "p50", "p90", "p99", "findings", "parse_errors", "regret")
+                 "p50", "p90", "p99", "findings", "parse_errors", "regret",
+                 "bytes_per_token")
 _LOWER_BETTER_SUFFIXES = ("_s", "_ms")
 
 

@@ -483,6 +483,170 @@ class GPTModel:
         return logits, {"k": k_new, "v": v_new}
 
     # ------------------------------------------------------------------ #
+    # paged incremental decode (serving, block-table KV)                  #
+    # ------------------------------------------------------------------ #
+
+    def init_paged_kv_cache(self, num_pages: int, page_size: int,
+                            dtype: Any = None):
+        """Paged KV pool, stacked [L, N_pages, H, page, D]. Requests own
+        page chains via block tables (serve/kv_blocks.py); page 0 is the
+        reserved garbage page inactive lanes and padding write to."""
+        c = self.config
+        shape = (c.num_layers, num_pages, c.num_heads, page_size, c.head_dim)
+        dt = c.dtype if dtype is None else dtype
+        return {"k": jnp.zeros(shape, dt), "v": jnp.zeros(shape, dt)}
+
+    def _paged_impl(self) -> str:
+        # Training impl names (ring/ulysses) have no paged meaning; only a
+        # forced xla/pallas carries over, everything else resolves by
+        # backend.
+        impl = self.config.attention_impl
+        return impl if impl in ("xla", "pallas") else "auto"
+
+    def _paged_decode_sublayer(self, p, x, k_pool, v_pool, block_tables, pos):
+        """_decode_attention_sublayer against a page pool: write the new
+        token's K/V through the block table, then ragged paged attention.
+        x [B, E]; pools [N, H, page, D]; block_tables [B, P]; pos [B]."""
+        c = self.config
+        dt = c.dtype
+        from oobleck_tpu.ops.attention import alibi_slopes
+        from oobleck_tpu.ops.paged_attention import (
+            paged_cache_write, paged_decode_attention)
+
+        h = _layer_norm(x, p["ln1"]["scale"], p["ln1"]["bias"], c.layer_norm_epsilon)
+        wqkv = p["attn"]["wqkv"].astype(dt)                             # [E,3,H,D]
+        qkv = jnp.einsum("be,ethd->tbhd", h, wqkv) + p["attn"]["bqkv"].astype(dt)[:, None]
+        k_pool = paged_cache_write(k_pool, qkv[1], block_tables, pos)
+        v_pool = paged_cache_write(v_pool, qkv[2], block_tables, pos)
+        slopes = alibi_slopes(c.num_heads) if c.position_embedding == "alibi" else None
+        attn = paged_decode_attention(
+            qkv[0], k_pool, v_pool, block_tables, pos + 1,
+            alibi_slopes=slopes, impl=self._paged_impl())
+        out = jnp.einsum("bhd,hde->be", attn, p["attn"]["wo"].astype(dt))
+        out = out + p["attn"]["bo"].astype(dt)
+        return x + out, k_pool, v_pool
+
+    def _tail_prefill_sublayer(self, p, x, k_pool, v_pool, head_tables,
+                               prior_len):
+        """attention_sublayer for a prompt TAIL whose head (`prior_len`
+        tokens) is already cached in pool pages named by `head_tables`
+        (static page count, garbage-padded past the live head): the prefix
+        hit skips the head's block compute entirely — head K/V are
+        GATHERED, not recomputed. Tail queries sit at absolute positions
+        prior_len + i, so the mask is explicit (head key j live iff
+        j < prior_len; causal among the tail) and ALiBi uses true
+        distances. seq_q != seq_k, so this is inherently the XLA path."""
+        c = self.config
+        dt = c.dtype
+        from oobleck_tpu.ops.attention import (
+            _xla_causal_attention, alibi_slopes)
+        from oobleck_tpu.ops.paged_attention import paged_gather_kv
+
+        h = _layer_norm(x, p["ln1"]["scale"], p["ln1"]["bias"], c.layer_norm_epsilon)
+        wqkv = p["attn"]["wqkv"].astype(dt)
+        qkv = jnp.einsum("bse,ethd->tbhsd", h, wqkv) + p["attn"]["bqkv"].astype(dt)[:, None, :, None, :]
+        q, k_tail, v_tail = qkv[0], qkv[1], qkv[2]
+        head_k = paged_gather_kv(k_pool, head_tables[None]).astype(dt)
+        head_v = paged_gather_kv(v_pool, head_tables[None]).astype(dt)
+        k = jnp.concatenate([head_k, k_tail], axis=2)
+        v = jnp.concatenate([head_v, v_tail], axis=2)
+        t_len, s_head = q.shape[2], head_k.shape[2]
+        q_abs = prior_len + jnp.arange(t_len)                           # [T]
+        k_abs = jnp.concatenate([jnp.arange(s_head), q_abs])            # [S]
+        live = jnp.concatenate([
+            jnp.broadcast_to(jnp.arange(s_head) < prior_len, (t_len, s_head)),
+            jnp.tril(jnp.ones((t_len, t_len), bool)),
+        ], axis=1)                                                      # [T, S]
+        bias = jnp.where(live, 0.0, NEG_INF)[None]                      # [1,T,S]
+        if c.position_embedding == "alibi":
+            dist = (q_abs[:, None] - k_abs[None, :]).astype(jnp.float32)
+            bias = bias - alibi_slopes(c.num_heads)[:, None, None] * dist
+        attn = _xla_causal_attention(q, k, v, bias=bias, causal=False)
+        out = jnp.einsum("bhsd,hde->bse", attn, p["attn"]["wo"].astype(dt))
+        out = out + p["attn"]["bo"].astype(dt)
+        return x + out, k_tail, v_tail
+
+    def _paged_tail_write(self, kv_cache, ks, vs, block_tables, prior_len,
+                          length):
+        """Scatter a prefill tail's K/V ([L, 1, Hkv, T, D]) into pool pages
+        at absolute positions prior_len + i. Padded positions (i >= length)
+        land on the garbage page 0."""
+        page = kv_cache["k"].shape[3]
+        t_len = ks.shape[3]
+        i = jnp.arange(t_len)
+        pos_abs = prior_len + i
+        page_idx = jnp.where(
+            i < length,
+            jnp.take(block_tables, pos_abs // page, mode="clip"), 0)    # [T]
+        off = pos_abs % page
+        # Advanced indices at dims 1/3 front the result: update [T, L, H, D].
+        upd_k = ks[:, 0].transpose(2, 0, 1, 3).astype(kv_cache["k"].dtype)
+        upd_v = vs[:, 0].transpose(2, 0, 1, 3).astype(kv_cache["v"].dtype)
+        return {
+            "k": kv_cache["k"].at[:, page_idx, :, off, :].set(upd_k),
+            "v": kv_cache["v"].at[:, page_idx, :, off, :].set(upd_v),
+        }
+
+    def forward_prefill_paged(self, params, tokens: jax.Array, kv_cache,
+                              block_tables: jax.Array, length: jax.Array,
+                              head_tables: jax.Array | None = None,
+                              prior_len: jax.Array | int = 0):
+        """Prompt pass for ONE request into pool pages. tokens [1, T] is the
+        prompt TAIL (bucket-padded past the live `length`); block_tables [P]
+        names the request's page chain (cached head included); on a prefix
+        hit `head_tables` [P_head] (static count — a jit bucket) names the
+        cached head pages and `prior_len` its live token count, and the
+        head's compute is skipped. Returns (next-token logits [V] f32 at
+        tail position length-1, updated pool)."""
+        c = self.config
+        t_len = tokens.shape[-1]
+        prior_len = jnp.asarray(prior_len, jnp.int32)
+        pe = params["embed"]
+        x = pe["wte"][tokens]
+        if c.position_embedding == "learned":
+            x = x + lax.dynamic_slice_in_dim(pe["wpe"], prior_len, t_len, axis=0)
+        x = x.astype(c.dtype)
+
+        def body(x, sl):
+            bp, kp, vp = sl
+            if head_tables is None:
+                x, k, v = self.attention_sublayer(bp, x, return_kv=True)
+            else:
+                x, k, v = self._tail_prefill_sublayer(
+                    bp, x, kp, vp, head_tables, prior_len)
+            return self.mlp_sublayer(bp, x), (k, v)
+
+        x, (ks, vs) = lax.scan(
+            body, x, (params["blocks"], kv_cache["k"], kv_cache["v"]))
+        kv_cache = self._paged_tail_write(
+            kv_cache, ks, vs, block_tables, prior_len, length)
+        logits = self.head(params["head"], x)[0, length - 1]
+        return logits, kv_cache
+
+    def forward_decode_paged(self, params, token: jax.Array, kv_cache,
+                             block_tables: jax.Array, pos: jax.Array):
+        """One paged decode step over all lanes: token [B], pos [B],
+        block_tables [B, P]. Same contract as forward_decode; inactive
+        lanes park on the garbage page and decode harmlessly."""
+        c = self.config
+        pe = params["embed"]
+        x = pe["wte"][token]
+        if c.position_embedding == "learned":
+            x = x + pe["wpe"][pos]
+        x = x.astype(c.dtype)
+
+        def body(x, sl):
+            bp, kp, vp = sl
+            x, kp, vp = self._paged_decode_sublayer(
+                bp, x, kp, vp, block_tables, pos)
+            return self.mlp_sublayer(bp, x), (kp, vp)
+
+        x, (k_new, v_new) = lax.scan(
+            body, x, (params["blocks"], kv_cache["k"], kv_cache["v"]))
+        logits = self.head(params["head"], x[:, None, :])[:, 0]
+        return logits, {"k": k_new, "v": v_new}
+
+    # ------------------------------------------------------------------ #
     # sharding + gradient-reduction rules                                 #
     # ------------------------------------------------------------------ #
 

@@ -395,6 +395,122 @@ class LlamaModel:
         logits = self.head(params["head"], x[:, None, :])[:, 0]
         return logits, {"k": k_new, "v": v_new}
 
+    # ---- paged incremental decode (serving, block-table KV) ----
+
+    def init_paged_kv_cache(self, num_pages: int, page_size: int, dtype=None):
+        """Paged KV pool [L, N_pages, KV, page, D] — unrepeated KV heads,
+        post-RoPE keys (absolute phases baked in, so gathered head pages
+        are position-correct without recompute). Page 0 is the reserved
+        garbage page."""
+        c = self.config
+        shape = (c.num_layers, num_pages, c.kv_heads, page_size, c.head_dim)
+        dt = c.dtype if dtype is None else dtype
+        return {"k": jnp.zeros(shape, dt), "v": jnp.zeros(shape, dt)}
+
+    def _paged_impl(self) -> str:
+        impl = self.config.attention_impl
+        return impl if impl in ("xla", "pallas") else "auto"
+
+    def _paged_decode_sublayer(self, p, x, k_pool, v_pool, block_tables, pos):
+        """_decode_attention_sublayer against a page pool; GQA folds query
+        heads inside paged_decode_attention against the unrepeated pool."""
+        c = self.config
+        dt = c.dtype
+        from oobleck_tpu.ops.paged_attention import (
+            paged_cache_write, paged_decode_attention)
+
+        h = _rms_norm(x, p["ln1"]["scale"], c.rms_norm_eps)
+        q = jnp.einsum("be,ehd->bhd", h, p["attn"]["wq"].astype(dt))
+        kv = jnp.einsum("be,ekhd->kbhd", h, p["attn"]["wkv"].astype(dt))
+        q = _rope_one(q, pos, c.rope_theta)
+        k = _rope_one(kv[0], pos, c.rope_theta)
+        k_pool = paged_cache_write(k_pool, k, block_tables, pos)
+        v_pool = paged_cache_write(v_pool, kv[1], block_tables, pos)
+        attn = paged_decode_attention(q, k_pool, v_pool, block_tables, pos + 1,
+                                      impl=self._paged_impl())
+        out = jnp.einsum("bhd,hde->be", attn, p["attn"]["wo"].astype(dt))
+        return x + out, k_pool, v_pool
+
+    def _tail_prefill_sublayer(self, p, x, k_pool, v_pool, head_tables,
+                               prior_len):
+        """Prompt-tail attention over a gathered cached head (see
+        GPTModel._tail_prefill_sublayer): head pages hold post-RoPE K, so
+        the prefix hit skips the head's compute; tail queries/keys rotate
+        at absolute positions prior_len + i; mask is explicit."""
+        c = self.config
+        dt = c.dtype
+        from oobleck_tpu.ops.attention import _xla_causal_attention
+        from oobleck_tpu.ops.paged_attention import paged_gather_kv
+
+        h = _rms_norm(x, p["ln1"]["scale"], c.rms_norm_eps)
+        wq = p["attn"]["wq"].astype(dt)
+        wkv = p["attn"]["wkv"].astype(dt)
+        q = jnp.einsum("bse,ehd->bhsd", h, wq)
+        kv = jnp.einsum("bse,ekhd->kbhsd", h, wkv)
+        t_len = q.shape[2]
+        pos = prior_len + jnp.arange(t_len)
+        q = _rope(q, pos, c.rope_theta)
+        k_tail = _rope(kv[0], pos, c.rope_theta)
+        v_tail = kv[1]
+        head_k = paged_gather_kv(k_pool, head_tables[None]).astype(dt)
+        head_v = paged_gather_kv(v_pool, head_tables[None]).astype(dt)
+        k = jnp.concatenate([head_k, k_tail], axis=2)
+        v = jnp.concatenate([head_v, v_tail], axis=2)
+        if c.kv_heads != c.num_heads:
+            rep = c.num_heads // c.kv_heads
+            k = jnp.repeat(k, rep, axis=1)
+            v = jnp.repeat(v, rep, axis=1)
+        s_head = head_k.shape[2]
+        live = jnp.concatenate([
+            jnp.broadcast_to(jnp.arange(s_head) < prior_len, (t_len, s_head)),
+            jnp.tril(jnp.ones((t_len, t_len), bool)),
+        ], axis=1)
+        bias = jnp.where(live, 0.0, NEG_INF)[None]                      # [1,T,S]
+        attn = _xla_causal_attention(q, k, v, bias=bias, causal=False)
+        out = jnp.einsum("bhsd,hde->bse", attn, p["attn"]["wo"].astype(dt))
+        return x + out, k_tail, v_tail
+
+    def forward_prefill_paged(self, params, tokens, kv_cache, block_tables,
+                              length, head_tables=None, prior_len=0):
+        """Same contract as GPTModel.forward_prefill_paged (prompt tail into
+        pool pages, optional cached head via head_tables/prior_len)."""
+        from oobleck_tpu.models.gpt import GPTModel
+
+        c = self.config
+        prior_len = jnp.asarray(prior_len, jnp.int32)
+        x = params["embed"]["wte"][tokens].astype(c.dtype)
+
+        def body(x, sl):
+            bp, kp, vp = sl
+            if head_tables is None:
+                x, k, v = self.attention_sublayer(bp, x, return_kv=True)
+            else:
+                x, k, v = self._tail_prefill_sublayer(
+                    bp, x, kp, vp, head_tables, prior_len)
+            return self.mlp_sublayer(bp, x), (k, v)
+
+        x, (ks, vs) = jax.lax.scan(
+            body, x, (params["blocks"], kv_cache["k"], kv_cache["v"]))
+        kv_cache = GPTModel._paged_tail_write(
+            self, kv_cache, ks, vs, block_tables, prior_len, length)
+        logits = self.head(params["head"], x)[0, length - 1]
+        return logits, kv_cache
+
+    def forward_decode_paged(self, params, token, kv_cache, block_tables, pos):
+        """Same contract as GPTModel.forward_decode_paged."""
+        x = params["embed"]["wte"][token].astype(self.config.dtype)
+
+        def body(x, sl):
+            bp, kp, vp = sl
+            x, kp, vp = self._paged_decode_sublayer(
+                bp, x, kp, vp, block_tables, pos)
+            return self.mlp_sublayer(bp, x), (kp, vp)
+
+        x, (k_new, v_new) = jax.lax.scan(
+            body, x, (params["blocks"], kv_cache["k"], kv_cache["v"]))
+        logits = self.head(params["head"], x[:, None, :])[:, 0]
+        return logits, {"k": k_new, "v": v_new}
+
     # ---- sharding ----
 
     def param_specs(self, *, stacked: bool = True):

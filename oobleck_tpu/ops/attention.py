@@ -158,6 +158,13 @@ def select_attention_impl(impl: str = "auto"):
         from oobleck_tpu.ops.ring_attention import ring_attention
 
         return ring_attention
+    if impl == "paged":
+        # Ragged paged decode over block tables (serving hot path). The
+        # callable has the paged signature (pools + block tables), not the
+        # [B, H, S, D] one; it dispatches pallas/xla internally by backend.
+        from oobleck_tpu.ops.paged_attention import paged_decode_attention
+
+        return paged_decode_attention
     if impl == "ulysses":
         # The Ulysses all-to-all layout only exists under a sequence-
         # parallel mesh axis (models call ops.ulysses directly there);

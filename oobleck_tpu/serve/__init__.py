@@ -26,7 +26,8 @@ import time
 
 from oobleck_tpu.config import ServeArguments
 from oobleck_tpu.serve.batcher import ContinuousBatcher, GenRequest, QueueFull
-from oobleck_tpu.serve.engine import DecodeEngine
+from oobleck_tpu.serve.engine import DecodeEngine, PagedDecodeEngine
+from oobleck_tpu.serve.kv_blocks import BlockAllocator, PagesExhausted
 from oobleck_tpu.serve.reload import (
     CheckpointWatcher,
     load_latest_params,
@@ -36,7 +37,8 @@ from oobleck_tpu.serve.reload import (
 from oobleck_tpu.serve.server import ServeHTTPServer
 
 __all__ = [
-    "CheckpointWatcher", "ContinuousBatcher", "DecodeEngine", "GenRequest",
+    "BlockAllocator", "CheckpointWatcher", "ContinuousBatcher",
+    "DecodeEngine", "GenRequest", "PagedDecodeEngine", "PagesExhausted",
     "QueueFull", "ServeArguments", "ServeHTTPServer", "ServingPlane",
     "load_latest_params", "params_from_payload", "publish_params",
 ]
@@ -97,6 +99,23 @@ class ServingPlane:
 
         return build_model(name, margs)
 
+    def _build_engine(self, model, max_seq: int):
+        """kv_cache="paged" (default): block/paged pool sized to the SAME
+        HBM budget the dense slot cache would take (slots * max_seq
+        tokens), with the decode width (`lanes`) freed from that budget —
+        short requests no longer pay a max_seq reservation. "dense"
+        restores the slot cache."""
+        a = self.args
+        if a.kv_cache == "dense":
+            return DecodeEngine(model, slots=a.slots, max_seq=max_seq)
+        if a.kv_cache != "paged":
+            raise ValueError(f"unknown kv_cache {a.kv_cache!r}")
+        page = a.page_size
+        num_pages = a.kv_pages or max(2, a.slots * max_seq // page)
+        lanes = a.lanes or max(a.slots, min(num_pages - 1, 8 * a.slots))
+        return PagedDecodeEngine(model, lanes=lanes, max_seq=max_seq,
+                                 page_size=page, num_pages=num_pages)
+
     def start(self) -> "ServingPlane":
         step, payload = self._wait_for_checkpoint()
         model = self._resolve_model(payload)
@@ -105,8 +124,7 @@ class ServingPlane:
         if max_seq != self.args.max_seq:
             logger.info("clamping max_seq %d -> model max positions %d",
                         self.args.max_seq, max_seq)
-        self.engine = DecodeEngine(model, slots=self.args.slots,
-                                   max_seq=max_seq)
+        self.engine = self._build_engine(model, max_seq)
         self.engine.set_params(
             self.engine.stage_params(params_from_payload(model, payload)),
             step)

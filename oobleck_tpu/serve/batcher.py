@@ -13,7 +13,16 @@ Admission is a bounded queue — when it is full `submit` rejects
 immediately (backpressure to the client as HTTP 429) instead of
 buffering unboundedly. Each request carries `max_tokens` and an optional
 wall-clock deadline; deadline-expired requests finish with what they
-have rather than starving the batch.
+have rather than starving the batch, and requests that expire while
+still QUEUED are swept at enqueue/admit time under the distinct
+`outcome=deadline_queued` — dead work never consumes a prefill.
+
+Paged engines (PagedDecodeEngine) admit by PAGES available, not lanes
+free: `can_admit` gates each admission on the request's full token span
+fitting the pool (net of its cached prefix), a small FIFO waiting line
+preserves arrival order while capacity frees up (no head-of-line skip),
+and `release` returns a finished request's pages immediately. Dense
+engines lack both hooks and keep the original slots-free discipline.
 """
 
 from __future__ import annotations
@@ -22,10 +31,12 @@ import logging
 import queue
 import threading
 import time
+from collections import deque
 
 import numpy as np
 
 from oobleck_tpu.obs import spans
+from oobleck_tpu.serve.kv_blocks import PagesExhausted
 from oobleck_tpu.utils import background, metrics
 from oobleck_tpu.utils.metrics import SERVE_LATENCY_BUCKETS
 
@@ -82,6 +93,15 @@ class ContinuousBatcher:
         self.engine = engine
         self.default_max_tokens = default_max_tokens
         self._queue: queue.Queue[GenRequest] = queue.Queue(maxsize=max_queue)
+        # Requests pulled off the queue but not yet admittable (paged
+        # engines: waiting for pages). FIFO — no head-of-line skip — and
+        # capped at the lane count so the bounded queue keeps its
+        # backpressure meaning.
+        self._waiting: deque[GenRequest] = deque()
+        # Paged-engine hooks; dense engines (and test fakes) lack them and
+        # keep the original slots-free admission.
+        self._can_admit = getattr(engine, "can_admit", None)
+        self._lane_release = getattr(engine, "release", None)
         self._rng = np.random.default_rng(seed)
         self._slots: list[GenRequest | None] = [None] * engine.slots
         self._token = np.zeros(engine.slots, np.int32)
@@ -123,14 +143,19 @@ class ContinuousBatcher:
     # -- client side ----------------------------------------------------- #
 
     def submit(self, req: GenRequest) -> GenRequest:
-        """Enqueue or reject-now (bounded queue = backpressure)."""
+        """Enqueue or reject-now (bounded queue = backpressure). A request
+        that is ALREADY past its deadline never enters the queue — it
+        finishes as deadline_queued without consuming any capacity."""
+        if req.expired(time.monotonic()):
+            self._finish(req, "deadline_queued")
+            return req
         try:
             self._queue.put_nowait(req)
         except queue.Full:
             self.m_requests.inc(outcome="rejected")
             raise QueueFull(
                 f"admission queue full ({self._queue.maxsize})") from None
-        self.m_queue.set(self._queue.qsize())
+        self.m_queue.set(self.queue_depth)
         return req
 
     def post_swap(self, step: int, device_params) -> None:
@@ -153,7 +178,9 @@ class ContinuousBatcher:
         for i, req in enumerate(self._slots):
             if req is not None:
                 self._finish(req, "shutdown")
-                self._slots[i] = None
+                self._free_lane(i)
+        while self._waiting:
+            self._finish(self._waiting.popleft(), "shutdown")
         while True:
             try:
                 self._finish(self._queue.get_nowait(), "shutdown")
@@ -166,7 +193,7 @@ class ContinuousBatcher:
 
     @property
     def queue_depth(self) -> int:
-        return self._queue.qsize()
+        return self._queue.qsize() + len(self._waiting)
 
     # -- scheduler ------------------------------------------------------- #
 
@@ -251,33 +278,80 @@ class ContinuousBatcher:
         logger.info("hot-reloaded weights to step %d (pause %.6fs, "
                     "%d requests in flight)", step, pause, self.slots_active)
 
+    def _free_lane(self, i: int) -> None:
+        """Clear a lane and (paged engines) return its pages immediately."""
+        self._slots[i] = None
+        if self._lane_release is not None:
+            self._lane_release(i)
+
+    def _pull_waiting(self) -> None:
+        # A small peek-buffer (capped at the lane count) so FIFO order
+        # survives page-capacity waits without draining the bounded
+        # queue's backpressure into an unbounded line.
+        while len(self._waiting) < len(self._slots):
+            try:
+                self._waiting.append(self._queue.get_nowait())
+            except queue.Empty:
+                break
+
+    def _next_admittable(self) -> GenRequest | None:
+        """Head of the waiting line once dead/invalid requests are swept.
+        Returns None when empty OR when the head is waiting on pages —
+        FIFO admission never skips over a starved request."""
+        while True:
+            self._pull_waiting()
+            if not self._waiting:
+                return None
+            req = self._waiting[0]
+            if req.expired(time.monotonic()):
+                # Queue-expired: swept before any prefill, under its own
+                # outcome so dashboards separate dead-on-arrival work from
+                # mid-generation deadline cuts.
+                self._waiting.popleft()
+                self._finish(req, "deadline_queued")
+                continue
+            n = len(req.tokens)
+            if n == 0 or self.engine.bucket_for(n) is None \
+                    or n + req.max_tokens > self.engine.max_seq:
+                self._waiting.popleft()
+                self._finish(req, "too_long")
+                continue
+            if self._can_admit is not None \
+                    and not self._can_admit(req.tokens, req.max_tokens):
+                return None
+            self._waiting.popleft()
+            return req
+
     def _admit(self) -> None:
         for i in range(len(self._slots)):
             if self._slots[i] is not None:
                 continue
-            try:
-                req = self._queue.get_nowait()
-            except queue.Empty:
+            req = self._next_admittable()
+            if req is None:
                 break
-            now = time.monotonic()
-            n = len(req.tokens)
-            if n == 0 or self.engine.bucket_for(n) is None \
-                    or n + req.max_tokens > self.engine.max_seq:
-                self._finish(req, "too_long")
-                continue
-            if req.expired(now):
-                self._finish(req, "deadline")
-                continue
             req.t_admit_wall = time.time()
-            with background.device_work("serve_prefill"):
-                logits = self.engine.prefill(req.tokens, i)
+            try:
+                with background.device_work("serve_prefill"):
+                    if self._can_admit is not None:
+                        logits = self.engine.prefill(
+                            req.tokens, i, max_tokens=req.max_tokens)
+                    else:
+                        logits = self.engine.prefill(req.tokens, i)
+            except PagesExhausted:
+                # can_admit gates admission on the same thread, so this is
+                # a defensive backstop: put the request back at the front
+                # and retry next iteration.
+                self._waiting.appendleft(req)
+                break
             req.t_prefill_wall = time.time()
             now = time.monotonic()
             token = self._sample(logits, req.temperature)
             if not self._emit(req, token, now):
                 self._slots[i] = req
                 self._token[i] = token
-                self._pos[i] = n
+                self._pos[i] = len(req.tokens)
+            elif self._lane_release is not None:
+                self._lane_release(i)
 
     def _decode_step(self) -> None:
         t0 = time.perf_counter()
@@ -292,10 +366,10 @@ class ContinuousBatcher:
             self._pos[i] += 1
             self._token[i] = token
             if self._emit(req, token, now):
-                self._slots[i] = None
+                self._free_lane(i)
 
     def _update_gauges(self) -> None:
-        self.m_queue.set(self._queue.qsize())
+        self.m_queue.set(self.queue_depth)
         self.m_active.set(self.slots_active)
         t_last, n_last = self._tok_window
         now = time.monotonic()
@@ -321,4 +395,4 @@ class ContinuousBatcher:
                 for i, req in enumerate(self._slots):
                     if req is not None:
                         self._finish(req, "error")
-                        self._slots[i] = None
+                        self._free_lane(i)

@@ -1,13 +1,21 @@
-"""Serving microbenchmark: tokens/sec, TTFT, and hot-reload pause.
+"""Serving microbenchmark: open-loop load, paged-KV capacity, reload pause.
 
-Stands up the full serving plane (checkpoint root -> DecodeEngine ->
-batcher -> HTTP) on a tiny model, drives concurrent /v1/generate
-requests, triggers one hot-reload mid-traffic, and reports:
+Two measurements, both CPU-friendly on a tiny model:
 
-  * tokens/sec and TTFT p50/p99 from the registry histograms,
-  * reload pause p99 (the decode-loop stall taken to swap weights)
-    against a full checkpoint-restore latency — the zero-downtime claim
-    is that the pause is the pointer swap, not the restore.
+  1. Full-plane open-loop load: stands up the serving plane (checkpoint
+     root -> PagedDecodeEngine -> batcher -> HTTP), drives /v1/generate
+     with Poisson arrivals, mixed prompt lengths, and a shared-prefix
+     fraction, triggers one hot-reload mid-traffic, and reports
+     tokens/sec, TTFT p50/p99, reload pause vs full restore, and the
+     prefix cache hit rate the shared-prefix mix earned.
+
+  2. Equal-HBM capacity probe: a dense slot cache and a paged pool of the
+     SAME byte budget (slots * max_seq tokens == num_pages * page_size
+     tokens) each take a burst of short requests; the peak concurrent
+     in-flight count after one admission pass is what that budget
+     sustains. Dense reserves max_seq per request, paged reserves the
+     request's true span — the gap is the paged-KV claim, reported as
+     `concurrent_requests_sustained` and `kv_bytes_per_token`.
 
 Standalone:  python -m oobleck_tpu.serve.bench
 Embedded:    bench.py folds the result under its "serve" key.
@@ -22,6 +30,7 @@ import threading
 import time
 
 import jax
+import numpy as np
 
 from oobleck_tpu.utils import metrics
 
@@ -37,12 +46,128 @@ def _percentiles(hist, q50=0.5, q99=0.99) -> dict:
     }
 
 
-def measure_serve(root: str | None = None, *, model_name: str = "gpt2-tiny",
-                  slots: int = 2, max_seq: int = 64, requests: int = 8,
-                  gen_tokens: int = 12) -> dict:
-    """End-to-end serve numbers on a tiny model (CPU-friendly)."""
+def _cache_nbytes(cache) -> int:
+    return sum(int(x.nbytes) for x in jax.tree.leaves(cache))
+
+
+def _burst_capacity(engine, *, n_requests: int, prompt_len: int,
+                    gen_tokens: int) -> tuple[int, int]:
+    """(peak concurrent in-flight, completed) for a burst of short
+    requests against one engine. The batcher's scheduler thread is never
+    started — `_admit`/`_decode_step` are driven directly, so the peak
+    after the first admission pass is deterministic, not a sampling
+    artifact."""
+    from oobleck_tpu.serve.batcher import ContinuousBatcher, GenRequest
+
+    b = ContinuousBatcher(engine, max_queue=n_requests)
+    reqs = [b.submit(GenRequest(
+        [1 + (i * prompt_len + j) % 97 for j in range(prompt_len)],
+        max_tokens=gen_tokens)) for i in range(n_requests)]
+    b._admit()
+    peak = b.slots_active
+    for _ in range(50 * n_requests):
+        if all(r.done.is_set() for r in reqs):
+            break
+        b._admit()
+        if b.slots_active:
+            b._decode_step()
+        peak = max(peak, b.slots_active)
+    done = sum(1 for r in reqs if r.finish_reason == "length")
+    b.stop()
+    return peak, done
+
+
+def measure_kv_capacity(model_name: str = "gpt2-tiny", *,
+                        dense_slots: int = 2, max_seq: int = 64,
+                        page_size: int = 8) -> dict:
+    """Equal-HBM concurrency: dense `slots x max_seq` vs a paged pool of
+    the same token count (`num_pages * page_size`), loaded with requests
+    whose true span is one page (prompt 4 + 4 generated)."""
+    from oobleck_tpu.models import build_model
+    from oobleck_tpu.serve.engine import DecodeEngine, PagedDecodeEngine
+
+    model = build_model(model_name, {"num_layers": 2})
+    params = model.init_params(jax.random.PRNGKey(0))
+
+    num_pages = dense_slots * max_seq // page_size   # same token budget
+    span = 8                                          # 4 prompt + 4 generated
+    burst = num_pages + 4                             # oversubscribe both
+
+    dense = DecodeEngine(model, slots=dense_slots, max_seq=max_seq)
+    dense.set_params(dense.stage_params(params), 1)
+    dense_peak, dense_done = _burst_capacity(
+        dense, n_requests=burst, prompt_len=4, gen_tokens=4)
+    dense_bytes = _cache_nbytes(dense.cache)
+
+    paged = PagedDecodeEngine(model, lanes=num_pages - 1, max_seq=max_seq,
+                              page_size=page_size, num_pages=num_pages)
+    paged.set_params(paged.stage_params(params), 1)
+    paged_peak, paged_done = _burst_capacity(
+        paged, n_requests=burst, prompt_len=4, gen_tokens=4)
+    paged_bytes = _cache_nbytes(paged.cache)
+
+    return {
+        "budget_tokens": dense_slots * max_seq,
+        "request_span_tokens": span,
+        "burst_requests": burst,
+        "completed_dense": dense_done,
+        "completed_paged": paged_done,
+        # Peak concurrent in-flight requests the budget sustains.
+        "concurrent_requests_sustained": paged_peak,
+        "concurrent_requests_sustained_dense": dense_peak,
+        "concurrency_gain": round(paged_peak / max(dense_peak, 1), 2),
+        # Cache HBM per concurrently-LIVE token at that peak: dense pays
+        # for max_seq reservations, paged for true spans.
+        "kv_bytes_per_token": round(paged_bytes / (paged_peak * span), 1),
+        "kv_bytes_per_token_dense": round(
+            dense_bytes / (max(dense_peak, 1) * span), 1),
+    }
+
+
+def _open_loop(port: int, *, n_requests: int, rate_hz: float,
+               shared_frac: float, gen_tokens: int, seed: int = 0) -> dict:
+    """Open-loop Poisson arrivals against /v1/generate: each request fires
+    at its arrival time regardless of completions (no closed-loop
+    self-throttling). A `shared_frac` fraction of prompts opens with a
+    fixed 20-token head (> one 16-token page) so the prefix cache has
+    something to earn; lengths are otherwise mixed."""
     import http.client
 
+    rng = np.random.default_rng(seed)
+    shared_head = [7 + i for i in range(20)]
+    outcomes: list[int] = []
+
+    def one_request(tokens: list[int]) -> None:
+        conn = http.client.HTTPConnection("127.0.0.1", port, timeout=60)
+        body = json.dumps({"tokens": tokens, "max_tokens": gen_tokens})
+        conn.request("POST", "/v1/generate", body,
+                     {"Content-Type": "application/json"})
+        resp = conn.getresponse()
+        out = json.loads(resp.read())
+        conn.close()
+        if resp.status != 200:
+            raise RuntimeError(f"generate failed: {resp.status} {out}")
+        outcomes.append(len(out["tokens"]))
+
+    threads = []
+    for _ in range(n_requests):
+        if rng.random() < shared_frac:
+            tail = [int(t) for t in rng.integers(1, 90, rng.integers(2, 9))]
+            tokens = shared_head + tail
+        else:
+            tokens = [int(t) for t in rng.integers(1, 90, rng.integers(4, 25))]
+        t = threading.Thread(target=one_request, args=(tokens,))
+        t.start()
+        threads.append(t)
+        time.sleep(float(rng.exponential(1.0 / rate_hz)))
+    return {"threads": threads, "outcomes": outcomes}
+
+
+def measure_serve(root: str | None = None, *, model_name: str = "gpt2-tiny",
+                  slots: int = 2, max_seq: int = 64, requests: int = 12,
+                  gen_tokens: int = 12, shared_frac: float = 0.5,
+                  rate_hz: float = 40.0) -> dict:
+    """End-to-end serve numbers on a tiny model (CPU-friendly)."""
     from oobleck_tpu.models import build_model
     from oobleck_tpu.serve import (
         ServeArguments,
@@ -65,39 +190,28 @@ def measure_serve(root: str | None = None, *, model_name: str = "gpt2-tiny",
         load_latest_params(tmp, model)
         restore_s = time.perf_counter() - t0
 
+        # Pool sized with headroom so the prefix-hit measurement reflects
+        # the cache, not allocation churn evicting the shared head.
         plane = ServingPlane(
             tmp, model=model,
             args=ServeArguments(port=0, slots=slots, max_seq=max_seq,
-                                reload_secs=0.1)).start()
+                                reload_secs=0.1, page_size=16, kv_pages=32,
+                                lanes=8)).start()
         port = plane.server.port
-
-        def one_request(prompt_len: int) -> int:
-            conn = http.client.HTTPConnection("127.0.0.1", port, timeout=60)
-            body = json.dumps({
-                "tokens": list(range(1, prompt_len + 1)),
-                "max_tokens": gen_tokens,
-            })
-            conn.request("POST", "/v1/generate", body,
-                         {"Content-Type": "application/json"})
-            resp = conn.getresponse()
-            out = json.loads(resp.read())
-            conn.close()
-            if resp.status != 200:
-                raise RuntimeError(f"generate failed: {resp.status} {out}")
-            return len(out["tokens"])
+        eng = plane.engine
+        hits0 = eng.m_prefix_hits.value() if hasattr(eng, "m_prefix_hits") \
+            else None
+        cached0 = eng.m_cached_tokens.value() if hits0 is not None else None
 
         t0 = time.perf_counter()
-        counts: list[int] = []
-        threads = [threading.Thread(
-            target=lambda i=i: counts.append(one_request(4 + (i % 5))))
-            for i in range(requests)]
-        for t in threads:
-            t.start()
+        load = _open_loop(port, n_requests=requests, rate_hz=rate_hz,
+                          shared_frac=shared_frac, gen_tokens=gen_tokens)
         # Trigger a hot-reload mid-traffic.
         publish_params(tmp, model, params, step=2, model_name=model_name)
-        for t in threads:
+        for t in load["threads"]:
             t.join()
         wall = time.perf_counter() - t0
+        counts = load["outcomes"]
         deadline = time.monotonic() + 30
         while plane.batcher.m_reloads.value() < 1 \
                 and time.monotonic() < deadline:
@@ -106,8 +220,10 @@ def measure_serve(root: str | None = None, *, model_name: str = "gpt2-tiny",
         b = plane.batcher
         out = {
             "model": model_name,
+            "kv_cache": plane.args.kv_cache,
             "slots": slots,
             "requests": requests,
+            "shared_prefix_frac": shared_frac,
             "tokens": int(sum(counts)),
             "tokens_per_sec": round(sum(counts) / max(wall, 1e-9), 2),
             "ttft_s": _percentiles(b.m_ttft),
@@ -116,9 +232,16 @@ def measure_serve(root: str | None = None, *, model_name: str = "gpt2-tiny",
             "reload_pause_s": _percentiles(b.m_reload_pause),
             "full_restore_s": round(restore_s, 6),
         }
+        if hits0 is not None:
+            done = max(len(counts), 1)
+            out["prefix_hit_rate"] = round(
+                (eng.m_prefix_hits.value() - hits0) / done, 4)
+            out["prefix_cached_tokens"] = int(
+                eng.m_cached_tokens.value() - cached0)
         pause_p99 = out["reload_pause_s"]["p99"]
         if pause_p99 is not None and restore_s > 0:
             out["reload_pause_vs_restore"] = round(pause_p99 / restore_s, 4)
+        out.update(measure_kv_capacity(model_name))
         return out
     finally:
         if plane is not None:

@@ -1,15 +1,28 @@
-"""Decode engine: jitted prefill/decode over a slot-structured KV cache.
+"""Decode engines: jitted prefill/decode over dense-slot or paged KV state.
 
-One DecodeEngine owns the device-side serving state for one model: the
-current weights (swappable between decode steps), the preallocated KV
-cache (`[L, slots, H, max_seq, D]`, donated through every jitted call so
-XLA updates it in place), and the compiled prefill/decode executables.
+An engine owns the device-side serving state for one model: the current
+weights (swappable between decode steps), the KV cache, and the compiled
+prefill/decode executables. Two cache disciplines:
+
+  DecodeEngine       dense slots — `[L, slots, H, max_seq, D]`, HBM per
+                     slot scales with max_seq regardless of actual
+                     lengths. Kept as the baseline the paged bench gate
+                     compares against.
+  PagedDecodeEngine  block/paged — `[L, N_pages, Hkv, page, D]` pool,
+                     per-request page chains (serve/kv_blocks.py), ragged
+                     paged attention (ops/paged_attention.py), prefix
+                     reuse. HBM per request is its true token span, so
+                     concurrency is bounded by total live tokens, not by
+                     a handful of max_seq reservations.
 
 Prompt lengths are padded to a small set of power-of-two buckets so the
 number of distinct prefill programs is O(log max_seq) instead of one per
 prompt length; both program families route through the PR 1 persistent
 compilation cache (`utils/compile_cache.ensure_persistent_cache`) so a
-server cold-start deserializes instead of recompiling.
+server cold-start deserializes instead of recompiling. The paged engine
+additionally buckets cached-head page counts (prefix hits) the same way;
+head-bucket programs compile lazily on first hit and persist like the
+rest.
 
 All engine methods must be called from ONE thread (the batcher's): the
 jitted calls donate the cache buffers, so a concurrent caller would race
@@ -28,6 +41,13 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
+from oobleck_tpu.serve.kv_blocks import (
+    GARBAGE_PAGE,
+    BlockAllocator,
+    PagesExhausted,
+    pages_for,
+)
+from oobleck_tpu.utils import metrics
 from oobleck_tpu.utils.compile_cache import (
     cache_event,
     ensure_persistent_cache,
@@ -47,13 +67,12 @@ def default_prefill_buckets(max_seq: int, smallest: int = 16) -> tuple[int, ...]
     return tuple(out)
 
 
-class DecodeEngine:
-    """Device-side serving state: weights + KV cache + compiled steps."""
+class _EngineBase:
+    """Weights + compile-cache plumbing shared by both cache disciplines."""
 
-    def __init__(self, model, *, slots: int, max_seq: int,
+    def __init__(self, model, *, max_seq: int,
                  prefill_buckets: tuple[int, ...] | None = None):
         self.model = model
-        self.slots = int(slots)
         self.max_seq = int(max_seq)
         if max_seq > model.config.max_position_embeddings:
             raise ValueError(
@@ -84,18 +103,7 @@ class DecodeEngine:
 
         self.params = None          # device-resident fused tree
         self.params_step: int = -1  # checkpoint step the weights came from
-        self.cache = model.init_kv_cache(self.slots, self.max_seq)
         self._stage_lock = threading.Lock()
-
-        # argnums: 0=params, 1=cache (donated), rest per call.
-        self._decode_fn = jax.jit(
-            lambda p, cache, token, pos:
-                model.forward_decode(p, token, cache, pos),
-            donate_argnums=(1,))
-        self._prefill_fn = jax.jit(
-            lambda p, cache, tokens, slot, length:
-                model.forward_prefill(p, tokens, cache, slot, length),
-            donate_argnums=(1,))
 
     # -- weights -------------------------------------------------------- #
 
@@ -143,6 +151,33 @@ class DecodeEngine:
             cache_event("serve_hit" if after == before else "serve_miss")
         return out
 
+    def bucket_for(self, n: int) -> int | None:
+        for b in self.prefill_buckets:
+            if n <= b:
+                return b
+        return None
+
+
+class DecodeEngine(_EngineBase):
+    """Dense-slot serving state: weights + slot KV cache + compiled steps."""
+
+    def __init__(self, model, *, slots: int, max_seq: int,
+                 prefill_buckets: tuple[int, ...] | None = None):
+        super().__init__(model, max_seq=max_seq,
+                         prefill_buckets=prefill_buckets)
+        self.slots = int(slots)
+        self.cache = model.init_kv_cache(self.slots, self.max_seq)
+
+        # argnums: 0=params, 1=cache (donated), rest per call.
+        self._decode_fn = jax.jit(
+            lambda p, cache, token, pos:
+                model.forward_decode(p, token, cache, pos),
+            donate_argnums=(1,))
+        self._prefill_fn = jax.jit(
+            lambda p, cache, tokens, slot, length:
+                model.forward_prefill(p, tokens, cache, slot, length),
+            donate_argnums=(1,))
+
     def warmup(self) -> int:
         """Compile the decode step and every prefill bucket up front (cold
         starts pay compiles at startup, not on the first request). Returns
@@ -166,12 +201,6 @@ class DecodeEngine:
 
     # -- steps (batcher thread only) ------------------------------------ #
 
-    def bucket_for(self, n: int) -> int | None:
-        for b in self.prefill_buckets:
-            if n <= b:
-                return b
-        return None
-
     def prefill(self, tokens: list[int], slot: int) -> np.ndarray:
         """Run one request's prompt into `slot`; returns next-token logits
         [V] as a host array."""
@@ -192,4 +221,214 @@ class DecodeEngine:
         logits, self.cache = self._decode_fn(
             self.params, self.cache,
             jnp.asarray(token, jnp.int32), jnp.asarray(pos, jnp.int32))
+        return np.asarray(logits)
+
+
+def default_head_buckets(max_pages: int) -> tuple[int, ...]:
+    """Power-of-two cached-head page-count buckets: one jitted tail-prefill
+    program per (tail bucket, head bucket) pair actually seen."""
+    out = [1]
+    while out[-1] < max_pages:
+        out.append(min(out[-1] * 2, max_pages))
+    return tuple(dict.fromkeys(out))
+
+
+class PagedDecodeEngine(_EngineBase):
+    """Paged serving state: page pool + block tables + prefix reuse.
+
+    `lanes` is the decode batch width (the analogue of dense `slots`, but
+    cheap: a lane is two int arrays, not a max_seq KV reservation), exposed
+    as `.slots` so the batcher drives both engines identically. Admission
+    capacity is PAGES: `can_admit` answers whether a request's full token
+    span (prompt + max_tokens, minus its cached prefix) fits the pool, and
+    `release` returns a finished request's pages immediately."""
+
+    def __init__(self, model, *, lanes: int, max_seq: int,
+                 page_size: int = 16, num_pages: int = 0,
+                 prefill_buckets: tuple[int, ...] | None = None):
+        super().__init__(model, max_seq=max_seq,
+                         prefill_buckets=prefill_buckets)
+        self.page_size = int(page_size)
+        if num_pages <= 0:
+            raise ValueError("num_pages must be explicit and positive")
+        self.num_pages = int(num_pages)
+        self.slots = self.lanes = int(lanes)
+        self.table_pages = pages_for(self.max_seq, self.page_size)
+        self.head_buckets = default_head_buckets(self.table_pages)
+
+        self.allocator = BlockAllocator(self.num_pages, self.page_size)
+        self.cache = model.init_paged_kv_cache(self.num_pages, self.page_size)
+        # Host-side lane state; device tables rebuilt per call (tiny int32).
+        self.tables = np.full((self.lanes, self.table_pages), GARBAGE_PAGE,
+                              np.int32)
+        self._lane_pages: list[list[int]] = [[] for _ in range(self.lanes)]
+
+        self._decode_fn = jax.jit(
+            lambda p, cache, token, tables, pos:
+                model.forward_decode_paged(p, token, cache, tables, pos),
+            donate_argnums=(1,))
+        # One callable; jit retraces per (tail bucket, head bucket) shape
+        # pair. head_tables=None (shape-free) is the no-hit fast path.
+        self._prefill_fn = jax.jit(
+            lambda p, cache, tokens, tables, length:
+                model.forward_prefill_paged(p, tokens, cache, tables, length),
+            donate_argnums=(1,))
+        self._prefill_head_fn = jax.jit(
+            lambda p, cache, tokens, tables, length, head, prior:
+                model.forward_prefill_paged(
+                    p, tokens, cache, tables, length,
+                    head_tables=head, prior_len=prior),
+            donate_argnums=(1,))
+
+        reg = metrics.registry()
+        self.m_pages_in_use = reg.gauge(
+            "oobleck_serve_kv_pages_in_use", "KV pool pages owned by requests")
+        self.m_pages_free = reg.gauge(
+            "oobleck_serve_kv_pages_free", "KV pool pages on the free list")
+        self.m_prefix_hits = reg.counter(
+            "oobleck_serve_prefix_hits_total",
+            "Prefills that reused at least one cached prefix page")
+        self.m_prompt_tokens = reg.counter(
+            "oobleck_serve_prompt_tokens_total", "Prompt tokens admitted")
+        self.m_cached_tokens = reg.counter(
+            "oobleck_serve_prefix_cached_tokens_total",
+            "Prompt tokens served from cached prefix pages (prefill skipped)")
+        self._set_page_gauges()
+
+    def _set_page_gauges(self) -> None:
+        self.m_pages_in_use.set(self.allocator.pages_in_use)
+        self.m_pages_free.set(self.allocator.free_pages)
+
+    # -- admission capacity (batcher thread only) ------------------------ #
+
+    def can_admit(self, tokens: list[int], max_tokens: int) -> bool:
+        """Whether prompt + max_tokens fits the pool right now, net of the
+        request's cached prefix. Single-threaded with prefill, so a True
+        answer cannot be raced stale."""
+        need = pages_for(len(tokens) + max_tokens, self.page_size)
+        need -= self.allocator.peek_prefix(tokens) // self.page_size
+        return self.allocator.can_allocate(need)
+
+    def release(self, lane: int) -> None:
+        """Return a finished request's pages (refcounted: pages shared with
+        a live prefix stay resident). Incremental — runs per finish, not
+        per batch."""
+        if self._lane_pages[lane]:
+            self.allocator.release(self._lane_pages[lane])
+            self._lane_pages[lane] = []
+        self.tables[lane] = GARBAGE_PAGE
+        self._set_page_gauges()
+
+    def _head_bucket(self, n: int) -> int:
+        for b in self.head_buckets:
+            if n <= b:
+                return b
+        raise ValueError(f"cached head of {n} pages exceeds table "
+                         f"{self.table_pages}")
+
+    # -- steps (batcher thread only) ------------------------------------ #
+
+    def warmup(self) -> int:
+        """Compile the decode step, every no-hit prefill bucket, and the
+        smallest prefix-hit variant. Remaining (tail, head) pairs compile
+        lazily on first hit and persist like the rest. Requires weights."""
+        assert self.params is not None, "set_params before warmup"
+        n = 0
+        tables = jnp.zeros((self.table_pages,), jnp.int32)
+        for b in self.prefill_buckets:
+            tokens = jnp.zeros((1, b), jnp.int32)
+            logits, self.cache = self._classified(
+                lambda t=tokens: self._prefill_fn(
+                    self.params, self.cache, t, tables, jnp.int32(1)))
+            n += 1
+        head = jnp.zeros((self.head_buckets[0],), jnp.int32)
+        tokens = jnp.zeros((1, self.prefill_buckets[0]), jnp.int32)
+        logits, self.cache = self._classified(
+            lambda: self._prefill_head_fn(
+                self.params, self.cache, tokens, tables, jnp.int32(1),
+                head, jnp.int32(0)))
+        n += 1
+        token = np.zeros((self.lanes,), np.int32)
+        pos = np.zeros((self.lanes,), np.int32)
+        (logits, self.cache) = self._classified(
+            lambda: self._decode_fn(
+                self.params, self.cache, jnp.asarray(token),
+                jnp.asarray(self.tables), jnp.asarray(pos)))
+        n += 1
+        logger.info(
+            "paged serve warmup: %d programs (buckets %s, head buckets %s, "
+            "%d pages x %d), cache dir %s", n, self.prefill_buckets,
+            self.head_buckets, self.num_pages, self.page_size,
+            self.compile_cache_dir)
+        return n
+
+    def prefill(self, tokens: list[int], lane: int, *,
+                max_tokens: int = 0) -> np.ndarray:
+        """Admit one request into `lane`: match its cached prefix, reserve
+        pages for its full span, prefill only the uncached tail, and
+        register the prompt's full pages for future reuse. Returns
+        next-token logits [V] on host. Raises PagesExhausted (allocation
+        untouched) when the pool cannot hold the span — callers gate on
+        `can_admit` so this is a defensive backstop."""
+        n = len(tokens)
+        head_pages, cached_len = self.allocator.match_prefix(tokens)
+        tail = tokens[cached_len:]
+        b = self.bucket_for(len(tail))
+        if b is None:
+            self.allocator.release(head_pages)
+            raise ValueError(f"prompt length {n} exceeds max_seq {self.max_seq}")
+        try:
+            fresh = self.allocator.allocate(
+                pages_for(n + max_tokens, self.page_size) - len(head_pages))
+        except PagesExhausted:
+            self.allocator.release(head_pages)
+            raise
+        table = head_pages + fresh
+
+        self.m_prompt_tokens.inc(n)
+        if cached_len:
+            self.m_prefix_hits.inc()
+            self.m_cached_tokens.inc(cached_len)
+        # Defensive CoW: the first tail write lands on the first fresh page
+        # (cached_len is page-aligned), so shared pages are never written in
+        # the natural flow — but if that invariant ever breaks, copy rather
+        # than corrupt a neighbor's prefix.
+        moved = self.allocator.make_writable(
+            table, cached_len // self.page_size)
+        if moved is not None:
+            src, dst = moved
+            self.cache = {
+                "k": self.cache["k"].at[:, dst].set(self.cache["k"][:, src]),
+                "v": self.cache["v"].at[:, dst].set(self.cache["v"][:, src]),
+            }
+
+        padded = np.zeros((1, b), np.int32)
+        padded[0, :len(tail)] = tail
+        dev_table = np.full((self.table_pages,), GARBAGE_PAGE, np.int32)
+        dev_table[:len(table)] = table
+        if cached_len:
+            hb = self._head_bucket(len(head_pages))
+            head = np.full((hb,), GARBAGE_PAGE, np.int32)
+            head[:len(head_pages)] = head_pages
+            logits, self.cache = self._prefill_head_fn(
+                self.params, self.cache, jnp.asarray(padded),
+                jnp.asarray(dev_table), jnp.int32(len(tail)),
+                jnp.asarray(head), jnp.int32(cached_len))
+        else:
+            logits, self.cache = self._prefill_fn(
+                self.params, self.cache, jnp.asarray(padded),
+                jnp.asarray(dev_table), jnp.int32(len(tail)))
+
+        self.allocator.register_chain(tokens, table)
+        self._lane_pages[lane] = table
+        self.tables[lane] = dev_table
+        self._set_page_gauges()
+        return np.asarray(logits)
+
+    def decode(self, token: np.ndarray, pos: np.ndarray) -> np.ndarray:
+        """One ragged decode step over ALL lanes (inactive lanes ride the
+        garbage page harmlessly); returns logits [lanes, V] on host."""
+        logits, self.cache = self._decode_fn(
+            self.params, self.cache, jnp.asarray(token, jnp.int32),
+            jnp.asarray(self.tables), jnp.asarray(pos, jnp.int32))
         return np.asarray(logits)

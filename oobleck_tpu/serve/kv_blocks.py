@@ -1,0 +1,199 @@
+"""Block/paged KV allocation for the serving plane.
+
+Host-side bookkeeping for the device page pool (`[L, N_pages, Hkv, page,
+D]`, see ops/paged_attention.py): a free list of fixed-size pages,
+per-page refcounts, and per-request block tables. HBM per request is
+`ceil((prompt + max_tokens) / page)` pages instead of a dense max_seq
+slot, so concurrency is bounded by TOTAL live tokens, not request count.
+
+Prefix caching: every FULL page a prompt fills is registered under the
+rolling hash of the token chain it closes (h_i = hash(h_{i-1}, page_i's
+tokens) — position-dependent by construction, so equal page content at
+different depths never collides). A later prompt sharing that head walks
+the chain, pins the matched pages (refcount++), and skips prefill
+compute for them. Freed pages KEEP their registration until the free
+list hands them out again (FIFO ≈ LRU eviction), so a popular prefix
+survives its first requester.
+
+Copy-on-write: shared pages are read-only; `make_writable` gives a
+request a private copy of a page it must write (the device-side copy is
+the caller's job — the allocator only manages identity/refcounts and
+reports whether a copy is needed).
+
+Page 0 is RESERVED as the garbage page: inactive decode lanes park their
+block tables on it, bucket-padding writes land on it, and it is never
+allocated — so stray writes can never corrupt a live request's KV.
+"""
+
+from __future__ import annotations
+
+from collections import OrderedDict, deque
+from collections.abc import Sequence
+
+GARBAGE_PAGE = 0
+
+
+class PagesExhausted(Exception):
+    """No free pages for the requested allocation; callers queue or shed."""
+
+
+def pages_for(tokens: int, page_size: int) -> int:
+    """Pages needed to hold `tokens` positions."""
+    return -(-tokens // page_size)
+
+
+class BlockAllocator:
+    """Free-list page allocator with refcounts and prefix-chain cache."""
+
+    def __init__(self, num_pages: int, page_size: int):
+        if num_pages < 2:
+            raise ValueError("need at least 2 pages (page 0 is reserved)")
+        if page_size < 1:
+            raise ValueError("page_size must be >= 1")
+        self.num_pages = int(num_pages)
+        self.page_size = int(page_size)
+        # Insertion-ordered: oldest-freed first, so reallocating evicts
+        # the least-recently-used cached prefix pages.
+        self._free: OrderedDict[int, None] = OrderedDict(
+            (p, None) for p in range(1, num_pages))
+        self._ref = [0] * num_pages
+        self._chain_to_page: dict[int, int] = {}   # chain hash -> page id
+        self._page_to_chain: dict[int, int] = {}   # reverse, for eviction
+        self.cow_copies = 0
+
+    # -- introspection --------------------------------------------------- #
+
+    @property
+    def free_pages(self) -> int:
+        return len(self._free)
+
+    @property
+    def pages_in_use(self) -> int:
+        return (self.num_pages - 1) - len(self._free)
+
+    def refcount(self, page: int) -> int:
+        return self._ref[page]
+
+    # -- allocation ------------------------------------------------------ #
+
+    def can_allocate(self, n_pages: int) -> bool:
+        return n_pages <= len(self._free)
+
+    def allocate(self, n_pages: int) -> list[int]:
+        """Take `n_pages` off the free list (oldest-freed first); raises
+        PagesExhausted without side effects when short."""
+        if n_pages > len(self._free):
+            raise PagesExhausted(
+                f"need {n_pages} pages, {len(self._free)} free "
+                f"(pool {self.num_pages - 1})")
+        out = []
+        for _ in range(n_pages):
+            page, _ = self._free.popitem(last=False)
+            self._evict_registration(page)
+            self._ref[page] = 1
+            out.append(page)
+        return out
+
+    def ref(self, pages: Sequence[int]) -> None:
+        for p in pages:
+            if p == GARBAGE_PAGE:
+                continue
+            assert self._ref[p] > 0, f"ref of unowned page {p}"
+            self._ref[p] += 1
+
+    def release(self, pages: Sequence[int]) -> None:
+        """Drop one reference per page; refcount 0 returns the page to the
+        free list (registration kept — it may be rediscovered as a cached
+        prefix until the free list recycles the page)."""
+        for p in pages:
+            if p == GARBAGE_PAGE:
+                continue
+            assert self._ref[p] > 0, f"release of unowned page {p}"
+            self._ref[p] -= 1
+            if self._ref[p] == 0:
+                self._free[p] = None
+
+    # -- copy-on-write --------------------------------------------------- #
+
+    def make_writable(self, pages: list[int], idx: int) -> tuple[int, int] | None:
+        """Ensure `pages[idx]` is privately owned before a write.
+
+        Shared (refcount > 1) -> allocate a fresh page, swap it into the
+        table at `idx`, drop one ref on the original, and return
+        (src_page, dst_page) so the caller copies the device bytes.
+        Already-private -> None (no copy needed). The fresh page is NOT
+        registered in the prefix cache: its content diverges at the next
+        write, and a stale registration would hand later prompts wrong
+        keys."""
+        src = pages[idx]
+        if src == GARBAGE_PAGE or self._ref[src] <= 1:
+            return None
+        dst = self.allocate(1)[0]
+        self._ref[src] -= 1          # shared, so never reaches 0 here
+        pages[idx] = dst
+        self.cow_copies += 1
+        return src, dst
+
+    # -- prefix cache ---------------------------------------------------- #
+
+    def _chain_hashes(self, tokens: Sequence[int]) -> list[int]:
+        """Rolling hash per FULL page boundary of `tokens`."""
+        out = []
+        h = 0
+        ps = self.page_size
+        for i in range(len(tokens) // ps):
+            h = hash((h, tuple(tokens[i * ps:(i + 1) * ps])))
+            out.append(h)
+        return out
+
+    def _evict_registration(self, page: int) -> None:
+        h = self._page_to_chain.pop(page, None)
+        if h is not None and self._chain_to_page.get(h) == page:
+            del self._chain_to_page[h]
+
+    def match_prefix(self, tokens: Sequence[int]) -> tuple[list[int], int]:
+        """Longest registered full-page chain covering a head of `tokens`,
+        capped at len(tokens) - 1 so at least one live token always
+        prefills (the tail prefill is what produces next-token logits).
+
+        Returns (pages, cached_tokens); the matched pages are PINNED
+        (refcount++ / pulled off the free list) — the caller must
+        `release` them when the request finishes."""
+        limit = (len(tokens) - 1) // self.page_size
+        pages: list[int] = []
+        for h in self._chain_hashes(tokens)[:limit]:
+            page = self._chain_to_page.get(h)
+            if page is None:
+                break
+            pages.append(page)
+        for p in pages:
+            if self._ref[p] == 0:
+                self._free.pop(p, None)
+            self._ref[p] += 1
+        return pages, len(pages) * self.page_size
+
+    def peek_prefix(self, tokens: Sequence[int]) -> int:
+        """Non-mutating `match_prefix`: the cached token count a request
+        would reuse, without pinning anything. Admission-capacity math."""
+        limit = (len(tokens) - 1) // self.page_size
+        n = 0
+        for h in self._chain_hashes(tokens)[:limit]:
+            if h not in self._chain_to_page:
+                break
+            n += 1
+        return n * self.page_size
+
+    def register_chain(self, tokens: Sequence[int], pages: Sequence[int]) -> None:
+        """Register every full page of `tokens` held in `pages` (the
+        request's block table, cached head included) for future prefix
+        reuse. Last writer wins on hash collisions between live pages —
+        both registrations are valid content, so either is safe to hand
+        out."""
+        for h, page in zip(self._chain_hashes(tokens), pages):
+            if page == GARBAGE_PAGE:
+                break
+            # One registration per page: a page closing chain h holds
+            # exactly the tokens hashing to h.
+            self._evict_registration(page)
+            self._chain_to_page[h] = page
+            self._page_to_chain[page] = h

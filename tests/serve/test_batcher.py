@@ -21,6 +21,7 @@ class FakeEngine:
         self.params = object()
         self.params_step = 1
         self.swaps: list[int] = []
+        self.prefills = 0
 
         class _Cfg:
             vocab_size = vocab
@@ -39,6 +40,7 @@ class FakeEngine:
         return z
 
     def prefill(self, tokens, slot):
+        self.prefills += 1
         return self._logits(tokens[-1])
 
     def decode(self, token, pos):
@@ -118,18 +120,32 @@ def test_oversized_prompt_rejected_at_admission():
 
 
 def test_deadline_expired_request_finishes_early():
+    """A request that expires while still QUEUED is swept under the
+    distinct deadline_queued outcome without generating anything — dead
+    work never consumes a prefill."""
     eng = FakeEngine()
     b = _batcher(eng)
     req = GenRequest([1, 2], max_tokens=10, deadline_s=0.005)
     b.submit(req)
     time.sleep(0.05)  # expire while still queued (scheduler not started)
+    prefills0 = eng.prefills
     b.start()
     try:
         assert req.wait(10)
-        assert req.finish_reason == "deadline"
-        assert len(req.out_tokens) < 10
+        assert req.finish_reason == "deadline_queued"
+        assert req.out_tokens == []
+        assert eng.prefills == prefills0
     finally:
         b.stop()
+
+
+def test_deadline_expired_at_submit_never_enqueues():
+    eng = FakeEngine()
+    b = _batcher(eng)  # scheduler not started: sweep happens in submit
+    req = b.submit(GenRequest([1, 2], max_tokens=4, deadline_s=-1.0))
+    assert req.done.is_set()
+    assert req.finish_reason == "deadline_queued"
+    assert b.queue_depth == 0
 
 
 def test_swap_applies_between_decode_steps():

@@ -1,0 +1,170 @@
+"""BlockAllocator: free list, refcounts, prefix chains, CoW, exhaustion."""
+
+import pytest
+
+from oobleck_tpu.serve.kv_blocks import (
+    GARBAGE_PAGE, BlockAllocator, PagesExhausted, pages_for)
+
+
+def test_pages_for():
+    assert pages_for(1, 16) == 1
+    assert pages_for(16, 16) == 1
+    assert pages_for(17, 16) == 2
+    assert pages_for(0, 16) == 0
+
+
+def test_allocate_never_hands_out_garbage_page():
+    a = BlockAllocator(num_pages=8, page_size=4)
+    pages = a.allocate(7)
+    assert GARBAGE_PAGE not in pages
+    assert sorted(pages) == list(range(1, 8))
+    assert a.free_pages == 0
+
+
+def test_exhaustion_raises_without_side_effects():
+    a = BlockAllocator(num_pages=4, page_size=4)
+    a.allocate(2)
+    before = a.free_pages
+    with pytest.raises(PagesExhausted):
+        a.allocate(2)
+    assert a.free_pages == before
+    assert a.can_allocate(1) and not a.can_allocate(2)
+
+
+def test_release_returns_pages_fifo():
+    a = BlockAllocator(num_pages=8, page_size=4)
+    rest = a.allocate(4)      # drain the never-used pages
+    first = a.allocate(3)
+    a.release(first)
+    a.release(rest)
+    # Oldest-freed reallocated first.
+    assert a.allocate(3) == first
+
+
+def test_refcounts_pin_shared_pages():
+    a = BlockAllocator(num_pages=8, page_size=4)
+    pages = a.allocate(2)
+    a.ref(pages)
+    a.release(pages)
+    assert all(a.refcount(p) == 1 for p in pages)
+    assert a.free_pages == 5  # still owned
+    a.release(pages)
+    assert a.free_pages == 7
+
+
+def test_prefix_match_full_pages_only_and_caps_last_token():
+    a = BlockAllocator(num_pages=16, page_size=4)
+    toks = list(range(12))  # 3 full pages
+    pages = a.allocate(3)
+    a.register_chain(toks, pages)
+
+    # Same 12 tokens: cap at len-1 -> only 2 pages (8 tokens) reusable,
+    # the last page must re-prefill to produce logits.
+    hit, cached = a.match_prefix(toks)
+    assert hit == pages[:2] and cached == 8
+    a.release(hit)
+
+    # 13 tokens sharing the 12-token head: all 3 full pages reusable.
+    hit, cached = a.match_prefix(toks + [99])
+    assert hit == pages and cached == 12
+    a.release(hit)
+
+    # Divergent second page: only the first page matches.
+    div = toks[:4] + [77] * 8
+    hit, cached = a.match_prefix(div)
+    assert hit == pages[:1] and cached == 4
+    a.release(hit)
+
+    # Sub-page prompt: nothing to match.
+    assert a.match_prefix(toks[:3]) == ([], 0)
+
+
+def test_match_pins_pages_even_after_owner_released():
+    a = BlockAllocator(num_pages=8, page_size=4)
+    toks = list(range(8))
+    pages = a.allocate(2)
+    a.register_chain(toks, pages)
+    a.release(pages)          # owner gone; pages on free list, still registered
+    assert a.free_pages == 7
+
+    hit, cached = a.match_prefix(toks + list(range(100, 104)))
+    assert hit == pages and cached == 8
+    assert a.free_pages == 5  # pulled back off the free list
+    assert all(a.refcount(p) == 1 for p in pages)
+    a.release(pages)
+
+
+def test_eviction_drops_registration():
+    a = BlockAllocator(num_pages=4, page_size=4)  # 3 usable pages
+    toks = list(range(8))
+    pages = a.allocate(2)
+    a.register_chain(toks, pages)
+    a.release(pages)
+    # Exhaust the pool: the registered pages get recycled.
+    a.allocate(3)
+    hit, cached = a.match_prefix(toks + [9] * 4)
+    assert hit == [] and cached == 0
+
+
+def test_chain_hash_is_position_dependent():
+    a = BlockAllocator(num_pages=8, page_size=2)
+    # Pages [A, A]: same content at depths 0 and 1 must hash differently.
+    toks = [5, 5, 5, 5]
+    pages = a.allocate(2)
+    a.register_chain(toks, pages)
+    # Prompt [5, 5, ...] matches page at depth 0 only when the chain agrees.
+    hit, cached = a.match_prefix([5, 5, 9, 9, 9])
+    assert hit == pages[:1] and cached == 2
+    a.release(hit)
+    # A prompt whose SECOND page is [5, 5] but first differs matches nothing.
+    hit, cached = a.match_prefix([7, 7, 5, 5, 9])
+    assert hit == [] and cached == 0
+
+
+def test_cow_private_page_is_noop():
+    a = BlockAllocator(num_pages=8, page_size=4)
+    table = a.allocate(2)
+    assert a.make_writable(table, 1) is None
+    assert a.cow_copies == 0
+
+
+def test_cow_shared_page_copies():
+    a = BlockAllocator(num_pages=8, page_size=4)
+    table = a.allocate(2)
+    a.ref(table)              # second owner
+    other = list(table)
+    res = a.make_writable(table, 1)
+    assert res is not None
+    src, dst = res
+    assert src == other[1] and dst not in other
+    assert table[1] == dst and table[0] == other[0]
+    assert a.refcount(src) == 1 and a.refcount(dst) == 1
+    assert a.cow_copies == 1
+    a.release(table)
+    a.release(other)
+    assert a.free_pages == 7
+
+
+def test_cow_garbage_page_is_noop():
+    a = BlockAllocator(num_pages=8, page_size=4)
+    table = [GARBAGE_PAGE, GARBAGE_PAGE]
+    assert a.make_writable(table, 0) is None
+    assert table == [GARBAGE_PAGE, GARBAGE_PAGE]
+
+
+def test_register_reallocated_page_replaces_old_registration():
+    a = BlockAllocator(num_pages=4, page_size=4)
+    rest = a.allocate(2)      # drain the never-used pages
+    t1 = list(range(4))
+    p1 = a.allocate(1)
+    a.register_chain(t1, p1)
+    a.release(p1)
+    # Recycle the same page under different tokens.
+    t2 = list(range(10, 14))
+    p2 = a.allocate(1)
+    assert p2 == p1  # FIFO recycled
+    a.register_chain(t2, p2)
+    # Old registration must not resolve to the recycled page.
+    assert a.match_prefix(t1 + [0]) == ([], 0)
+    hit, cached = a.match_prefix(t2 + [0])
+    assert hit == p2 and cached == 4

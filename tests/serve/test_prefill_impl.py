@@ -1,0 +1,42 @@
+"""The serve prefill attention seam: bucketed prefill routes through
+`causal_attention(impl=config.attention_impl)`, whose "auto" default picks
+the Pallas flash kernel on TPU and the XLA path elsewhere. On CPU that
+means "auto" must BE the XLA reference (bit-identical logits for free),
+and the flash kernel (interpreter mode — exactly what the TPU default
+computes) must agree with it through the full engine prefill."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from oobleck_tpu.models import build_model
+from oobleck_tpu.ops.attention import (
+    _xla_causal_attention,
+    select_attention_impl,
+)
+from oobleck_tpu.serve.engine import DecodeEngine
+
+PROMPT = [3, 7, 1, 9, 4]
+
+
+def test_auto_resolves_to_xla_reference_on_cpu():
+    assert jax.default_backend() != "tpu"
+    assert select_attention_impl("auto") is _xla_causal_attention
+
+
+@pytest.mark.parametrize("name", ["gpt2-tiny", "bloom-tiny"])
+def test_bucketed_prefill_flash_matches_xla(name):
+    """Same weights, one engine per impl: the bucket-padded serve prefill
+    under the flash kernel (pallas, interpret mode off-TPU; in-kernel
+    ALiBi slopes for bloom) produces the XLA path's logits."""
+    logits = {}
+    for impl in ("xla", "pallas"):
+        model = build_model(name, {"dtype": jnp.float32,
+                                   "attention_impl": impl})
+        params = model.init_params(jax.random.PRNGKey(0))
+        eng = DecodeEngine(model, slots=1, max_seq=32)
+        eng.set_params(eng.stage_params(params), 1)
+        logits[impl] = eng.prefill(PROMPT, 0)
+    assert int(np.argmax(logits["pallas"])) == int(np.argmax(logits["xla"]))
+    np.testing.assert_allclose(logits["pallas"], logits["xla"], atol=2e-5)

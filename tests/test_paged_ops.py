@@ -1,0 +1,147 @@
+"""Paged decode attention kernel tests: the Pallas kernel (interpreter mode
+on CPU) must match the pure-XLA gather-then-mask reference for ragged
+lengths, GQA pools, ALiBi slopes, and block-table gathers — plus the
+cache-write scatter and the `select_attention_impl("paged")` seam."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from oobleck_tpu.ops.attention import select_attention_impl
+from oobleck_tpu.ops.paged_attention import (
+    _paged_decode_pallas,
+    _paged_decode_xla,
+    paged_cache_write,
+    paged_decode_attention,
+    paged_gather_kv,
+)
+
+PAGE = 8
+
+
+def _setup(b=3, hq=4, hkv=4, d=16, n_pages=16, p=4, seed=0):
+    ks = jax.random.split(jax.random.PRNGKey(seed), 4)
+    q = jax.random.normal(ks[0], (b, hq, d), jnp.float32)
+    k_pool = jax.random.normal(ks[1], (n_pages, hkv, PAGE, d), jnp.float32)
+    v_pool = jax.random.normal(ks[2], (n_pages, hkv, PAGE, d), jnp.float32)
+    # Disjoint per-lane chains (live lanes never alias pages).
+    bt = (1 + jnp.arange(b * p, dtype=jnp.int32)).reshape(b, p)
+    return q, k_pool, v_pool, bt
+
+
+@pytest.mark.parametrize("lengths", [[32, 32, 32], [5, 17, 32], [1, 9, 24]])
+def test_pallas_matches_xla_ragged(lengths):
+    q, k_pool, v_pool, bt = _setup()
+    ln = jnp.asarray(lengths, jnp.int32)
+    ref = _paged_decode_xla(q, k_pool, v_pool, bt, ln)
+    got = _paged_decode_pallas(q, k_pool, v_pool, bt, ln)
+    np.testing.assert_allclose(got, ref, atol=2e-6, rtol=2e-6)
+
+
+def test_pallas_matches_xla_gqa():
+    q, k_pool, v_pool, bt = _setup(hq=8, hkv=2)
+    ln = jnp.asarray([7, 19, 30], jnp.int32)
+    ref = _paged_decode_xla(q, k_pool, v_pool, bt, ln)
+    got = _paged_decode_pallas(q, k_pool, v_pool, bt, ln)
+    np.testing.assert_allclose(got, ref, atol=2e-6, rtol=2e-6)
+
+
+def test_pallas_matches_xla_alibi():
+    from oobleck_tpu.ops.attention import alibi_slopes
+
+    q, k_pool, v_pool, bt = _setup(hq=4, hkv=4)
+    slopes = alibi_slopes(4)
+    ln = jnp.asarray([6, 13, 27], jnp.int32)
+    ref = _paged_decode_xla(q, k_pool, v_pool, bt, ln, alibi_slopes=slopes)
+    got = _paged_decode_pallas(q, k_pool, v_pool, bt, ln, alibi_slopes=slopes)
+    np.testing.assert_allclose(got, ref, atol=2e-6, rtol=2e-6)
+
+
+def test_zero_length_lane_no_nan():
+    """Inactive lanes (length 0) must produce finite garbage, not NaN —
+    they sit in every ragged decode batch."""
+    q, k_pool, v_pool, bt = _setup()
+    ln = jnp.asarray([0, 11, 0], jnp.int32)
+    for fn in (_paged_decode_xla, _paged_decode_pallas):
+        out = fn(q, k_pool, v_pool, bt, ln)
+        assert bool(jnp.all(jnp.isfinite(out))), fn.__name__
+
+
+def test_stale_page_bytes_are_masked():
+    """Keys past a lane's length live in pages owned by the lane but not
+    yet written (stale bytes from freed requests) — scribbling them must
+    not change the output."""
+    q, k_pool, v_pool, bt = _setup(b=1, p=2)
+    ln = jnp.asarray([5], jnp.int32)
+    ref = _paged_decode_xla(q, k_pool, v_pool, bt, ln)
+    # Scribble everything at positions >= 5 of the lane's chain.
+    k2 = k_pool.at[bt[0, 0], :, 5:, :].set(1e4).at[bt[0, 1]].set(-1e4)
+    v2 = v_pool.at[bt[0, 0], :, 5:, :].set(1e4).at[bt[0, 1]].set(-1e4)
+    for fn in (_paged_decode_xla, _paged_decode_pallas):
+        np.testing.assert_allclose(fn(q, k2, v2, bt, ln), ref,
+                                   atol=2e-6, rtol=2e-6, err_msg=fn.__name__)
+
+
+def test_gather_layout():
+    """paged_gather_kv places entry i of page block_tables[b, p] at
+    position p*PAGE + i."""
+    _, k_pool, _, _ = _setup(b=1)
+    bt = jnp.asarray([[3, 1]], jnp.int32)
+    out = paged_gather_kv(k_pool, bt)
+    np.testing.assert_array_equal(out[0, :, :PAGE], k_pool[3])
+    np.testing.assert_array_equal(out[0, :, PAGE:], k_pool[1])
+
+
+def test_cache_write_roundtrip():
+    """One token per lane written through the table lands at its logical
+    position and nowhere else (disjoint chains)."""
+    _, k_pool, _, bt = _setup()
+    new = jnp.full((3, 4, 16), 7.0)
+    pos = jnp.asarray([0, 9, 31], jnp.int32)  # pages 0, 1, 3 of each chain
+    out = paged_cache_write(k_pool, new, bt, pos)
+    gathered = paged_gather_kv(out, bt)
+    for lane, p in enumerate([0, 9, 31]):
+        np.testing.assert_array_equal(gathered[lane, :, p], new[lane])
+    # Exactly one position per lane changed.
+    diff = jnp.any(gathered != paged_gather_kv(k_pool, bt), axis=(1, 3))
+    assert int(diff.sum()) == 3
+
+
+def test_decode_write_then_read_matches_dense():
+    """The serving step order — write the new token's K/V, then attend with
+    lengths = pos + 1 — must equal dense decode_attention on the
+    materialized chain."""
+    from oobleck_tpu.ops.attention import cache_write, decode_attention
+
+    q, k_pool, v_pool, bt = _setup(b=2, p=2)
+    ks = jax.random.split(jax.random.PRNGKey(9), 2)
+    new_k = jax.random.normal(ks[0], (2, 4, 16), jnp.float32)
+    new_v = jax.random.normal(ks[1], (2, 4, 16), jnp.float32)
+    pos = jnp.asarray([4, 11], jnp.int32)
+
+    k_pool2 = paged_cache_write(k_pool, new_k, bt, pos)
+    v_pool2 = paged_cache_write(v_pool, new_v, bt, pos)
+    got = paged_decode_attention(q[:2], k_pool2, v_pool2, bt, pos + 1)
+
+    k_dense = cache_write(paged_gather_kv(k_pool, bt), new_k, pos)
+    v_dense = cache_write(paged_gather_kv(v_pool, bt), new_v, pos)
+    ref = decode_attention(q[:2], k_dense, v_dense, pos)
+    np.testing.assert_allclose(got, ref, atol=2e-6, rtol=2e-6)
+
+
+def test_seam_resolves_paged():
+    fn = select_attention_impl("paged")
+    assert fn is paged_decode_attention
+
+
+def test_bad_shapes_rejected():
+    q, k_pool, v_pool, bt = _setup(hq=3, hkv=2)
+    with pytest.raises(ValueError, match="multiple"):
+        paged_decode_attention(q, k_pool, v_pool, bt,
+                               jnp.asarray([1, 1, 1], jnp.int32))
+    q, k_pool, v_pool, bt = _setup()
+    with pytest.raises(ValueError, match="alibi_slopes"):
+        paged_decode_attention(q, k_pool, v_pool, bt,
+                               jnp.asarray([1, 1, 1], jnp.int32),
+                               alibi_slopes=jnp.ones((2,)))
