@@ -2,7 +2,7 @@
 
 PY ?= python
 
-.PHONY: lint analyze gen-registry test test-slow tier1 bench bench-diff trace-report ckpt-bench serve-bench pipeline-bench degrade-bench policy-bench sim-bench
+.PHONY: lint analyze gen-registry test test-slow tier1 bench bench-diff trace-report ckpt-bench serve-bench pipeline-bench degrade-bench policy-bench sim-bench grow-bench
 
 # Lint = the project-native analyzer (always available, stdlib-only)
 # plus ruff (config in pyproject.toml). Ruff degrades to a skip when not
@@ -100,3 +100,12 @@ policy-bench:
 	JAX_PLATFORMS=cpu OOBLECK_METRICS_DIR= \
 		XLA_FLAGS="--xla_force_host_platform_device_count=8" \
 		$(PY) -m oobleck_tpu.policy.bench
+
+# Grow plane: join-to-first-post-grow-step per grow arm (absorb_spare /
+# grow_dp / grow_reshape / adaptive) on a 2-host rig growing by 2
+# joiners. 8 virtual devices: 4 bound at start, 4 free for the arrivals
+# (also under bench.py's "grow" key, diffed by bench --diff).
+grow-bench:
+	JAX_PLATFORMS=cpu OOBLECK_METRICS_DIR= \
+		XLA_FLAGS="--xla_force_host_platform_device_count=8" \
+		$(PY) -m oobleck_tpu.policy.grow_bench

@@ -7,7 +7,12 @@ timeout and a missing line is worse than a degraded one (round-2 failure
 mode: rc 124, empty output).
 
 Budget layout (wall-clock caps, enforced with subprocess timeouts):
-  probe   : 60 s, one retry            -> is the TPU relay alive at all?
+  probe   : 60 s x 3 attempts          -> is the TPU relay alive at all?
+                                          (backoff scales with the
+                                          BENCH_PROBE_TIMEOUT budget; the
+                                          probe runs at the START of every
+                                          round so a healed relay ends a
+                                          stale streak by itself)
   measure : 240 s on the real device   -> the actual benchmark
   fallback: 120 s tiny CPU proxy       -> sanity signal when TPU unreachable
   serve   : 150 s CPU subprocess       -> serving microbench under "serve"
@@ -45,9 +50,14 @@ _INNER_ENV = "_OOBLECK_BENCH_INNER"
 _PIPELINE_ENV = "_OOBLECK_BENCH_PIPELINE"
 
 PROBE_TIMEOUT_S = 60
-PROBE_RETRY_BACKOFF_S = 10
+PROBE_ATTEMPTS = 3
 MEASURE_TIMEOUT_S = 280  # includes ~30 s of on-device flash validation
 CPU_FALLBACK_TIMEOUT_S = 120
+
+# Whether THIS process ran the device probe this round — emitted as the
+# `probe_attempted` boolean on every line (the __main__ crash path may
+# fire before the probe, and a consumer must never have to guess).
+_PROBE_ATTEMPTED = [False]
 
 
 def _probe_timeout_s() -> int:
@@ -514,6 +524,43 @@ def _policy_summary() -> dict:
         return {"error": f"unparseable policy bench output: {exc}"}
 
 
+GROW_BENCH_TIMEOUT_S = 300
+
+
+def _grow_summary() -> dict:
+    """Grow-plane microbench (oobleck_tpu/policy/grow_bench.py) in a
+    throwaway CPU subprocess with 8 virtual devices (2-host rig on the
+    first 4, two joiners binding the free 4). Measures join-to-first-
+    post-grow-step for each grow arm plus adaptive; never on the TPU
+    relay — it builds and kills four engines."""
+    env = dict(os.environ)
+    env.update({
+        "JAX_PLATFORMS": "cpu", "PALLAS_AXON_POOL_IPS": "",
+        "OOBLECK_METRICS_DIR": "",
+        "XLA_FLAGS": (env.get("XLA_FLAGS", "")
+                      + " --xla_force_host_platform_device_count=8").strip(),
+    })
+    env.pop(_INNER_ENV, None)
+    env.pop(_PIPELINE_ENV, None)
+    env.pop("OOBLECK_POLICY", None)  # arms are forced in-process, not by env
+    proc = subprocess.Popen(
+        [sys.executable, "-m", "oobleck_tpu.policy.grow_bench"],
+        stdout=subprocess.PIPE, stderr=subprocess.PIPE, text=True, env=env)
+    try:
+        out, err = proc.communicate(timeout=GROW_BENCH_TIMEOUT_S)
+    except subprocess.TimeoutExpired:
+        proc.kill()
+        return {"error": f"grow bench hung >{GROW_BENCH_TIMEOUT_S}s"}
+    if proc.returncode != 0:
+        tail = (err or "").strip().splitlines()[-1:] or ["no stderr"]
+        return {"error":
+                f"grow bench exit {proc.returncode}: {tail[0][:160]}"}
+    try:
+        return json.loads(out.strip().splitlines()[-1])
+    except Exception as exc:  # noqa: BLE001
+        return {"error": f"unparseable grow bench output: {exc}"}
+
+
 SERVE_BENCH_TIMEOUT_S = 150
 
 
@@ -682,6 +729,12 @@ def _emit(result: dict) -> None:
         result["policy"] = _policy_summary()
     except Exception as exc:  # noqa: BLE001 — emit must never fail
         result["policy"] = {"error": f"{type(exc).__name__}: {exc}"}
+    # Grow plane (join-to-first-post-grow-step per grow arm): CPU
+    # subprocess, bounded, best-effort — see _grow_summary.
+    try:
+        result["grow"] = _grow_summary()
+    except Exception as exc:  # noqa: BLE001 — emit must never fail
+        result["grow"] = {"error": f"{type(exc).__name__}: {exc}"}
     # Simulated SLOs (recovery percentiles, goodput under churn, regret
     # vs the hindsight oracle, determinism gate): CPU subprocess, jax-
     # free, bounded, best-effort — see _sim_summary.
@@ -709,9 +762,14 @@ def _stamp_provenance(result: dict) -> None:
     replayed number was measured in; None when fresh — all subprocess
     microbenches are measured in-run, so they are fresh by construction
     unless they errored, in which case the error string is the signal and
-    the section is still stamped)."""
+    the section is still stamped). `probe_attempted` (boolean, so the
+    numeric diff ignores it) records whether this round actually ran the
+    device probe — a replayed headline from a round that never reached
+    the probe is distinguishable from one that probed and found the relay
+    down."""
     result.setdefault("stale", False)
     result.setdefault("stale_from", None)
+    result.setdefault("probe_attempted", _PROBE_ATTEMPTED[0])
     for section in result.values():
         if isinstance(section, dict):
             section.setdefault("stale", False)
@@ -864,13 +922,20 @@ def main() -> None:
         return
 
     reasons: list[str] = []
-    for attempt in range(2):
-        reason = _probe_device(_probe_timeout_s())
+    timeout_s = _probe_timeout_s()
+    # Backoff between attempts scales with the probe budget (so a CI that
+    # shrinks BENCH_PROBE_TIMEOUT shrinks the whole probe phase with it);
+    # probing at the start of EVERY round is what lets a relay that healed
+    # overnight end a stale-replay streak without operator action.
+    backoff_s = max(1, timeout_s // PROBE_ATTEMPTS)
+    for attempt in range(PROBE_ATTEMPTS):
+        reason = _probe_device(timeout_s)
+        _PROBE_ATTEMPTED[0] = True
         if reason is None:
             break
         reasons.append(reason)
-        if attempt == 0:
-            time.sleep(PROBE_RETRY_BACKOFF_S)
+        if attempt < PROBE_ATTEMPTS - 1:
+            time.sleep(backoff_s)
     else:
         reason = reasons[-1]
 
@@ -938,6 +1003,7 @@ if __name__ == "__main__":
             "vs_baseline": 1.0 if base else 0,
             "stale": True,
             "stale_from": base.get("recorded", "unknown"),
+            "probe_attempted": _PROBE_ATTEMPTED[0],
             "note": f"bench harness crashed ({type(exc).__name__}: {exc}); "
                     "value is the last good TPU measurement" if base else
                     f"bench harness crashed ({type(exc).__name__}: {exc})",

@@ -42,6 +42,7 @@ VERB_TO_ENGINE_KIND = {
     "RECONFIGURATION": "reconfigure",
     "DEGRADE": "degrade",
     "RESTORE": "restore",
+    "GROW": "grow",
 }
 # Verbs the worker/engine never sees (absorbed by the agent/master).
 CONTROL_PLANE_ONLY = {"SUCCESS", "FAILURE", "PONG", "FORWARD_COORDINATOR"}

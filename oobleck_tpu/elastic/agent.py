@@ -31,6 +31,7 @@ from dataclasses import dataclass
 
 from oobleck_tpu.config import OobleckArguments
 from oobleck_tpu.elastic.message import (
+    JOINED_KEY,
     PROTOCOL_VERSION,
     RequestType,
     ResponseType,
@@ -451,6 +452,10 @@ class OobleckAgent:
                 await self.on_reconfiguration(msg["lost_ip"], restore=True,
                                               trace=spans.extract(msg),
                                               decision=msg.get(DECISION_KEY))
+            elif kind == ResponseType.GROW.value:
+                await self.on_grow(list(msg.get(JOINED_KEY) or ()),
+                                   trace=spans.extract(msg),
+                                   decision=msg.get(DECISION_KEY))
             elif kind == ResponseType.FORWARD_COORDINATOR.value:
                 payload = {"kind": "coordinator", "address": msg["address"]}
                 if msg.get("world") is not None:
@@ -569,6 +574,41 @@ class OobleckAgent:
             kind = ("restore" if restore
                     else "degrade" if degrade else "reconfigure")
             payload = {"kind": kind, "lost_ip": lost_ip}
+            if trace is not None:
+                payload[spans.TRACE_KEY] = trace
+            if decision is not None:
+                payload[DECISION_KEY] = decision
+            self.worker.pipe.send(payload)
+
+    async def on_grow(self, joined_ips: list[str],
+                      trace: dict | None = None,
+                      decision: dict | None = None) -> None:
+        """GROW broadcast: hosts `joined_ips` arrived mid-training and the
+        master's policy plane scored the absorption. Nothing terminates and
+        no survivor respawns — the verb only extends membership and rides
+        the worker pipe down to the engine, which applies the chosen grow
+        arm at its next step boundary. The joining host receives the same
+        broadcast: its membership now includes itself, and its worker (when
+        one eventually launches into the grown world) sees the same
+        verdict."""
+        logger.warning("hosts %s joined (grow verdict=%s)", joined_ips,
+                       (decision or {}).get("mechanism"))
+        self._notified_at = time.monotonic()
+        notified_wall = time.time()
+        if trace is not None:
+            trace = {**trace, "notified_at": notified_wall}
+            spans.span_recorder().record(
+                "incident.notified", notified_wall, notified_wall,
+                trace_id=trace.get("trace_id"),
+                joined_ips=",".join(joined_ips), ip=self.agent_ip)
+        metrics.flight_recorder().record("grow_notified",
+                                         joined_ips=joined_ips,
+                                         ip=self.agent_ip)
+        for ip in joined_ips:
+            if ip not in self.node_ips:
+                self.node_ips.append(ip)
+        if self.worker is not None:
+            payload: dict = {"kind": "grow", JOINED_KEY: joined_ips}
             if trace is not None:
                 payload[spans.TRACE_KEY] = trace
             if decision is not None:

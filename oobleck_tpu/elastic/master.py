@@ -39,6 +39,7 @@ from dataclasses import dataclass, field
 from oobleck_tpu.config import OobleckArguments
 from oobleck_tpu.elastic.message import (
     DEFAULT_PING_INTERVAL,
+    JOINED_KEY,
     DistributionInfo,
     RequestType,
     ResponseType,
@@ -51,8 +52,16 @@ from oobleck_tpu.policy import PolicyEngine
 from oobleck_tpu.policy.engine import DECISION_KEY, MECH_REINSTANTIATE, \
     MECH_REROUTE, MECH_RESTORE
 from oobleck_tpu.utils import metrics, recovery
+from oobleck_tpu.utils.chaos import chaos
 
 MAX_NUM_HOSTS = 32
+
+# Near-simultaneous JOINs (a whole spot batch provisioning at once) are
+# folded into ONE grow incident: the first arrival opens this window, and
+# everything landing inside it rides the same policy decision + broadcast
+# (mirrors the correlated-LOSS batching of _maybe_reconfigure).
+ENV_JOIN_WINDOW = "OOBLECK_JOIN_WINDOW"
+DEFAULT_JOIN_WINDOW_S = 0.25
 
 # Committed incident reports pushed up from workers, kept for /status.
 MAX_INCIDENTS = 16
@@ -177,6 +186,11 @@ class OobleckMasterDaemon:
         # (first post-broadcast worker snapshot = the pipeline is stepping
         # again).
         self._recoveries: list[dict] = []
+        # Mid-training JOINs waiting for the batching window to close; the
+        # first arrival schedules the flush task, every arrival inside the
+        # window rides the same grow incident.
+        self._pending_joins: list[tuple[str, float | None]] = []
+        self._join_flush_task: asyncio.Task | None = None
         # Incident forensics reports (obs/incident.py) committed by workers
         # and pushed up piggybacked on METRICS snapshots; bounded ring.
         self._incidents: list[dict] = []
@@ -197,6 +211,9 @@ class OobleckMasterDaemon:
         self._m_pushes = reg.counter(
             "oobleck_master_metrics_pushes_total",
             "METRICS snapshots received", )
+        self._m_grows = reg.counter(
+            "oobleck_master_grow_broadcasts_total",
+            "GROW broadcasts sent for mid-training JOIN batches")
 
     # ------------------------------------------------------------------ #
 
@@ -378,6 +395,22 @@ class OobleckMasterDaemon:
             proactive=proactive,
         )
 
+    def decide_grow(self, joined_ips: list[str], *,
+                    lifetime_hints: dict[str, float] | None = None):
+        """Consult the policy engine's grow direction with master-side
+        live signals. `current_hosts` excludes the joiners themselves —
+        they are already in self.agents by flush time, but the retention
+        math needs the pre-grow fleet size."""
+        current = max(len(self.agents) - len(joined_ips), 1)
+        return self.policy.decide_grow(
+            joined_ips,
+            current_hosts=current,
+            staleness_steps=self._staleness_steps(),
+            step_seconds=self._step_seconds(),
+            lifetime_hints=lifetime_hints,
+            cause="join",
+        )
+
     def _record_metrics_push(self, msg: dict) -> None:
         ip = msg.get("ip", "?")
         role = msg.get("role", "agent")
@@ -421,6 +454,8 @@ class OobleckMasterDaemon:
             await self._handle_launch_job(msg, reader, writer)
         elif kind == RequestType.REGISTER_AGENT.value:
             await self._handle_register_agent(msg, reader, writer)
+        elif kind == RequestType.JOIN.value:
+            await self._handle_join(msg, reader, writer)
         else:
             await send_response(writer, ResponseType.FAILURE,
                                 {"error": f"unexpected first message {kind}"})
@@ -489,9 +524,21 @@ class OobleckMasterDaemon:
         )
         self.agents[ip] = info
         self._m_registrations.inc()
-        metrics.flight_recorder().record(
-            "register", ip=ip, protocol=info.protocol,
-            ping_interval=info.ping_interval)
+        if self.policy.health.consume_lift(ip):
+            # A host whose flap quarantine lifted (hysteresis satisfied) is
+            # re-registering: accepted like any other, but the handshake is
+            # a REJOIN and the forensic record must say so — "this host was
+            # refused, proved stable, and came back" reads very differently
+            # from a first-contact register in a postmortem.
+            metrics.flight_recorder().record(
+                "quarantine_rejoin", ip=ip, protocol=info.protocol,
+                ping_interval=info.ping_interval)
+            logger.info("quarantined host %s rejoined after hysteresis "
+                        "lift", ip)
+        else:
+            metrics.flight_recorder().record(
+                "register", ip=ip, protocol=info.protocol,
+                ping_interval=info.ping_interval)
         logger.info(
             "agent %s registered (protocol v%d, ping %.1fs, read deadline "
             "%.1fs)", ip, info.protocol, info.ping_interval,
@@ -514,6 +561,122 @@ class OobleckMasterDaemon:
                 await self._close_agent(ip)
             else:
                 info.writer.close()
+
+    async def _handle_join(self, msg, reader, writer) -> None:
+        """Mid-training JOIN: a freshly provisioned host volunteering
+        capacity to a running job. Distinct from initial bring-up (the
+        host was never in node_ips) and from a quarantine-lifted host
+        re-registering (that one replays REGISTER_AGENT and is tagged
+        quarantine_rejoin). The handshake mirrors register — SUCCESS with
+        job args, coordinator replay, long-lived liveness channel — but
+        instead of filling a known slot it opens (or rides) a batched
+        GROW incident."""
+        ip = msg.get("ip") or writer.get_extra_info("peername")[0]
+        if self.job is None:
+            await send_response(writer, ResponseType.FAILURE,
+                                {"error": "no job configured"})
+            writer.close()
+            return
+        if self.policy.is_quarantined(ip):
+            # A flapping host does not get to grow the cluster either; the
+            # same hysteresis that gates re-registration gates JOIN.
+            logger.warning("refusing JOIN from quarantined host %s", ip)
+            metrics.flight_recorder().record("join_refused", ip=ip,
+                                             reason="quarantined")
+            await send_response(writer, ResponseType.FAILURE,
+                                {"error": "quarantined"})
+            writer.close()
+            return
+        if ip in self.agents or len(self.agents) >= MAX_NUM_HOSTS:
+            reason = "already registered" if ip in self.agents \
+                else f"cluster full (max {MAX_NUM_HOSTS})"
+            metrics.flight_recorder().record("join_refused", ip=ip,
+                                             reason=reason)
+            await send_response(writer, ResponseType.FAILURE,
+                                {"error": reason})
+            writer.close()
+            return
+        interval = float(msg.get("ping_interval") or DEFAULT_PING_INTERVAL)
+        info = AgentInfo(
+            ip, reader, writer,
+            protocol=int(msg.get("protocol") or 1),
+            ping_interval=interval,
+            read_deadline=read_deadline(interval),
+        )
+        self.agents[ip] = info
+        self._m_registrations.inc()
+        # Expected-lifetime hint for the policy's amortization horizon: the
+        # joiner may advertise one (spot instances know their own market),
+        # else a chaos spot_lifetime directive supplies it for drills.
+        hint: float | None = None
+        raw_hint = msg.get("spot_lifetime_s")
+        if raw_hint is not None:
+            try:
+                hint = float(raw_hint) or None
+            except (TypeError, ValueError):
+                hint = None
+        if hint is None:
+            hint = chaos().spot_lifetime(ip)
+        metrics.flight_recorder().record(
+            "join", ip=ip, protocol=info.protocol,
+            ping_interval=info.ping_interval, spot_lifetime_s=hint)
+        logger.info("host %s JOINed mid-training (protocol v%d, lifetime "
+                    "hint %s)", ip, info.protocol, hint)
+        await send_response(writer, ResponseType.SUCCESS,
+                            {"args": self.job.to_dict()})
+        if self.coordinator is not None:
+            await send_response(writer, ResponseType.FORWARD_COORDINATOR,
+                                self._coordinator_payload())
+        self._pending_joins.append((ip, hint))
+        if self._join_flush_task is None or self._join_flush_task.done():
+            self._join_flush_task = asyncio.ensure_future(self._flush_joins())
+        try:
+            await self._agent_loop(info)
+        finally:
+            if self.agents.get(ip) is info:
+                await self._close_agent(ip)
+            else:
+                info.writer.close()
+
+    def _join_window_s(self) -> float:
+        raw = os.environ.get(ENV_JOIN_WINDOW, "")
+        try:
+            return float(raw) if raw else DEFAULT_JOIN_WINDOW_S
+        except ValueError:
+            return DEFAULT_JOIN_WINDOW_S
+
+    async def _flush_joins(self) -> None:
+        """Close the batching window: every JOIN that landed inside it
+        becomes ONE grow incident — one trace, one policy decision, one
+        GROW broadcast (the grow-direction mirror of correlated-loss
+        batching in the engine's _maybe_reconfigure)."""
+        await asyncio.sleep(self._join_window_s())
+        batch, self._pending_joins = self._pending_joins, []
+        # Keep only joiners still registered: one that dialed in and died
+        # inside the window is already handled by its own loss path.
+        batch = [(ip, h) for ip, h in batch if ip in self.agents]
+        if not batch:
+            return
+        joined = [ip for ip, _ in batch]
+        hints = {ip: h for ip, h in batch if h is not None}
+        trace_id = spans.new_trace_id()
+        detected_at = time.time()
+        with self._snap_lock:
+            self._recoveries.append({
+                "lost_ip": "", "joined_ips": list(joined), "cause": "join",
+                "trace_id": trace_id, "detected_at": detected_at,
+                "broadcast_at": None, "resolved_at": None,
+            })
+        spans.span_recorder().record(
+            "incident.detect", detected_at, detected_at, trace_id=trace_id,
+            joined_ips=",".join(joined), cause="join")
+        fr = metrics.flight_recorder()
+        fr.record("join_detected", joined_ips=",".join(joined),
+                  trace_id=trace_id)
+        fr.dump(f"join_detected:{'+'.join(joined)}")
+        decision = self.decide_grow(joined, lifetime_hints=hints)
+        await self._broadcast_grow(joined, decision,
+                                   include=list(self.agents.values()))
 
     def _coordinator_payload(self) -> dict:
         """Coordinator relay payload; the generation tag is included only
@@ -714,6 +877,52 @@ class OobleckMasterDaemon:
         fr.dump(f"reconfiguration_broadcast:{ip}")
         recovery.mark(recovery.BROADCAST, lost_ip=ip,
                       survivors=len(self.agents))
+
+    async def _broadcast_grow(self, joined_ips: list[str], decision,
+                              include: list[AgentInfo]) -> None:
+        """Broadcast the decided grow verb for a JOIN batch, policy
+        decision attached. GROW always rides the one verb — the chosen arm
+        (absorb_spare / grow_dp / grow_reshape) travels inside the
+        decision payload, so legacy receivers that predate the verb skip
+        the whole thing knowingly (absorption degrades to a no-op, never
+        an outage). The empty lost_ip satisfies the shared broadcast
+        machinery's core-key contract."""
+        broadcast_at = time.time()
+        trace_ctx: dict | None = None
+        with self._snap_lock:
+            for r in self._recoveries:
+                if (r.get("joined_ips") == joined_ips
+                        and r["broadcast_at"] is None):
+                    r["broadcast_at"] = broadcast_at
+                    r["mechanism"] = decision.mechanism
+                    if r.get("trace_id"):
+                        trace_ctx = {
+                            "trace_id": r["trace_id"],
+                            "detected_at": r["detected_at"],
+                            "broadcast_at": broadcast_at,
+                            "cause": r.get("cause"),
+                        }
+        payload: dict = {"lost_ip": "", DECISION_KEY: decision.as_payload()}
+        payload[JOINED_KEY] = list(joined_ips)
+        if trace_ctx is not None:
+            payload[spans.TRACE_KEY] = trace_ctx
+            decision.trace_id = trace_ctx["trace_id"]
+            spans.span_recorder().record(
+                "incident.broadcast", broadcast_at, broadcast_at,
+                trace_id=trace_ctx["trace_id"],
+                joined_ips=",".join(joined_ips),
+                verb=ResponseType.GROW.value,
+                mechanism=decision.mechanism, agents=len(self.agents))
+        for other in include:
+            try:
+                await send_response(other.writer, ResponseType.GROW, payload)
+            except ConnectionError:
+                pass
+        self._m_grows.inc(mechanism=decision.mechanism)
+        fr = metrics.flight_recorder()
+        fr.record("grow_broadcast", joined_ips=",".join(joined_ips),
+                  agents=len(self.agents), mechanism=decision.mechanism)
+        fr.dump(f"grow_broadcast:{'+'.join(joined_ips)}")
 
 
 async def _amain(port: int, launcher: str, username: str | None,

@@ -69,6 +69,15 @@ class RequestType(str, Enum):
     # decided BEFORE the host disappears — instead of waiting for the
     # heartbeat deadline to notice the corpse.
     PREEMPTION_NOTICE = "preemption_notice"
+    # Mid-training capacity arrival ({"ip", optional "spot_lifetime_s"}):
+    # a freshly provisioned host announces itself AFTER the job launched —
+    # distinct from initial bring-up (REGISTER_AGENT before launch) and
+    # from a quarantine-lifted host re-registering. The master batches
+    # near-simultaneous JOINs into one grow incident and answers with a
+    # GROW broadcast; masters that predate the verb answer FAILURE, and
+    # the joining agent falls back to plain REGISTER_AGENT (parked until
+    # the next restart picks it up).
+    JOIN = "join"
 
 
 class ResponseType(str, Enum):
@@ -91,7 +100,22 @@ class ResponseType(str, Enum):
     # RECONFIGURATION (the respawned worker restores from durable state
     # on bringup anyway, so the fallback is correct, just slower).
     RESTORE = "restore"
+    # Grow verb: one or more hosts JOINed mid-training and the policy
+    # plane scored the grow arms (absorb_spare / grow_dp / grow_reshape).
+    # Payload carries "lost_ip": "" (no host was lost — the shared
+    # broadcast machinery requires the key) plus JOINED_KEY, the policy
+    # decision, and trace context. Receivers that predate the verb IGNORE
+    # it (it funnels to the engine's control queue, not to recovery): an
+    # old survivor simply keeps training at the old size, which is safe —
+    # capacity absorption degrades to a no-op, never to an outage.
+    GROW = "grow"
     FORWARD_COORDINATOR = "forward_coordinator"
+
+
+# Broadcast-payload key naming the joined host ips on the GROW verb (a
+# named constant so oobleck-lint OBL004 can pin the master's broadcast
+# payloads to the core key set).
+JOINED_KEY = "joined_ips"
 
 
 @dataclass

@@ -43,6 +43,7 @@ import numpy as np
 from jax.sharding import NamedSharding
 
 from oobleck_tpu.config import OobleckArguments
+from oobleck_tpu.elastic.message import JOINED_KEY
 from oobleck_tpu.execution.dataloader import (
     DeviceStager,
     OobleckDataLoader,
@@ -65,6 +66,10 @@ from oobleck_tpu.planning.profiler import load_profile, profile
 from oobleck_tpu.planning.templates import PipelineTemplate, TemplateGenerator
 from oobleck_tpu.policy import DECISION_KEY as POLICY_DECISION_KEY
 from oobleck_tpu.policy import (
+    GROW_MODES,
+    MECH_ABSORB,
+    MECH_GROW_DP,
+    MECH_GROW_RESHAPE,
     MECH_REINSTANTIATE,
     MECH_REROUTE,
     MECH_RESTORE,
@@ -560,6 +565,15 @@ class ReconfigurationEngine:
                 self.engine.request_reconfiguration(
                     msg["lost_ip"], trace=obs_spans.extract(msg),
                     decision=msg.get(POLICY_DECISION_KEY))
+            elif msg.get("kind") == "grow":
+                # JOIN incident: capacity ARRIVING instead of leaving. The
+                # grow direction rides the same pending-queue + step-
+                # boundary pattern as losses (one correlated incident per
+                # boundary), never a mid-step mutation.
+                self.engine.request_grow(
+                    list(msg.get(JOINED_KEY) or ()),
+                    trace=obs_spans.extract(msg),
+                    decision=msg.get(POLICY_DECISION_KEY))
             else:
                 self.engine._control_msgs.put(msg)
 
@@ -680,6 +694,14 @@ class OobleckEngine:
         self._durable = None
         self.ckpt_stall_s: list[float] = []
         self._pending_lost: list[tuple[str, dict | None, dict | None]] = []
+        # Grow direction (PR 13): JOIN batches waiting for the next step
+        # boundary, hosts parked by an absorb_spare verdict (admitted into
+        # geometry but not the plan), and chaos spot-lifetime deadlines
+        # (monotonic) armed at admit — the priced-in churn actually lands.
+        self._pending_joins: list[tuple[list[str], dict | None,
+                                        dict | None]] = []
+        self._spare_hosts: list[str] = []
+        self._spot_deadlines: dict[str, float] = {}
         self._lock = threading.Lock()
         import queue as _queue
 
@@ -736,6 +758,10 @@ class OobleckEngine:
         self._m_reconfigs = reg.counter(
             "oobleck_engine_reconfigurations_total",
             "In-place reconfigurations completed")
+        self._m_grows = reg.counter(
+            "oobleck_engine_grows_total",
+            "Grow incidents applied, by mechanism (absorb_spare / "
+            "grow_dp / grow_reshape)")
         self._m_template = reg.gauge(
             "oobleck_engine_pipeline_template_info",
             "Current pipeline layout (labels); value = step when adopted")
@@ -831,6 +857,18 @@ class OobleckEngine:
             self._fused_hosts = list(range(n_hosts))
             return
 
+        self.templates = self._generate_templates(n_hosts)
+        logger.info("templates for host counts %s",
+                    [t.num_hosts for t in self.templates])
+
+    def _generate_templates(self, max_hosts: int) -> list[PipelineTemplate]:
+        """Pipeline templates for every feasible host count in
+        [compute_min_hosts(), max_hosts]. Deterministic in its inputs
+        (profiles, chip geometry, execution knobs), which is what lets
+        grow re-instantiation regenerate with a LARGER ceiling and get the
+        existing templates back bit-for-bit plus the new sizes — plan
+        parity with a fresh larger-fleet bring-up holds by construction
+        (_ensure_templates_for)."""
         min_hosts = self.compute_min_hosts()
         gen = TemplateGenerator()
         # Interleaving changes the cost model (warmup ramp / v), so the
@@ -851,32 +889,45 @@ class OobleckEngine:
                     f"tensor_parallel*sequence_parallel={tp}*{sp}"
                 )
             base = gen.create_pipeline_templates(
-                self.profiles, (min_hosts, n_hosts),
+                self.profiles, (min_hosts, max_hosts),
                 self.chips_per_host // unit, virtual_stages=vstages,
             )
-            self.templates = [_scale_template_chips(t, unit) for t in base]
+            templates = [_scale_template_chips(t, unit) for t in base]
         else:
-            self.templates = gen.create_pipeline_templates(
-                self.profiles, (min_hosts, n_hosts), self.chips_per_host,
+            templates = gen.create_pipeline_templates(
+                self.profiles, (min_hosts, max_hosts), self.chips_per_host,
                 virtual_stages=vstages,
             )
-        if not self.templates:
+        if not templates:
             raise RuntimeError(
                 f"no feasible pipeline templates for hosts in "
-                f"[{min_hosts}, {n_hosts}] x {self.chips_per_host} chips"
+                f"[{min_hosts}, {max_hosts}] x {self.chips_per_host} chips"
             )
         num_stages = self.args.execution.num_stages
         if num_stages > 0:
-            filtered = [t for t in self.templates
+            filtered = [t for t in templates
                         if len(t.stages) == num_stages]
             if not filtered:
                 raise RuntimeError(
                     f"execution.num_stages={num_stages} matches no feasible "
                     f"template (stage counts available: "
-                    f"{sorted({len(t.stages) for t in self.templates})})"
+                    f"{sorted({len(t.stages) for t in templates})})"
                 )
-            self.templates = filtered
-        logger.info("templates for host counts %s",
+            templates = filtered
+        return templates
+
+    def _ensure_templates_for(self, n_hosts: int) -> None:
+        """Raise the template ceiling to cover `n_hosts`. Templates were
+        generated only up to the STARTUP fleet size (the reference never
+        grows, so neither did the generator call); growing past that
+        ceiling re-runs the generator with the same inputs and a larger
+        range — the overlapping templates come back identical, so every
+        cached plan/executable keyed on them stays valid."""
+        if self.templates and max(
+                t.num_hosts for t in self.templates) >= n_hosts:
+            return
+        self.templates = self._generate_templates(n_hosts)
+        logger.info("templates extended for host counts %s",
                     [t.num_hosts for t in self.templates])
 
     def _broadcast_profiles(self) -> None:
@@ -1823,7 +1874,10 @@ class OobleckEngine:
                 self._tracer.on_step(self.step)
                 self._maybe_chaos_kill_stage()
                 self._maybe_chaos_kill_hosts()
+                self._maybe_chaos_join()
+                self._maybe_spot_expire()
                 self._maybe_reconfigure()
+                self._maybe_grow()
                 self._maybe_inplace_degrade()
                 if self._drain_requested:
                     # Preemption drain (or in-place-degrade victim): flush
@@ -2898,6 +2952,70 @@ class OobleckEngine:
             # Same trace, same drain window -> one correlated incident.
             self.request_reconfiguration(ip, trace=trace)
 
+    def _maybe_chaos_join(self) -> None:
+        """Chaos capacity arrival (OOBLECK_CHAOS=join_host=<ip>[@<delay>]
+        / join_hosts=<ip1+ip2>): declare freshly provisioned hosts at a
+        step boundary — the in-process mirror of a real JOIN handshake,
+        so the grow plane is exercisable without a control plane. Hosts
+        maturing at the same boundary arrive as ONE batch (the grow
+        mirror of kill_hosts' correlated loss)."""
+        if not chaos().active or not self.pipelines:
+            return
+        ips = chaos().join_targets()
+        if not ips:
+            return
+        fresh = [ip for ip in ips
+                 if ip not in self.host_ips and ip not in self._spare_hosts]
+        if not fresh:
+            logger.warning("chaos join: hosts %s already present", ips)
+            return
+        detected_at = time.time()
+        trace = {"trace_id": obs_spans.new_trace_id(),
+                 "detected_at": detected_at, "cause": "chaos_join_host"}
+        obs_spans.span_recorder().record(
+            "incident.detect", detected_at, detected_at,
+            trace_id=trace["trace_id"], joined_ips=",".join(fresh),
+            cause="chaos_join_host")
+        logger.warning("chaos join: hosts %s arriving together", fresh)
+        self.request_grow(fresh, trace=trace)
+
+    def _maybe_spot_expire(self) -> None:
+        """Spot-lifetime deadlines armed at admit (chaos spot_lifetime
+        directive): when a joined host's advertised lifetime runs out,
+        the churn the policy's amortization horizon priced in actually
+        happens. An active host leaves through the REGULAR loss path
+        (one synthetic incident); a parked spare just unparks — it was
+        never in the plan, so its departure interrupts nothing."""
+        if not self._spot_deadlines:
+            return
+        now = time.monotonic()
+        expired = [ip for ip, t in self._spot_deadlines.items() if now >= t]
+        for ip in expired:
+            del self._spot_deadlines[ip]
+            if ip in self._spare_hosts:
+                self._spare_hosts.remove(ip)
+                metrics.flight_recorder().record(
+                    "spot_lifetime_expired", ip=ip, step=self.step,
+                    was_spare=True)
+                logger.warning("spare host %s reached its spot lifetime; "
+                               "unparked", ip)
+                continue
+            if ip not in self.host_ips:
+                continue
+            detected_at = time.time()
+            trace = {"trace_id": obs_spans.new_trace_id(),
+                     "detected_at": detected_at, "cause": "spot_lifetime"}
+            obs_spans.span_recorder().record(
+                "incident.detect", detected_at, detected_at,
+                trace_id=trace["trace_id"], lost_ip=ip,
+                cause="spot_lifetime")
+            metrics.flight_recorder().record(
+                "spot_lifetime_expired", ip=ip, step=self.step,
+                was_spare=False)
+            logger.warning("host %s reached its advertised spot lifetime; "
+                           "declaring it lost", ip)
+            self.request_reconfiguration(ip, trace=trace)
+
     def _maybe_chaos_kill_stage(self) -> None:
         """Stage-addressed fault injection (OOBLECK_CHAOS=kill_stage=
         <stage>:<replica>): declare the host owning that stage of that
@@ -2948,6 +3066,16 @@ class OobleckEngine:
         with self._lock:
             self._pending_lost.append((lost_ip, trace, decision))
 
+    def request_grow(self, joined_ips: list[str],
+                     trace: dict | None = None,
+                     decision: dict | None = None) -> None:
+        """Queue a JOIN batch; applied at the next step boundary
+        (_maybe_grow), never mid-step."""
+        if not joined_ips:
+            return
+        with self._lock:
+            self._pending_joins.append((list(joined_ips), trace, decision))
+
     def request_drain(self, trace: dict | None = None) -> None:
         """Proactive preemption drain: the host got an advance notice, so
         flush durable state at the next step boundary and exit cleanly
@@ -2984,6 +3112,23 @@ class OobleckEngine:
         extra = [ip for ip in seen if ip != ip0]
         self.reconfigure(ip0, trace=trace, decision=decision,
                          extra_lost=extra)
+
+    def _maybe_grow(self) -> None:
+        with self._lock:
+            pending = list(self._pending_joins)
+            self._pending_joins.clear()
+        if not pending:
+            return
+        # Arrivals pending at one step boundary are ONE grow incident:
+        # the policy must price the whole batch (three spares vs one
+        # 3-host pipeline are different verdicts), mirroring the
+        # correlated-loss batching above. First trace/decision wins.
+        seen: dict[str, None] = {}
+        for ips, _, _ in pending:
+            for ip in ips:
+                seen.setdefault(ip)
+        _, trace, decision = pending[0]
+        self.grow(list(seen), trace=trace, decision=decision)
 
     def reconfigure(self, lost_ip: str, trace: dict | None = None,
                     decision: dict | None = None,
@@ -3164,6 +3309,316 @@ class OobleckEngine:
         if self._precompiler is not None:
             # Re-arm for the NEXT failure from the new (smaller) topology.
             self.start_recovery_precompile()
+
+    # -- grow direction (JOIN incidents, PR 13) ------------------------- #
+
+    def grow(self, joined_ips: list[str], trace: dict | None = None,
+             decision: dict | None = None) -> None:
+        """Incident-instrumented grow entry point, mirroring
+        reconfigure(): opens the incident (adopting upstream detect/
+        broadcast/notified marks), pins the trace as the process ambient,
+        and runs _do_grow. The incident stays open until the first
+        post-grow step commits incident-<n>.json — one committed file per
+        JOIN batch, with the policy decision (all three arm costs)
+        attached."""
+        incident = obs_incident.IncidentBuilder(
+            "",
+            trace_id=(trace or {}).get("trace_id"),
+            cause=(trace or {}).get("cause") or "join",
+            joined_ips=list(joined_ips), direction="grow")
+        incident.adopt(trace)
+        incident.mark("apply_start")
+        obs_spans.set_ambient({"trace_id": incident.trace_id})
+        prev_recovered = self._recovered_at
+        pdec = None
+        try:
+            with obs_spans.span("engine.grow",
+                                trace_id=incident.trace_id,
+                                joined_ips=",".join(joined_ips)):
+                pdec = self._do_grow(joined_ips, decision=decision)
+        finally:
+            obs_spans.set_ambient(None)
+            if self._recovering and self._recovered_at != prev_recovered:
+                if pdec is not None:
+                    incident.attrs["decision"] = pdec.as_payload()
+                incident.mark("apply_end")
+                self._incident = incident
+
+    def _do_grow(self, joined_ips: list[str], decision: dict | None = None):
+        """Apply one grow incident: bind the arrivals into the engine's
+        geometry, resolve the policy verdict (a broadcast-attached grow
+        decision wins; anything else consults the local policy engine),
+        and execute the chosen arm. Returns the resolved PolicyDecision
+        (None when nothing was admitted)."""
+        t0 = time.perf_counter()
+        if self.multihost or self.fused is not None:
+            # Growing a jax.distributed world takes a coordinated restart
+            # of every process (world size is baked into the runtime);
+            # the fused path would need a mesh re-grow. Both park the
+            # arrivals as spares so the capacity is tracked, never lost.
+            for ip in joined_ips:
+                if ip not in self.host_ips and ip not in self._spare_hosts:
+                    self._spare_hosts.append(ip)
+            metrics.flight_recorder().record(
+                "grow_deferred", joined_ips=joined_ips, step=self.step,
+                reason="multihost" if self.multihost else "fused")
+            logger.warning("grow deferred (%s path): %s parked as spares",
+                           "multihost" if self.multihost else "fused",
+                           joined_ips)
+            return None
+        admitted = self._admit_hosts(joined_ips)
+        if not admitted:
+            return None
+        # Deferred losses reference arrays on the pre-grow meshes; read
+        # them back before a re-materialization can drop the buffers.
+        self._drain_pending_losses()
+        pdec = decision_from_payload(decision)
+        if pdec is None or pdec.mechanism not in GROW_MODES:
+            pdec = self._consult_policy_grow(admitted,
+                                             cause="engine_detected")
+        mechanism = pdec.mechanism
+
+        if mechanism == MECH_GROW_DP:
+            if self._grow_dp_apply(admitted, t0):
+                return pdec
+            logger.warning("grow_dp chosen but no template fits the "
+                           "arrivals; absorbing %s as spares", admitted)
+            mechanism = MECH_ABSORB
+        if mechanism == MECH_GROW_RESHAPE:
+            self._grow_reshape_apply(admitted, t0)
+            return pdec
+
+        # absorb_spare (chosen, or the grow_dp apply-time fallback):
+        # park the arrivals in the spare pool — zero interruption, the
+        # live pipelines never notice. The incident still commits (the
+        # decision and its costs are the forensic record).
+        self._spare_hosts.extend(admitted)
+        elapsed = time.perf_counter() - t0
+        self._recovering = True
+        self._recovered_at = time.monotonic()
+        self._m_grows.inc(mechanism=MECH_ABSORB)
+        self._observe_policy_measured(MECH_ABSORB, elapsed)
+        metrics.flight_recorder().record(
+            "grow_absorbed", joined_ips=admitted,
+            spares=list(self._spare_hosts),
+            elapsed_s=round(elapsed, 3), step=self.step)
+        logger.warning(
+            "absorbed %s into the spare pool in %.3fs (zero interruption; "
+            "spares now %s)", admitted, elapsed, self._spare_hosts)
+        return pdec
+
+    def _admit_hosts(self, ips: list[str]) -> list[str]:
+        """Bind arriving hosts into the engine's immutable geometry: a
+        NEW host gets the next ORIGINAL index and chips_per_host fresh
+        devices (self.devices only ever grows — the rank encoding
+        rank = original_index * chips_per_host + local stays valid);
+        a previously-lost host rejoining reuses its original index, whose
+        device slice never left self.devices. Arms the chaos
+        spot-lifetime deadline when one is advertised. Returns the ips
+        actually admitted."""
+        admitted = []
+        for ip in ips:
+            if ip in self.host_ips or ip in self._spare_hosts:
+                logger.warning("join: host %s already present; ignoring",
+                               ip)
+                continue
+            if ip not in self._host_index:
+                cph = self.chips_per_host or 1
+                bound = {id(d) for d in self.devices}
+                pool = [d for d in jax.devices() if id(d) not in bound]
+                if len(pool) < cph:
+                    logger.warning(
+                        "join: no %d free devices for %s (have %d); "
+                        "refusing", cph, ip, len(pool))
+                    metrics.flight_recorder().record(
+                        "join_refused", ip=ip, reason="no_free_devices",
+                        step=self.step)
+                    continue
+                self._host_index[ip] = len(self._host_index)
+                self.devices.extend(pool[:cph])
+            lifetime = chaos().spot_lifetime(ip)
+            if lifetime is not None:
+                self._spot_deadlines[ip] = time.monotonic() + lifetime
+            admitted.append(ip)
+        return admitted
+
+    def predict_grow(self, new_hosts: set[int],
+                     current: list[list[int]] | None = None):
+        """predict_replan's grow-direction mirror: keep every current
+        pipeline's host group intact and fold `new_hosts` into
+        additional DP pipeline(s) from the existing templates, WITHOUT
+        mutating engine state. Returns (plan, host_assignment,
+        idle_hosts); plan is None when no template fits the arrivals
+        (the caller absorbs them instead). Shared with the recovery
+        precompiler so predicted post-grow executables carry
+        byte-identical cache keys to the ones a real JOIN will ask
+        for."""
+        if current is None:
+            current = [
+                sorted({r // self.chips_per_host for r in p.ranks})
+                for p in self.pipelines
+            ]
+        by_hosts = {t.num_hosts: t for t in self.templates}
+        sizes = sorted(by_hosts)
+        fitted, idle = fit_host_groups([sorted(new_hosts)], sizes)
+        if not fitted:
+            return None, None, sorted(new_hosts)
+        groups = [list(g) for g in current] + fitted
+        new_instances: dict[PipelineTemplate, int] = {}
+        for hosts in groups:
+            t = by_hosts[len(hosts)]
+            new_instances[t] = new_instances.get(t, 0) + 1
+        ar_across = [p.allreduce_across_hosts for p in self.profiles]
+        plan = PipelineInstantiator().get_new_execution_plan(
+            new_instances, ar_across, self.plan.total_num_microbatches
+        )
+        groups_by_size: dict[int, list[list[int]]] = {}
+        for g in groups:
+            groups_by_size.setdefault(len(g), []).append(g)
+        host_assignment = [
+            groups_by_size[t.num_hosts].pop(0) for t in plan.instances
+        ]
+        return plan, host_assignment, idle
+
+    def _grow_dp_apply(self, admitted: list[str], t0: float) -> bool:
+        """grow_dp: keep every surviving pipeline's host group intact and
+        add DP pipeline(s) over the arriving hosts from the EXISTING
+        templates — no restore, no survivor respawn; the batch
+        redistribution and the new replicas materializing from the live
+        weights (the DP copy IS the state transfer) are the whole
+        interruption. Returns False when no template fits."""
+        new_group = {self._host_index[ip] for ip in admitted}
+        plan, host_assignment, idle = self.predict_grow(new_group)
+        if plan is None:
+            return False
+        active = {h for g in host_assignment for h in g}
+        joined_active = [ip for ip in admitted
+                        if self._host_index[ip] in active]
+        joined_idle = [ip for ip in admitted if ip not in joined_active]
+        if joined_idle:
+            logger.warning(
+                "hosts %s idle after grow_dp (no template extension fits "
+                "them); parked as spares", joined_idle)
+            self._spare_hosts.extend(joined_idle)
+        old_params, old_opt = self._collect_layer_state()
+        it_done = self.dataloaders[0].num_iterations_done
+        epoch = self.dataloaders[0].epoch
+        self.host_ips.extend(joined_active)
+        self.plan = plan
+        self._materialize_plan(plan, it_done, epoch, old_params, old_opt,
+                               host_assignment=host_assignment)
+        self._finish_grow(MECH_GROW_DP, joined_active, t0, rolled_back=0)
+        return True
+
+    def _grow_reshape_apply(self, admitted: list[str], t0: float) -> None:
+        """grow_reshape: re-instantiate on the larger template set,
+        planned exactly as a fresh bring-up at the new fleet size would
+        plan — the LIVE promotion of the offline 2->4
+        restore-across-reshape path. State comes from the last durable
+        checkpoint when one exists (honest rollback, the step counter
+        rewinds); else the live layer state reshapes in place (nothing
+        replayed)."""
+        self._ensure_templates_for(len(self.host_ips) + len(admitted))
+        restored = self.try_restore_checkpoint()
+        rolled_back = 0
+        if restored is not None:
+            old_params = restored["params"]
+            old_opt = {}
+            for li, leaves in restored["opt"].items():
+                struct = jax.tree.structure(
+                    jax.eval_shape(self.optimizer.init, old_params[li]))
+                old_opt[li] = jax.tree.unflatten(struct, leaves)
+            meta = restored["meta"]
+            it_done = int(meta["num_iterations_done"])
+            epoch = int(meta["epoch"])
+            rolled_back = self.step - int(meta["step"])
+            self.step = int(meta["step"])
+        else:
+            old_params, old_opt = self._collect_layer_state()
+            it_done = self.dataloaders[0].num_iterations_done
+            epoch = self.dataloaders[0].epoch
+        self.host_ips.extend(admitted)
+        ar_across = [p.allreduce_across_hosts for p in self.profiles]
+        plan = PipelineInstantiator().get_best_execution_plan(
+            self.templates, ar_across, len(self.host_ips),
+            self.plan.total_num_microbatches,
+        )
+        # Contiguous blocks over the sorted available indices — for a
+        # never-shrunk fleet this is exactly the assignment a fresh
+        # bring-up materializes, which is what the live-grow parity test
+        # pins against its uninterrupted twin.
+        avail = sorted(self._host_index[ip] for ip in self.host_ips)
+        host_assignment = []
+        pos = 0
+        for t in plan.instances:
+            host_assignment.append(avail[pos:pos + t.num_hosts])
+            pos += t.num_hosts
+        self.plan = plan
+        self._materialize_plan(plan, it_done, epoch, old_params, old_opt,
+                               host_assignment=host_assignment)
+        self._finish_grow(MECH_GROW_RESHAPE, admitted, t0,
+                          rolled_back=rolled_back)
+
+    def _finish_grow(self, mechanism: str, admitted: list[str], t0: float,
+                     *, rolled_back: int) -> None:
+        elapsed = time.perf_counter() - t0
+        self.recovery_times.append(elapsed)
+        self._recovering = True
+        self._recovered_at = time.monotonic()
+        self._m_grows.inc(mechanism=mechanism)
+        self._set_template_gauge()
+        recovery.observe_latency(elapsed, stage="grow")
+        self._observe_policy_measured(mechanism, elapsed)
+        metrics.flight_recorder().record(
+            "engine_grown", joined_ips=admitted, mechanism=mechanism,
+            elapsed_s=round(elapsed, 3), step=self.step,
+            rolled_back_steps=rolled_back)
+        logger.warning(
+            "grew onto %s via %s in %.2fs%s: %s", admitted, mechanism,
+            elapsed,
+            f" (rolled back {rolled_back} step(s))" if rolled_back else "",
+            self.plan)
+        if self._precompiler is not None:
+            # Re-arm for the NEXT incident from the new (larger) topology.
+            self.start_recovery_precompile()
+
+    def _grow_dp_feasibility(self, k: int) -> tuple[bool, str]:
+        """Whether k arriving hosts can form new DP pipeline(s) from the
+        EXISTING templates alone (grow_dp's apply-time requirement)."""
+        if not self.templates or self.plan is None:
+            return False, "no_plan"
+        smallest = min(t.num_hosts for t in self.templates)
+        if k >= smallest:
+            return True, ""
+        return False, f"arrivals({k})<smallest_template({smallest})"
+
+    def _consult_policy_grow(self, joined_ips: list[str], *,
+                             cause: str = ""):
+        """Score the grow arms for an in-process-detected JOIN with the
+        same signals the master would use, plus the chaos spot-lifetime
+        hints only this process can see."""
+        pol = self._policy_engine()
+        staleness = None
+        plane = self._durable_plane()
+        if plane is not None:
+            durable = plane.last_durable_step
+            if durable is not None and durable >= 0:
+                staleness = max(float(self.step - durable), 0.0)
+        hints: dict[str, float] = {}
+        for ip in joined_ips:
+            lt = chaos().spot_lifetime(ip)
+            if lt:
+                hints[ip] = lt
+        dp_ok, dp_why = self._grow_dp_feasibility(len(joined_ips))
+        return pol.decide_grow(
+            joined_ips,
+            current_hosts=len(self.host_ips),
+            dp_feasible=dp_ok,
+            dp_reason=dp_why,
+            staleness_steps=staleness,
+            step_seconds=self._step_s_ewma,
+            lifetime_hints=hints,
+            cause=cause)
 
     def _reconfigure_fused(self, lost_ip: str, lost_host: int, t0: float) -> None:
         """Fused-path recovery: shrink the global mesh to the surviving

@@ -82,6 +82,7 @@ class RecoveryPrecompiler:
             "plans": 0, "stages_compiled": 0, "stages_cached": 0,
             "aux_compiled": 0, "errors": 0, "elapsed_s": None,
             "reroute_feasible": 0, "reroute_infeasible": 0,
+            "grow_plans": 0,
         }
         self._done_keys: set = set()
         self._thread: threading.Thread | None = None
@@ -213,8 +214,46 @@ class RecoveryPrecompiler:
                     next_frontier.append(assignment)
                     yield self._instantiate(plan, assignment)
             frontier = next_frontier
+        yield from self._predicted_grow(live_pipelines)
 
-    def _instantiate(self, plan, host_assignment):
+    def _predicted_grow(self, live_pipelines):
+        """Warm the most likely post-GROW plan: one arriving host folded
+        in as new DP pipeline(s) via engine.predict_grow — the SAME fit
+        the live grow_dp arm runs at JOIN time, so the exec-cache keys
+        match exactly. Only when a free device block exists to bind the
+        prediction against (the joiner's chips, by construction, are not
+        in engine.devices yet); grow_reshape recompiles by design (every
+        stage changes shape) and absorb_spare compiles nothing."""
+        engine = self.engine
+        cph = engine.chips_per_host
+        try:
+            if engine.multihost:
+                return  # multihost grows defer to the spare pool
+            bound = {id(d) for d in engine.devices}
+            pool = [d for d in jax.devices() if id(d) not in bound]
+            if len(pool) < cph:
+                return
+            current = [sorted({r // cph for r in p.ranks})
+                       for p in live_pipelines]
+            # The next joiner gets the next ORIGINAL host index — exactly
+            # what _admit_hosts will hand out.
+            plan, assignment, _idle = engine.predict_grow(
+                {len(engine._host_index)}, current=current)
+            if plan is None:
+                return  # no template fits a lone arrival: absorb, no compile
+        except Exception:
+            self.stats["errors"] += 1
+            logger.debug("grow prediction failed", exc_info=True)
+            return
+        self.stats["grow_plans"] += 1
+        logger.info(
+            "predicted one-host join: warming post-grow plan (%d pipelines)",
+            len(plan.instances),
+        )
+        yield self._instantiate(plan, assignment,
+                                devices=list(engine.devices) + pool[:cph])
+
+    def _instantiate(self, plan, host_assignment, devices=None):
         """Build the predicted plan's PipelineInstances: full stage layout
         (meshes, shardings, jitted stage fns registered in the SHARED exec
         cache) but no parameter arrays."""
@@ -222,12 +261,14 @@ class RecoveryPrecompiler:
         from oobleck_tpu.execution.reconfigure import hosts_to_ranks
 
         engine = self.engine
+        if devices is None:
+            devices = engine.devices
         assignments = plan.assignments(ranks=[
             hosts_to_ranks(hosts, engine.chips_per_host)
             for hosts in host_assignment
         ])
         process_of_rank = (
-            [r // engine.chips_per_host for r in range(len(engine.devices))]
+            [r // engine.chips_per_host for r in range(len(devices))]
             if engine.multihost else None
         )
         pipes = []
@@ -246,7 +287,7 @@ class RecoveryPrecompiler:
                         a.pipeline_index, record=False,
                     ),
                     model=engine.model,
-                    devices=engine.devices,
+                    devices=devices,
                     num_microbatches=a.num_microbatches,
                     total_num_microbatches=plan.total_num_microbatches,
                     microbatch_size=engine.args.job.microbatch_size,

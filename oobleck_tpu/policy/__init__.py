@@ -20,6 +20,10 @@ layered over ReCycle-style pipeline adaptation (arxiv 2405.14009).
 from oobleck_tpu.policy.engine import (  # noqa: F401
     DECISION_KEY,
     ENV_POLICY,
+    GROW_MODES,
+    MECH_ABSORB,
+    MECH_GROW_DP,
+    MECH_GROW_RESHAPE,
     MECH_REINSTANTIATE,
     MECH_REROUTE,
     MECH_RESTORE,
@@ -30,4 +34,8 @@ from oobleck_tpu.policy.engine import (  # noqa: F401
 )
 from oobleck_tpu.policy.health import HostHealthTracker  # noqa: F401
 from oobleck_tpu.policy.scorer import score_arms  # noqa: F401
-from oobleck_tpu.policy.signals import ArmSignals, build_arms  # noqa: F401
+from oobleck_tpu.policy.signals import (  # noqa: F401
+    ArmSignals,
+    build_arms,
+    build_grow_arms,
+)

@@ -24,7 +24,11 @@ from dataclasses import dataclass, field
 from oobleck_tpu.obs import spans
 from oobleck_tpu.policy.health import HostHealthTracker
 from oobleck_tpu.policy.scorer import cheapest_feasible, score_arms
-from oobleck_tpu.policy.signals import build_arms, priors_provenance
+from oobleck_tpu.policy.signals import (
+    build_arms,
+    build_grow_arms,
+    priors_provenance,
+)
 from oobleck_tpu.utils import metrics
 
 logger = logging.getLogger("oobleck.policy")
@@ -34,8 +38,18 @@ ENV_POLICY = "OOBLECK_POLICY"
 MECH_REROUTE = "reroute"
 MECH_REINSTANTIATE = "reinstantiate"
 MECH_RESTORE = "restore"
+# Grow-direction arms (JOIN incidents — capacity ARRIVING, PR 13).
+MECH_ABSORB = "absorb_spare"
+MECH_GROW_DP = "grow_dp"
+MECH_GROW_RESHAPE = "grow_reshape"
 MODE_ADAPTIVE = "adaptive"
-MODES = (MODE_ADAPTIVE, MECH_REROUTE, MECH_REINSTANTIATE, MECH_RESTORE)
+GROW_MODES = (MECH_ABSORB, MECH_GROW_DP, MECH_GROW_RESHAPE)
+# A forced mode only pins decisions in ITS direction: OOBLECK_POLICY=
+# grow_reshape forces grow incidents but leaves loss incidents adaptive
+# (and vice versa) — a cross-direction forced arm is not an error, it is
+# simply out of scope for that incident.
+MODES = (MODE_ADAPTIVE, MECH_REROUTE, MECH_REINSTANTIATE,
+         MECH_RESTORE) + GROW_MODES
 
 # Payload key the recovery broadcast carries the decision under (legacy
 # receivers ignore unknown keys, like spans.TRACE_KEY).
@@ -53,6 +67,7 @@ class PolicyDecision:
 
     mechanism: str
     lost_ips: list[str]
+    joined_ips: list = field(default_factory=list)  # grow incidents
     reason: str = "cheapest"       # "cheapest" | "forced:<m>" | fallback
     projected_cost_s: float | None = None
     measured_recovery_s: float | None = None
@@ -72,6 +87,7 @@ class PolicyDecision:
         return {
             "mechanism": self.mechanism,
             "lost_ips": list(self.lost_ips),
+            "joined_ips": list(self.joined_ips),
             "reason": self.reason,
             "projected_cost_s": self.projected_cost_s,
             "measured_recovery_s": self.measured_recovery_s,
@@ -114,9 +130,9 @@ def decision_from_payload(payload) -> PolicyDecision | None:
         return None
     d = PolicyDecision(mechanism=str(payload["mechanism"]),
                        lost_ips=list(payload.get("lost_ips") or []))
-    for k in ("reason", "projected_cost_s", "costs", "infeasible", "mtbf_s",
-              "quarantined", "proactive", "inplace", "trace_id",
-              "decided_at"):
+    for k in ("joined_ips", "reason", "projected_cost_s", "costs",
+              "infeasible", "mtbf_s", "quarantined", "proactive", "inplace",
+              "trace_id", "decided_at"):
         if k in payload and payload[k] is not None:
             setattr(d, k, payload[k])
     return d
@@ -219,13 +235,17 @@ class PolicyEngine:
             mtbf_s = min(mtbfs) if mtbfs else self.health.fleet_mtbf()
             scored = score_arms(arms, mtbf_s=mtbf_s)
 
-            if self.mode != MODE_ADAPTIVE:
-                if scored[self.mode].feasible:
-                    chosen, reason = scored[self.mode], f"forced:{self.mode}"
+            # A forced GROW arm is out of scope for a loss incident: this
+            # decision scores adaptively (the forced arm keeps pinning
+            # decide_grow).
+            forced = self.mode if self.mode in scored else MODE_ADAPTIVE
+            if forced != MODE_ADAPTIVE:
+                if scored[forced].feasible:
+                    chosen, reason = scored[forced], f"forced:{forced}"
                 else:
                     chosen = scored[MECH_REINSTANTIATE]
-                    reason = (f"forced:{self.mode}:infeasible:"
-                              f"{scored[self.mode].reason}")
+                    reason = (f"forced:{forced}:infeasible:"
+                              f"{scored[forced].reason}")
             else:
                 chosen = cheapest_feasible(scored)
                 reason = "cheapest"
@@ -254,6 +274,88 @@ class PolicyEngine:
         logger.info(
             "policy: %s for loss of %s (reason=%s cost=%.3fs mtbf=%s)",
             decision.mechanism, lost_ips, reason, chosen.cost_s,
+            f"{mtbf_s:.1f}s" if mtbf_s is not None else "n/a")
+        self._decisions.append(decision)
+        decision.record()
+        return decision
+
+    def decide_grow(self, joined_ips: list[str], *,
+                    current_hosts: int,
+                    dp_feasible: bool = True,
+                    dp_reason: str = "",
+                    staleness_steps: float | None = None,
+                    step_seconds: float | None = None,
+                    lifetime_hints: dict[str, float] | None = None,
+                    cause: str = "join") -> PolicyDecision:
+        """Score the grow arms for one JOIN incident and pick.
+
+        The amortization horizon is the arriving capacity's expected
+        LIFETIME, not the fleet's failure cadence: a `lifetime_hints`
+        entry (spot metadata / chaos spot_lifetime) wins, then the
+        joining host's own online MTBF (a flapper that left and came
+        back carries its history), then the fleet MTBF. Short expected
+        lifetimes make absorb_spare cheap — there is nothing to amortize
+        a reshape against — and simultaneously raise the churn hedge on
+        the arms that commit state to the newcomer."""
+        hints = lifetime_hints or {}
+        with spans.span("policy.decide_grow",
+                        joined_ips=",".join(joined_ips), cause=cause) as ctx:
+            arms = build_grow_arms(
+                joined_count=len(joined_ips),
+                current_hosts=current_hosts,
+                dp_feasible=dp_feasible,
+                dp_reason=dp_reason,
+                staleness_steps=staleness_steps,
+                step_seconds=step_seconds,
+                latency_overrides=self._ewma,
+                registry=self._registry,
+                priors_path=self._priors_path,
+            )
+            lifetimes = [
+                lt for lt in (hints.get(ip) or self.health.mtbf(ip)
+                              for ip in joined_ips)
+                if lt is not None
+            ]
+            mtbf_s = min(lifetimes) if lifetimes else self.health.fleet_mtbf()
+            scored = score_arms(arms, mtbf_s=mtbf_s)
+
+            # A forced SHRINK arm is out of scope here (see MODES); an
+            # infeasible forced grow arm falls back to absorb_spare — the
+            # grow direction's always-available mechanism.
+            forced = self.mode if self.mode in scored else MODE_ADAPTIVE
+            if forced != MODE_ADAPTIVE:
+                if scored[forced].feasible:
+                    chosen, reason = scored[forced], f"forced:{forced}"
+                else:
+                    chosen = scored[MECH_ABSORB]
+                    reason = (f"forced:{forced}:infeasible:"
+                              f"{scored[forced].reason}")
+            else:
+                chosen = cheapest_feasible(scored)
+                reason = "cheapest"
+                if chosen is None:  # cannot happen: absorb_spare is
+                    chosen = scored[MECH_ABSORB]  # always feasible
+                    reason = "fallback"
+
+            decision = PolicyDecision(
+                mechanism=chosen.mechanism,
+                lost_ips=[],
+                joined_ips=list(joined_ips),
+                reason=reason,
+                projected_cost_s=chosen.cost_s,
+                costs={m: a.cost_s for m, a in scored.items()},
+                infeasible={m: a.reason for m, a in scored.items()
+                            if not a.feasible},
+                arms={m: dict(arms[m].as_record(),
+                              **scored[m].as_record())
+                      for m in arms},
+                mtbf_s=mtbf_s,
+                quarantined=self.health.quarantined(),
+                trace_id=ctx["trace_id"],
+            )
+        logger.info(
+            "policy: %s for join of %s (reason=%s cost=%.3fs lifetime=%s)",
+            decision.mechanism, joined_ips, reason, chosen.cost_s,
             f"{mtbf_s:.1f}s" if mtbf_s is not None else "n/a")
         self._decisions.append(decision)
         decision.record()

@@ -1,0 +1,144 @@
+"""Grow-plane microbench: join-to-first-post-grow-step for each grow arm.
+
+One scripted arrival — two fresh hosts joining a 2-host (4 virtual CPU
+chips) rig mid-training, with a warm durable checkpoint — is replayed
+four times: once per forced grow arm (``absorb_spare`` / ``grow_dp`` /
+``grow_reshape``, constructed directly so the arms share one process and
+one compile cache) and once adaptive. The paper's recovery metric is
+measured in the grow direction: JOIN injection until the NEXT train step
+completes, plus the step time before and after the grow so the output
+shows whether the arm actually bought throughput (absorb_spare by design
+does not; grow_dp and grow_reshape must — the arrivals double the fleet).
+
+Run as ``python -m oobleck_tpu.policy.grow_bench`` under
+JAX_PLATFORMS=cpu with XLA_FLAGS=--xla_force_host_platform_device_count=8
+(bench.py and ``make grow-bench`` set this up): the engine binds the
+first 4 virtual devices, the joiners bind the free 4. Prints ONE JSON
+line on stdout.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import tempfile
+import time
+
+_MODEL_ARGS = {"hidden_size": 64, "num_layers": 4,
+               "max_position_embeddings": 32}
+
+_HOSTS = ["10.0.0.0", "10.0.0.1"]
+_JOINERS = ["10.0.0.2", "10.0.0.3"]
+
+ARMS = ("adaptive", "absorb_spare", "grow_dp", "grow_reshape")
+
+
+def _make_engine(ckpt_dir: str):
+    import jax
+
+    from oobleck_tpu.config import (
+        DistributedArguments,
+        JobArguments,
+        ModelArguments,
+        OobleckArguments,
+    )
+    from oobleck_tpu.execution.engine import OobleckEngine
+
+    args = OobleckArguments(
+        dist=DistributedArguments(node_ips=list(_HOSTS)),
+        job=JobArguments(
+            microbatch_size=1,
+            global_microbatch_size=8,
+            steps=64,
+            learning_rate=1e-3,
+            warmup_steps=2,
+        ),
+        model=ModelArguments(
+            model_name="gpt2-tiny", dataset_path="synthetic",
+            model_tag="grow-bench",  # own profile cache: non-default args
+            model_args=dict(_MODEL_ARGS),
+        ),
+    )
+    args.execution.checkpoint_dir = ckpt_dir
+    args.execution.precompile_recovery_depth = 0  # mechanism cost, not warmth
+    args.execution.eval_fraction = 0.0
+    engine = OobleckEngine(args, devices=jax.devices()[:4])
+    engine.initialize_distributed()
+    engine.instantiate_pipelines(args.job.global_num_microbatch)
+    return engine
+
+
+def _timed_step(eng) -> float:
+    t0 = time.perf_counter()
+    eng._train_step()
+    return time.perf_counter() - t0
+
+
+def _run_arm(mode: str, ckpt_root: str) -> dict:
+    """One scripted arrival under one policy mode. Fresh engine, fresh
+    checkpoint dir, identical joiners."""
+    from oobleck_tpu.policy import PolicyEngine
+    from oobleck_tpu.utils import metrics
+
+    eng = _make_engine(os.path.join(ckpt_root, mode))
+    eng._policy = PolicyEngine(multihost=False, mode=mode)
+    for _ in range(2):
+        eng._train_step()
+    eng.save_checkpoint(wait=True)
+    step_before = _timed_step(eng)
+
+    before = len(metrics.flight_recorder().events())
+    t0 = time.perf_counter()
+    eng.request_grow(list(_JOINERS))
+    eng._maybe_grow()
+    eng._train_step()
+    latency = time.perf_counter() - t0
+    step_after = _timed_step(eng)
+
+    tail = metrics.flight_recorder().events()[before:]
+    decision = next((e for e in tail
+                     if e.get("event") == "policy_decision"), {})
+    return {
+        "join_to_first_step_s": round(latency, 3),
+        "step_s_before": round(step_before, 3),
+        "step_s_after": round(step_after, 3),
+        "mechanism": decision.get("mechanism"),
+        "reason": decision.get("reason"),
+        "projected_cost_s": decision.get("projected_cost_s"),
+        "hosts_after": len(eng.host_ips),
+        "spares_after": len(eng._spare_hosts),
+        "pipelines_after": len(eng.pipelines),
+    }
+
+
+def measure() -> dict:
+    out: dict = {
+        "rig": "2 hosts x (1-host pipeline on 2 virtual CPU chips) growing "
+               "by 2 joiners, gpt2-tiny h64/L4/seq32, durable ckpt warm",
+        "joiners": list(_JOINERS),
+    }
+    arms = {}
+    with tempfile.TemporaryDirectory(prefix="grow-bench-") as root:
+        for mode in ARMS:
+            arms[mode] = _run_arm(mode, root)
+    out["arms"] = arms
+    # Headline per direction of the tradeoff: the cheapest interruption
+    # (absorb) and the cheapest arm that actually grew throughput.
+    out["absorb_join_s"] = arms["absorb_spare"]["join_to_first_step_s"]
+    grew = {m: a for m, a in arms.items()
+            if m in ("grow_dp", "grow_reshape")
+            and a["pipelines_after"] > 2}
+    if grew:
+        best = min(sorted(grew), key=lambda m: grew[m]["join_to_first_step_s"])
+        out["best_grow_arm"] = best
+        out["best_grow_join_s"] = grew[best]["join_to_first_step_s"]
+    return out
+
+
+def main() -> None:
+    os.environ.setdefault("JAX_PLATFORMS", "cpu")
+    print(json.dumps(measure()))
+
+
+if __name__ == "__main__":
+    main()

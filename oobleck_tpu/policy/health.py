@@ -40,6 +40,11 @@ class HostHealthTracker:
         self._failures: dict[str, list[float]] = {}
         self._causes: dict[str, str] = {}
         self._quarantined_at: dict[str, float] = {}
+        # Hosts whose quarantine lifted (hysteresis satisfied) and that
+        # have not yet re-registered: the master's REGISTER path consumes
+        # this to tag the handshake as a quarantine_rejoin rather than a
+        # first-contact register.
+        self._lifted: set[str] = set()
 
     # -- failure log -------------------------------------------------------- #
 
@@ -51,6 +56,7 @@ class HostHealthTracker:
         window = self.window(ip)
         if log and now - log[-1] <= window:
             self._quarantined_at[ip] = now
+            self._lifted.discard(ip)  # relapse voids any pending rejoin tag
         log.append(now)
         del log[:-MAX_EVENTS_PER_HOST]
         if cause:
@@ -93,8 +99,21 @@ class HostHealthTracker:
         last = self._failures[ip][-1]
         if self._clock() - last >= self._hysteresis_factor * self.window(ip):
             del self._quarantined_at[ip]
+            self._lifted.add(ip)
             return False
         return True
+
+    def consume_lift(self, ip: str) -> bool:
+        """One-shot: True iff this host's quarantine lifted since it last
+        (re)registered — the REGISTER handshake for such a host is a
+        quarantine REJOIN, and the distinction must survive into the
+        flight record. Calling is_quarantined first ensures a lazily
+        expired quarantine is counted before being consumed."""
+        self.is_quarantined(ip)
+        if ip in self._lifted:
+            self._lifted.discard(ip)
+            return True
+        return False
 
     def quarantined(self) -> list[str]:
         return sorted(ip for ip in list(self._quarantined_at)

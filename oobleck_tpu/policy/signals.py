@@ -37,6 +37,14 @@ PRIOR_LATENCY_S = {
     "reinstantiate": 0.7,          # warm in-place re-instantiation
     "reinstantiate_respawn": 21.0,  # multihost: respawn + re-init
     "restore": 25.0,
+    # Grow-direction arms (JOIN incidents). absorb_spare only appends to
+    # the spare pool (bookkeeping, no topology change); grow_dp is a warm
+    # re-materialization at unchanged template size (one extra replica);
+    # grow_reshape is a restore-across-reshape — durable read + larger-
+    # template re-instantiation, priced like restore plus the re-match.
+    "absorb_spare": 0.05,
+    "grow_dp": 1.0,
+    "grow_reshape": 26.0,
 }
 # Step-time prior when no measured step seconds are available yet (only
 # used to price checkpoint staleness in lost-work seconds).
@@ -231,3 +239,71 @@ def build_arms(*,
         restore.lost_work_s = max(float(staleness_steps), 0.0) * (
             step_seconds if step_seconds else PRIOR_STEP_S)
     return {"reroute": reroute, "reinstantiate": reinst, "restore": restore}
+
+
+def build_grow_arms(*,
+                    joined_count: int,
+                    current_hosts: int,
+                    dp_feasible: bool = True,
+                    dp_reason: str = "",
+                    staleness_steps: float | None = None,
+                    step_seconds: float | None = None,
+                    latency_overrides: dict[str, float] | None = None,
+                    registry=None,
+                    priors_path: str | None = None) -> dict[str, ArmSignals]:
+    """Assemble the three GROW arms for one JOIN incident.
+
+    Retention is measured against the POST-grow throughput ceiling: the
+    scorer's degraded term then prices the gain an arm forgoes by not
+    absorbing the arrivals, with the same amortization horizon a shrink
+    decision uses — except here the horizon is the arriving host's
+    expected LIFETIME (a spot host that will vanish in 30 s cannot
+    amortize a 26 s reshape, so absorb_spare wins; a long-lived arrival
+    flips it). The in_memory flag keeps the churn hedge: grow_dp and
+    grow_reshape commit live state onto the newcomer, so its early death
+    schedules the next recovery; parking a spare risks nothing.
+
+    ``dp_feasible`` is the planner's verdict on whether the arrivals can
+    form a whole extra replica of an already-instantiated template size;
+    ``staleness_steps`` prices grow_reshape's restore-across-reshape
+    rollback (None = no durable checkpoint: the reshape falls back to a
+    live-state re-instantiation, which replays nothing).
+    """
+    n, k = max(int(current_hosts), 0), max(int(joined_count), 0)
+    kept = (n / (n + k)) if (n + k) else 1.0
+
+    absorb = ArmSignals(
+        mechanism="absorb_spare",
+        latency_s=0.0, latency_source="",
+        retention=kept,
+        in_memory=False,
+    )
+    absorb.latency_s, absorb.latency_source, absorb.prior_source = _latency(
+        "absorb_spare", "absorb_spare", latency_overrides, registry,
+        priors_path)
+
+    grow_dp = ArmSignals(
+        mechanism="grow_dp",
+        latency_s=0.0, latency_source="",
+        retention=1.0,
+    )
+    grow_dp.latency_s, grow_dp.latency_source, grow_dp.prior_source = \
+        _latency("grow_dp", "grow_dp", latency_overrides, registry,
+                 priors_path)
+    if not dp_feasible:
+        grow_dp.feasible, grow_dp.reason = False, (dp_reason
+                                                   or "no_template_fit")
+
+    reshape = ArmSignals(
+        mechanism="grow_reshape",
+        latency_s=0.0, latency_source="",
+        retention=1.0,
+    )
+    reshape.latency_s, reshape.latency_source, reshape.prior_source = \
+        _latency("grow_reshape", "grow_reshape", latency_overrides,
+                 registry, priors_path)
+    if staleness_steps is not None:
+        reshape.lost_work_s = max(float(staleness_steps), 0.0) * (
+            step_seconds if step_seconds else PRIOR_STEP_S)
+    return {"absorb_spare": absorb, "grow_dp": grow_dp,
+            "grow_reshape": reshape}

@@ -28,6 +28,7 @@ SUITE = (
     ("preemption_wave_64", "spot_preemption_wave", 64, 600.0, 1117, {}),
     ("flap_sequence_64", "flap_sequence", 64, 600.0, 1117, {}),
     ("diurnal_traffic_64", "diurnal_traffic", 64, 1800.0, 1117, {}),
+    ("capacity_arrival_64", "capacity_arrival", 64, 600.0, 1117, {}),
     ("churn_storm_1024", "churn_storm", 1024, 600.0, 1117,
      {"mean_interarrival_s": 4.0}),
 )

@@ -11,8 +11,10 @@ only what hardware would have provided: a fleet, a scripted failure
 process, and the passage of time.
 
 Time advances through a heapq of (t, seq, ...) events — scenario-scripted
-failures/preemptions/traffic swings plus the repairs and recovery
-completions they cause. Goodput is integrated piecewise-constant:
+failures/preemptions/traffic swings/capacity arrivals plus the repairs,
+spot-lifetime expiries, and recovery completions they cause. Arrivals run
+through ``PolicyEngine.decide_grow`` exactly as losses run through
+``decide``: the simulator models capacity, never the decision. Goodput is integrated piecewise-constant:
 delivered = min(relative_rate, demand); recovery windows deliver zero
 (reconfigure blocks the job, as on the real cluster).
 
@@ -204,6 +206,88 @@ class SimCluster:
 
     # -- the incident -------------------------------------------------------- #
 
+    def _handle_join(self, events: list) -> None:
+        """One grow incident: a batch of same-instant arrivals decided by
+        the REAL ``PolicyEngine.decide_grow`` (the same chain the live
+        master runs), then applied to the cluster model — absorb_spare
+        parks the arrivals (zero stall), grow_dp keeps every surviving
+        pipeline's host group intact and adds replica(s) over the
+        arrivals (the batch redistribution is the stall), grow_reshape
+        re-instantiates the whole layout over every usable host."""
+        events = [e for e in events if e.host not in self.live]
+        if not events:
+            return
+        joined = sorted(e.host for e in events)
+        joined_ips = [self._ip(h) for h in joined]
+        hints = {self._ip(e.host): e.repair_delay_s
+                 for e in events if e.repair_delay_s > 0}
+        hpp = self.config.hosts_per_pipeline
+        current = len({h for p in self.pipelines for h in p.hosts})
+        staleness_steps, _ = self._staleness()
+        dp_ok = bool(self.pipelines) and len(joined) >= hpp
+        decision = self.engine.decide_grow(
+            joined_ips,
+            current_hosts=max(current, 1),
+            dp_feasible=dp_ok,
+            dp_reason="" if dp_ok
+            else f"arrivals({len(joined)})<pipeline_unit({hpp})",
+            staleness_steps=staleness_steps,
+            step_seconds=self._step_seconds(),
+            lifetime_hints=hints,
+            cause=events[0].cause or "join")
+
+        rate_before = self._rate()
+        self.live |= set(joined)
+        stalls = decision.mechanism != "absorb_spare"
+        if decision.mechanism == "grow_dp":
+            # Survivor groups untouched; arrivals form whole new replica
+            # blocks; the FIXED global microbatch budget re-spreads evenly
+            # (remainder to the lowest-indexed pipelines, as _rebuild).
+            for i in range(len(joined) // hpp):
+                self.pipelines.append(_Pipeline(
+                    hosts=joined[i * hpp:(i + 1) * hpp], microbatches=0))
+            base, rem = divmod(self._total_microbatches, len(self.pipelines))
+            for i, p in enumerate(self.pipelines):
+                p.microbatches = base + (1 if i < rem else 0)
+        elif decision.mechanism == "grow_reshape":
+            self._rebuild()
+
+        realized = (decision.arms[decision.mechanism]["latency_s"]
+                    * self.rng.uniform(JITTER_LO, JITTER_HI))
+        self.engine.observe_measured(decision.mechanism, realized)
+        if stalls:
+            self._recovery_until = max(self._recovery_until,
+                                       self.now + realized)
+            self._push(self._recovery_until, "recovered", None)
+
+        reg = self.registry
+        reg.histogram(
+            "oobleck_sim_recovery_seconds",
+            "Simulated realized recovery latency by mechanism",
+        ).observe(realized, mechanism=decision.mechanism)
+        reg.counter(
+            "oobleck_sim_incidents_total",
+            "Simulated incidents by mechanism and cause",
+        ).inc(mechanism=decision.mechanism, cause=events[0].cause or "join")
+        self.incidents.append({
+            "t": round(self.now, 6),
+            "direction": "grow",
+            "joined_hosts": len(joined),
+            "lost_hosts": 0,
+            "cause": events[0].cause or "join",
+            "correlated": len(joined) > 1,
+            "proactive": False,
+            "mechanism": decision.mechanism,
+            "reason": decision.reason,
+            "projected_cost_s": round(decision.projected_cost_s, 6),
+            "realized_recovery_s": round(realized, 6),
+            "arms": decision.arms,
+            "rate_before": round(rate_before, 6),
+            "rate_after": round(self._rate(), 6),
+            "live_hosts": len(self.live),
+            "pipelines": len(self.pipelines),
+        })
+
     def _handle_incident(self, events: list) -> None:
         events = [e for e in events if e.host in self.live]
         if not events:
@@ -340,6 +424,31 @@ class SimCluster:
                             self._push(t + max(ev.repair_delay_s, 0.0),
                                        "repair", ev.host)
                     self._handle_incident(batch)
+                elif payload.kind == "join":
+                    # Same-instant arrivals sharing an incident_id are ONE
+                    # grow incident — the live master's JOIN-window batch.
+                    batch = [payload]
+                    while (self._heap and self._heap[0][0] == t
+                           and self._heap[0][2] == "scenario"
+                           and getattr(self._heap[0][3], "kind", "")
+                           == "join"
+                           and self._heap[0][3].incident_id
+                           == payload.incident_id):
+                        batch.append(heapq.heappop(self._heap)[3])
+                    for ev in batch:
+                        if ev.repair_delay_s > 0:
+                            # Spot lifetime: the host dies for good when
+                            # the advertised deadline lapses.
+                            self._push(t + ev.repair_delay_s, "expire",
+                                       ev.host)
+                    self._handle_join(batch)
+            elif kind == "expire":
+                if payload in self.live:
+                    from oobleck_tpu.sim.scenarios import ScenarioEvent
+
+                    self._handle_incident([ScenarioEvent(
+                        t=t, kind="fail", host=payload,
+                        cause="spot_lifetime")])
             elif kind == "repair":
                 if payload not in self.live:
                     self.live.add(payload)

@@ -28,14 +28,16 @@ RACK_SIZE = 8
 class ScenarioEvent:
     """One scripted occurrence. kind: "fail" (host dies; rejoins after
     repair_delay_s), "preempt" (spot notice: proactive drain, then the
-    host dies), or "traffic" (demand factor changes)."""
+    host dies), "join" (fresh capacity arrives mid-run; repair_delay_s
+    doubles as the advertised spot lifetime, 0 = on-demand), or
+    "traffic" (demand factor changes)."""
 
     t: float
     kind: str
     host: int = -1
     incident_id: int = -1          # same id + same t -> correlated batch
     cause: str = ""
-    repair_delay_s: float = 0.0
+    repair_delay_s: float = 0.0    # "join": advertised spot lifetime
     demand: float = 1.0            # "traffic" only
 
 
@@ -159,8 +161,46 @@ def diurnal_traffic(rng: random.Random, hosts: int, duration_s: float, *,
     return events
 
 
+def capacity_arrival(rng: random.Random, hosts: int, duration_s: float, *,
+                     arrivals: int = 6, burst_prob: float = 0.4,
+                     spot_frac: float = 0.5,
+                     mean_lifetime_s: float = 300.0,
+                     mean_interarrival_s: float = 30.0,
+                     mean_repair_s: float = 120.0) -> list[ScenarioEvent]:
+    """Capacity churn in BOTH directions: background failures (so the
+    grow decisions price a real churn regime, not a quiet one) plus fresh
+    hosts arriving mid-run — sometimes two in one burst, which the live
+    master batches into ONE grow incident and the cluster model must too.
+    Each arrival pre-draws whether it is spot (finite advertised
+    lifetime; the host dies for good when it expires) or on-demand
+    (lifetime 0 = no deadline), so absorb-vs-grow amortization is decided
+    against the same signal the live policy plane sees."""
+    events = churn_storm(rng, hosts, duration_s,
+                         mean_interarrival_s=mean_interarrival_s * 4,
+                         mean_repair_s=mean_repair_s)
+    incident = 1_000_000  # join incident ids never collide with failures
+    next_host, t, made = hosts, 0.0, 0
+    while made < arrivals:
+        t += _exp(rng, mean_interarrival_s)
+        if t >= duration_s:
+            break
+        burst = 2 if rng.random() < burst_prob else 1
+        for _ in range(min(burst, arrivals - made)):
+            lifetime = (round(_exp(rng, mean_lifetime_s), 6)
+                        if rng.random() < spot_frac else 0.0)
+            events.append(ScenarioEvent(
+                t=round(t, 6), kind="join", host=next_host,
+                incident_id=incident, cause="capacity",
+                repair_delay_s=lifetime))
+            next_host += 1
+            made += 1
+        incident += 1
+    return events
+
+
 GENERATORS = {
     "churn_storm": churn_storm,
+    "capacity_arrival": capacity_arrival,
     "correlated_rack_loss": correlated_rack_loss,
     "spot_preemption_wave": spot_preemption_wave,
     "flap_sequence": flap_sequence,

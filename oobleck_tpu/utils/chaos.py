@@ -45,6 +45,25 @@ directives; each directive is ``action=arg[:qual][@ip]``:
                                 dies for real 5 s later — the window the
                                 proactive drain + checkpoint flush must
                                 fit inside
+    join_host=10.0.0.5          capacity arrival: host 10.0.0.5 JOINs the
+                                running job at the next step boundary,
+                                once. The joiner has no process yet, so
+                                for THIS action the ``@`` segment is a
+                                step-boundary delay, not a process
+                                filter: ``join_host=10.0.0.5@3`` arrives
+                                after 3 step polls (deterministic — the
+                                engine polls once per step)
+    join_hosts=10.0.0.5+10.0.0.6  correlated capacity arrival: both hosts
+                                JOIN in the SAME step boundary, once —
+                                the near-simultaneous-arrival case the
+                                master's grow batching window exists for
+    spot_lifetime=10.0.0.5:30   the arriving host is a spot instance
+                                expected to live ~30 s: the policy plane
+                                reads this (NON-consuming) as the
+                                amortization horizon when scoring the
+                                grow arms, and the engine arms a deferred
+                                synthetic loss of that host 30 s after it
+                                is admitted — arrival followed by churn
 
 Barriers are explicit calls (``chaos().barrier("step_end", ip=...)``)
 placed at recovery-relevant points: worker start, step start/end, and
@@ -72,7 +91,8 @@ ENV_VAR = "OOBLECK_CHAOS"
 
 _KNOWN_ACTIONS = ("delay_send", "drop_send", "stall_heartbeat", "kill_at",
                   "delay_at", "kill_stage", "flap_host", "kill_hosts",
-                  "preempt_notice")
+                  "preempt_notice", "join_host", "join_hosts",
+                  "spot_lifetime")
 
 
 @dataclass
@@ -134,6 +154,23 @@ def parse_spec(spec: str) -> list[Rule]:
             if not rule.ip:
                 raise ValueError(
                     f"preempt_notice needs a victim @ip: {directive!r}")
+        elif action == "join_host":
+            if not rule.arg:        # join_host=<ip>[@<step-delay>]
+                raise ValueError(
+                    f"join_host needs a joining ip: {directive!r}")
+            int(rule.ip or 0)       # @segment = step-boundary delay
+        elif action == "join_hosts":
+            if not rule.arg or not all(p for p in rule.arg.split("+")):
+                raise ValueError(
+                    f"join_hosts needs '+'-joined host ips: {directive!r}")
+            int(rule.ip or 0)
+        elif action == "spot_lifetime":
+            if not rule.arg:        # spot_lifetime=<ip>:<secs>
+                raise ValueError(
+                    f"spot_lifetime needs a host ip: {directive!r}")
+            if float(rule.qual or 0) <= 0:
+                raise ValueError(
+                    f"spot_lifetime needs positive seconds: {directive!r}")
         elif rule.qual is not None:
             int(rule.qual)
         rules.append(rule)
@@ -283,6 +320,50 @@ class Chaos:
                 "chaos_injection", action="preempt_notice", ip=ip,
                 warn_seconds=warn, delay_seconds=delay)
             return warn, delay
+        return None
+
+    # -- capacity arrivals (grow-plane faults) ------------------------------ #
+
+    def join_targets(self) -> list[str] | None:
+        """One-shot list of hosts ARRIVING at this step boundary, or None.
+
+        The engine polls once per step; a join_host rule with ``@<delay>``
+        fires on poll number delay+1 (deterministic down to the step).
+        Several rules maturing at the same poll — or one join_hosts rule —
+        return together: a correlated arrival the master-side batching
+        window must fold into ONE grow incident. Consuming per rule."""
+        arrived: list[str] = []
+        for r in self.rules:
+            if r.action not in ("join_host", "join_hosts"):
+                continue
+            i = self.rules.index(r)
+            n = self._counts.get(i, 0)
+            if n < 0:
+                continue  # already fired
+            delay = int(r.ip or 0)
+            if n < delay:
+                self._counts[i] = n + 1
+                continue
+            self._counts[i] = -1
+            arrived.extend(p for p in r.arg.split("+") if p)
+        if not arrived:
+            return None
+        logger.warning("chaos: hosts %s arriving (JOIN)", arrived)
+        from oobleck_tpu.utils import metrics
+
+        metrics.flight_recorder().record(
+            "chaos_injection", action="join_host", ips=arrived)
+        return arrived
+
+    def spot_lifetime(self, ip: str | None) -> float | None:
+        """Expected lifetime (seconds) of arriving spot host `ip`, or None
+        when no spot_lifetime rule names it. NON-consuming: the policy
+        scorer reads it per decision as the amortization horizon, and the
+        engine reads it once more when admitting the host to arm the
+        deferred synthetic loss."""
+        for r in self.rules:
+            if r.action == "spot_lifetime" and r.arg == ip:
+                return float(r.qual or 0)
         return None
 
     # -- named barriers ---------------------------------------------------- #

@@ -276,6 +276,36 @@ def test_obl004_fires_on_unknown_new_verb(analyze):
     assert "new verb" in result.new[0].message
 
 
+def test_obl004_grow_verb_must_reach_engine_and_agent(analyze):
+    """GROW is a first-class verb: an agent that never dispatches it, or
+    an engine listener without the 'grow' pipe arm, fails the lint — the
+    grow plane cannot silently regress to a control-plane-only feature."""
+    files = _protocol_files(
+        agent_refs="return (ResponseType.SUCCESS, "
+                   "ResponseType.RECONFIGURATION, ResponseType.GROW)",
+        engine_strings="return kind == 'reconfigure'",
+        members=("SUCCESS", "RECONFIGURATION", "GROW"))
+    result = analyze(files)
+    assert codes(result) == ["OBL004"]
+    assert "'grow'" in result.new[0].message
+
+    files = _protocol_files(
+        agent_refs="return (ResponseType.SUCCESS, "
+                   "ResponseType.RECONFIGURATION)",
+        engine_strings="return kind in ('reconfigure', 'grow')",
+        members=("SUCCESS", "RECONFIGURATION", "GROW"))
+    result = analyze(files)
+    assert codes(result) == ["OBL004"]
+    assert "GROW" in result.new[0].message
+
+    files = _protocol_files(
+        agent_refs="return (ResponseType.SUCCESS, "
+                   "ResponseType.RECONFIGURATION, ResponseType.GROW)",
+        engine_strings="return kind in ('reconfigure', 'grow')",
+        members=("SUCCESS", "RECONFIGURATION", "GROW"))
+    assert codes(analyze(files)) == []
+
+
 def test_obl004_broadcast_payload_literal_key(analyze):
     files = _protocol_files(
         agent_refs="return (ResponseType.SUCCESS, "

@@ -54,6 +54,20 @@ def test_parse_spec_churn_directives():
     ]
 
 
+def test_parse_spec_grow_directives():
+    """Capacity-arrival grammar: for join_host/join_hosts the @ segment is
+    a STEP-BOUNDARY delay (the joiner has no process to filter on yet)."""
+    rules = parse_spec(
+        "join_host=10.0.0.5@3, join_hosts=10.0.0.5+10.0.0.6@1,"
+        "spot_lifetime=10.0.0.5:30"
+    )
+    assert [(r.action, r.arg, r.qual, r.ip) for r in rules] == [
+        ("join_host", "10.0.0.5", None, "3"),
+        ("join_hosts", "10.0.0.5+10.0.0.6", None, "1"),
+        ("spot_lifetime", "10.0.0.5", "30", None),
+    ]
+
+
 @pytest.mark.parametrize("bad", [
     "explode=now",            # unknown action
     "delay_send",             # no '='
@@ -68,6 +82,11 @@ def test_parse_spec_churn_directives():
     "preempt_notice=5",       # no victim @ip
     "preempt_notice=0@10.0.0.1",      # non-positive warning
     "preempt_notice=soon@10.0.0.1",   # non-numeric warning
+    "join_host=",             # no joining ip
+    "join_host=10.0.0.5@soon",        # non-integer step delay
+    "join_hosts=10.0.0.5++10.0.0.6",  # empty segment
+    "spot_lifetime=:30",      # no host ip
+    "spot_lifetime=10.0.0.5:0",       # non-positive lifetime
 ])
 def test_parse_spec_rejects_typos_eagerly(bad):
     # A typo'd injection spec must fail the run at parse time, not
@@ -121,6 +140,30 @@ def test_churn_directive_semantics():
                 if e["event"] == "chaos_injection"}
     assert {("flap_host", "10.0.0.1"), ("kill_hosts", None),
             ("preempt_notice", "10.0.0.4")} <= injected
+
+
+def test_join_targets_delay_merge_and_one_shot():
+    """join_targets is polled once per step: a rule with @<delay> matures
+    on poll delay+1; rules maturing at the SAME poll merge into one batch
+    (the correlated arrival the master's grow window folds); each rule is
+    consumed exactly once — a host cannot arrive twice."""
+    c = Chaos("join_host=10.0.0.5@1,join_hosts=10.0.0.6+10.0.0.7@1,"
+              "join_host=10.0.0.8@3")
+    assert c.join_targets() is None                      # poll 1: maturing
+    assert c.join_targets() == ["10.0.0.5", "10.0.0.6", "10.0.0.7"]
+    assert c.join_targets() is None                      # consumed
+    assert c.join_targets() == ["10.0.0.8"]              # poll 4
+    assert c.join_targets() is None
+
+
+def test_spot_lifetime_is_non_consuming():
+    """The policy scorer reads the lifetime hint per decision AND the
+    engine reads it again at admit; a consuming accessor would starve the
+    second reader."""
+    c = Chaos("spot_lifetime=10.0.0.5:30")
+    assert c.spot_lifetime("10.0.0.5") == pytest.approx(30.0)
+    assert c.spot_lifetime("10.0.0.5") == pytest.approx(30.0)
+    assert c.spot_lifetime("10.0.0.9") is None
 
 
 def test_inactive_chaos_is_a_noop():

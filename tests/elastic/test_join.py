@@ -1,0 +1,229 @@
+"""Mid-training JOIN over real localhost TCP: the grow-direction mirror of
+test_control_plane.py's disconnect tests. Near-simultaneous JOINs must fold
+into ONE GROW broadcast (batching window), refusals must be explicit and
+flight-recorded, and a quarantine-lifted host's re-registration must be
+tagged quarantine_rejoin — a rejoin reads very differently from a
+first-contact register in a postmortem."""
+
+import asyncio
+
+import pytest
+
+from oobleck_tpu.config import OobleckArguments
+from oobleck_tpu.elastic.master import OobleckMasterDaemon
+from oobleck_tpu.elastic.message import (
+    JOINED_KEY,
+    RequestType,
+    ResponseType,
+    recv_msg,
+    send_request,
+)
+from oobleck_tpu.policy import (
+    DECISION_KEY,
+    GROW_MODES,
+    MECH_ABSORB,
+    MECH_GROW_DP,
+    MECH_GROW_RESHAPE,
+)
+from oobleck_tpu.policy.health import HostHealthTracker
+from oobleck_tpu.utils import metrics
+
+from tests.elastic.test_control_plane import (
+    RecordingLauncher,
+    launch_job,
+    register_agent,
+    start_master,
+)
+
+
+@pytest.fixture(autouse=True)
+def _fresh_flight(monkeypatch):
+    # The flight recorder is a bounded module-global ring: a len()-based
+    # tail breaks once the full suite has filled it (new events evict old
+    # ones while the length stays pinned at capacity). Fresh ring per test.
+    monkeypatch.setattr(metrics, "_flight", metrics.FlightRecorder())
+
+
+@pytest.fixture
+def job_args():
+    args = OobleckArguments()
+    args.dist.node_ips = ["10.0.0.1", "10.0.0.2"]
+    return args
+
+
+async def join(daemon, ip, spot_lifetime_s=None):
+    r, w = await asyncio.open_connection("127.0.0.1", daemon.port)
+    payload = {"ip": ip}
+    if spot_lifetime_s is not None:
+        payload["spot_lifetime_s"] = spot_lifetime_s
+    await send_request(w, RequestType.JOIN, payload)
+    msg = await recv_msg(r, timeout=5)
+    return r, w, msg
+
+
+def _flight_tail(n0):
+    return metrics.flight_recorder().events()[n0:]
+
+
+@pytest.mark.asyncio
+async def test_near_simultaneous_joins_fold_into_one_grow(job_args,
+                                                          monkeypatch):
+    """Two JOINs inside the batching window -> ONE grow incident: one
+    join_detected, one GROW broadcast to EVERY agent (survivors and
+    joiners alike), with both ips and all three arm costs attached."""
+    monkeypatch.setenv("OOBLECK_JOIN_WINDOW", "0.4")
+    n0 = len(metrics.flight_recorder().events())
+    daemon, _, task = await start_master()
+    await launch_job(daemon, job_args)
+    r1, w1, _ = await register_agent(daemon, "10.0.0.1")
+    r2, w2, _ = await register_agent(daemon, "10.0.0.2")
+
+    rj1, wj1, msg1 = await join(daemon, "10.0.0.4", spot_lifetime_s=120)
+    rj2, wj2, msg2 = await join(daemon, "10.0.0.5")
+    for msg in (msg1, msg2):
+        # The JOIN handshake mirrors register: SUCCESS with the job args.
+        assert msg["kind"] == ResponseType.SUCCESS.value
+        assert msg["args"]["model"]["model_name"] \
+            == job_args.model.model_name
+
+    # Every agent — the two survivors AND the two joiners — gets the one
+    # broadcast once the window closes.
+    grows = []
+    for r in (r1, r2, rj1, rj2):
+        grows.append(await recv_msg(r, timeout=5))
+    for msg in grows:
+        assert msg["kind"] == ResponseType.GROW.value
+        assert msg["lost_ip"] == ""  # nothing was lost
+        assert sorted(msg[JOINED_KEY]) == ["10.0.0.4", "10.0.0.5"]
+        decision = msg[DECISION_KEY]
+        assert decision["mechanism"] in GROW_MODES
+        assert {MECH_ABSORB, MECH_GROW_DP, MECH_GROW_RESHAPE} \
+            <= set(decision["costs"])
+        assert "trace" in msg  # trace context rides the broadcast
+
+    tail = _flight_tail(n0)
+    joins = [e for e in tail if e.get("event") == "join"]
+    assert {e["ip"] for e in joins} == {"10.0.0.4", "10.0.0.5"}
+    # The advertised lifetime hint survived into the flight record.
+    assert next(e for e in joins
+                if e["ip"] == "10.0.0.4")["spot_lifetime_s"] == 120
+    detected = [e for e in tail if e.get("event") == "join_detected"]
+    assert len(detected) == 1  # ONE incident for the batch
+    assert detected[0]["joined_ips"] == "10.0.0.4,10.0.0.5"
+    broadcasts = [e for e in tail if e.get("event") == "grow_broadcast"]
+    assert len(broadcasts) == 1
+    task.cancel()
+
+
+@pytest.mark.asyncio
+async def test_join_refusals(job_args):
+    """No job -> FAILURE; quarantined host -> FAILURE (flight-recorded);
+    already-registered ip -> FAILURE. A refused joiner never enters
+    self.agents and never triggers a GROW."""
+    n0 = len(metrics.flight_recorder().events())
+    daemon, _, task = await start_master()
+
+    _, _, msg = await join(daemon, "10.0.0.4")
+    assert msg["kind"] == ResponseType.FAILURE.value
+    assert "no job" in msg["error"]
+
+    await launch_job(daemon, job_args)
+    r1, w1, _ = await register_agent(daemon, "10.0.0.1")
+
+    # Two failures inside the window quarantine the would-be joiner; the
+    # same hysteresis that gates re-registration gates JOIN. Injected
+    # clock: with real time both failures land microseconds apart, the
+    # estimated MTBF collapses to ~0 and the quarantine lifts instantly.
+    now = [0.0]
+    daemon.policy.health = HostHealthTracker(clock=lambda: now[0])
+    daemon.policy.observe_failure("10.0.0.9", cause="flap")
+    now[0] = 100.0
+    daemon.policy.observe_failure("10.0.0.9", cause="flap")
+    now[0] = 150.0  # inside hysteresis (2 x 100s MTBF past last failure)
+    assert daemon.policy.is_quarantined("10.0.0.9")
+    _, _, msg = await join(daemon, "10.0.0.9")
+    assert msg["kind"] == ResponseType.FAILURE.value
+    assert msg["error"] == "quarantined"
+    assert "10.0.0.9" not in daemon.agents
+
+    _, _, msg = await join(daemon, "10.0.0.1")
+    assert msg["kind"] == ResponseType.FAILURE.value
+    assert "already registered" in msg["error"]
+
+    refused = [e for e in _flight_tail(n0)
+               if e.get("event") == "join_refused"]
+    assert [(e["ip"], e["reason"]) for e in refused] == \
+        [("10.0.0.9", "quarantined"), ("10.0.0.1", "already registered")]
+    assert not any(e.get("event") == "join_detected"
+                   for e in _flight_tail(n0))
+    task.cancel()
+
+
+@pytest.mark.asyncio
+async def test_joiner_dying_inside_window_is_dropped_from_batch(
+        job_args, monkeypatch):
+    """A joiner that dials in and dies before the window closes is handled
+    by its own loss path — the grow batch must not broadcast it."""
+    monkeypatch.setenv("OOBLECK_JOIN_WINDOW", "0.5")
+    daemon, _, task = await start_master()
+    await launch_job(daemon, job_args)
+    r1, w1, _ = await register_agent(daemon, "10.0.0.1")
+
+    rj1, wj1, msg = await join(daemon, "10.0.0.4")
+    assert msg["kind"] == ResponseType.SUCCESS.value
+    rj2, wj2, msg = await join(daemon, "10.0.0.5")
+    assert msg["kind"] == ResponseType.SUCCESS.value
+    wj2.close()  # dies inside the window
+    for _ in range(100):
+        if "10.0.0.5" not in daemon.agents:
+            break
+        await asyncio.sleep(0.05)
+
+    msg = await recv_msg(r1, timeout=5)
+    # The survivor may first see 10.0.0.5's loss broadcast; the GROW for
+    # the remaining joiner follows.
+    while msg["kind"] != ResponseType.GROW.value:
+        msg = await recv_msg(r1, timeout=5)
+    assert msg[JOINED_KEY] == ["10.0.0.4"]
+    task.cancel()
+
+
+@pytest.mark.asyncio
+async def test_quarantine_lifted_register_tagged_rejoin(job_args):
+    """Satellite: a host whose flap quarantine lifted re-registers over a
+    real socket — accepted like any other agent, but the handshake leaves
+    a DISTINCT quarantine_rejoin flight event."""
+    n0 = len(metrics.flight_recorder().events())
+    daemon, _, task = await start_master()
+    await launch_job(daemon, job_args)
+
+    now = [0.0]
+    daemon.policy.health = HostHealthTracker(clock=lambda: now[0])
+    daemon.policy.observe_failure("10.0.0.2", cause="flap")
+    now[0] = 10.0
+    daemon.policy.observe_failure("10.0.0.2", cause="flap")
+    assert daemon.policy.is_quarantined("10.0.0.2")
+
+    # Refused while quarantined...
+    r, w = await asyncio.open_connection("127.0.0.1", daemon.port)
+    await send_request(w, RequestType.REGISTER_AGENT, {"ip": "10.0.0.2"})
+    msg = await recv_msg(r, timeout=5)
+    assert msg["kind"] == ResponseType.FAILURE.value
+
+    # ...then the host stays quiet past the hysteresis window and comes
+    # back: accepted, and tagged as a REJOIN, not a first contact.
+    now[0] = 1000.0
+    assert not daemon.policy.is_quarantined("10.0.0.2")
+    r2, w2, msg = await register_agent(daemon, "10.0.0.2")
+    assert msg["kind"] == ResponseType.SUCCESS.value
+    assert "10.0.0.2" in daemon.agents
+
+    rejoins = [e for e in _flight_tail(n0)
+               if e.get("event") == "quarantine_rejoin"]
+    assert [e["ip"] for e in rejoins] == ["10.0.0.2"]
+
+    # A normal first-contact register never fabricates the tag.
+    await register_agent(daemon, "10.0.0.1")
+    assert len([e for e in _flight_tail(n0)
+                if e.get("event") == "quarantine_rejoin"]) == 1
+    task.cancel()

@@ -1,0 +1,286 @@
+"""Elastic scale-UP on a live engine: JOIN as a first-class incident.
+
+The loss-direction twins of these tests live in test_engine_reconfig.py /
+test_incident.py; here the same machinery runs in the grow direction:
+chaos capacity arrival through the REAL train loop commits exactly ONE
+incident with all three grow-arm costs attached, grow_dp reaches its
+first post-grow step without touching the survivors' state, and a live
+grow_reshape lands on the SAME loss/params trajectory as an uninterrupted
+fleet that was this size all along (the live promotion of
+test_checkpoint.py::test_engine_checkpoint_resume_grow's offline path)."""
+
+import glob
+import json
+import time
+
+import numpy as np
+import pytest
+
+import jax
+
+from oobleck_tpu.policy import (
+    MECH_ABSORB,
+    MECH_GROW_DP,
+    MECH_GROW_RESHAPE,
+    PolicyEngine,
+)
+from oobleck_tpu.utils import chaos as chaos_mod
+from oobleck_tpu.utils import metrics
+
+from tests.execution.test_engine import cache_env, make_engine  # noqa: F401
+
+JOINERS = ["10.0.0.2", "10.0.0.3"]
+
+
+@pytest.fixture(autouse=True)
+def _fresh_flight(monkeypatch):
+    # The flight recorder is a bounded module-global ring (256 entries):
+    # by the time the full suite reaches this file it is at capacity, so
+    # a len()-based tail would read nothing while new events silently
+    # evict old ones. Every test gets its own empty ring.
+    monkeypatch.setattr(metrics, "_flight", metrics.FlightRecorder())
+
+
+def _small_engine(devices8, steps=6, checkpoint_dir=None):
+    """2 hosts on the first 4 virtual chips; the other 4 stay free for
+    the arrivals to bind."""
+    eng = make_engine(num_hosts=2, steps=steps, devices=devices8[:4])
+    if checkpoint_dir is not None:
+        eng.args.execution.checkpoint_dir = str(checkpoint_dir)
+    eng.initialize_distributed()
+    eng.instantiate_pipelines(eng.args.job.global_num_microbatch)
+    return eng
+
+
+def _leaves(tree):
+    return [np.asarray(x, np.float32) for x in jax.tree.leaves(tree)]
+
+
+def _host_groups(eng):
+    return [sorted({r // eng.chips_per_host for r in p.ranks})
+            for p in eng.pipelines]
+
+
+def _flight_tail(n0):
+    return metrics.flight_recorder().events()[n0:]
+
+
+def test_live_grow_reshape_matches_uninterrupted_twin(cache_env, devices8,
+                                                      tmp_path):
+    """2 hosts grow to 4 MID-TRAINING via grow_reshape; after the honest
+    rollback to the restore point, loss AND params must track a fresh
+    4-host engine restoring the same checkpoint — the twin that was
+    never interrupted. Template identity makes the post-grow plan equal
+    to a fresh 4-host bring-up's by construction; this pins it."""
+    live = _small_engine(devices8, steps=10, checkpoint_dir=tmp_path)
+    live._train_step()
+    live._train_step()
+    live.save_checkpoint(wait=True)
+    live._train_step()  # progress past the restore point -> real rollback
+
+    live._policy = PolicyEngine(multihost=False, mode=MECH_GROW_RESHAPE)
+    live.request_grow(list(JOINERS))
+    live._maybe_grow()
+
+    assert live.host_ips == [f"10.0.0.{i}" for i in range(4)]
+    assert live.step == 2  # rolled back to the durable point
+    assert sum(p.template.num_hosts for p in live.pipelines) == 4
+
+    twin = make_engine(num_hosts=4, steps=10, devices=devices8)
+    twin.args.execution.checkpoint_dir = str(tmp_path)
+    twin.initialize_distributed()
+    twin.instantiate_pipelines(twin.args.job.global_num_microbatch)
+    assert twin.step == 2
+
+    # Same plan shape as the never-interrupted fleet...
+    assert [t.num_hosts for t in live.plan.instances] == \
+        [t.num_hosts for t in twin.plan.instances]
+    assert _host_groups(live) == _host_groups(twin)
+    # ...same data position...
+    assert (live.dataloaders[0].num_iterations_done
+            == twin.dataloaders[0].num_iterations_done)
+    # ...same state at the restore point...
+    p_live, _ = live._collect_layer_state()
+    p_twin, _ = twin._collect_layer_state()
+    assert set(p_live) == set(p_twin)
+    for li in p_live:
+        for g, w in zip(_leaves(p_live[li]), _leaves(p_twin[li]),
+                        strict=True):
+            np.testing.assert_allclose(g, w, rtol=1e-6)
+
+    # ...and the same trajectory afterwards.
+    for _ in range(3):
+        l_live = live._train_step()
+        l_twin = twin._train_step()
+        np.testing.assert_allclose(l_live, l_twin, rtol=1e-4)
+    p_live, _ = live._collect_layer_state()
+    p_twin, _ = twin._collect_layer_state()
+    for li in p_live:
+        for g, w in zip(_leaves(p_live[li]), _leaves(p_twin[li]),
+                        strict=True):
+            np.testing.assert_allclose(g, w, rtol=1e-4)
+
+
+def test_chaos_join_commits_exactly_one_grow_incident(cache_env, devices8,
+                                                      tmp_path, monkeypatch):
+    """The acceptance path: a chaos join_hosts directive through the REAL
+    train loop -> ONE committed incident-<n>.json for the whole batch,
+    with the policy decision (all three grow-arm costs) attached."""
+    monkeypatch.setenv(metrics.ENV_METRICS_DIR, str(tmp_path))
+    eng = _small_engine(devices8, steps=5)
+    try:
+        chaos_mod.reset(f"join_hosts={'+'.join(JOINERS)}@1")
+        eng.train()  # arrivals mature at the 2nd step-boundary poll
+    finally:
+        chaos_mod.reset("")
+
+    # Both arrivals landed somewhere: active hosts or the spare pool.
+    placed = set(eng.host_ips) | set(eng._spare_hosts)
+    assert set(JOINERS) <= placed
+
+    paths = sorted(glob.glob(str(tmp_path / "incident-*.json")))
+    assert len(paths) == 1, paths  # ONE incident for the correlated batch
+    with open(paths[0]) as f:
+        rec = json.load(f)
+
+    assert rec["cause"] == "chaos_join_host"
+    assert rec["lost_ip"] == ""  # nothing was lost
+    assert rec["attrs"]["direction"] == "grow"
+    assert sorted(rec["attrs"]["joined_ips"]) == sorted(JOINERS)
+    for mark in ("detect", "apply_start", "apply_end", "first_step"):
+        assert mark in rec["marks"], rec["marks"]
+
+    decision = rec["attrs"]["decision"]
+    assert decision["mechanism"] in (MECH_ABSORB, MECH_GROW_DP,
+                                     MECH_GROW_RESHAPE)
+    assert sorted(decision["joined_ips"]) == sorted(JOINERS)
+    # All three arms were priced, not just the winner.
+    assert {MECH_ABSORB, MECH_GROW_DP, MECH_GROW_RESHAPE} \
+        <= set(decision["costs"])
+
+    names = {s["name"] for s in rec["spans"]}
+    assert {"incident.detect", "engine.grow"} <= names
+    assert all(s["trace_id"] == rec["trace_id"] for s in rec["spans"])
+
+    # Training kept going on the grown fleet.
+    assert np.isfinite(eng._train_step())
+
+
+def test_grow_dp_keeps_survivors_in_place(cache_env, devices8):
+    """grow_dp adds DP pipeline(s) over the arrivals from the EXISTING
+    templates: survivor host groups stay intact, nothing rolls back, and
+    the live params carry over untouched — the first post-grow step runs
+    without any survivor being respawned or restored."""
+    eng = _small_engine(devices8, steps=6)
+    eng._train_step()
+    groups_before = _host_groups(eng)
+    pipes_before = len(eng.pipelines)
+    step_before = eng.step
+    p_before, _ = eng._collect_layer_state()
+    saved = {li: _leaves(t) for li, t in p_before.items()}
+
+    n0 = len(metrics.flight_recorder().events())
+    eng._policy = PolicyEngine(multihost=False, mode=MECH_GROW_DP)
+    eng.request_grow(list(JOINERS))
+    eng._maybe_grow()
+
+    assert eng.step == step_before  # no rollback
+    assert len(eng.pipelines) > pipes_before
+    # Every pre-grow host group survives verbatim in the new plan.
+    groups_after = _host_groups(eng)
+    for g in groups_before:
+        assert g in groups_after
+    grown = next(e for e in _flight_tail(n0)
+                 if e.get("event") == "engine_grown")
+    assert grown["mechanism"] == MECH_GROW_DP
+    assert grown["rolled_back_steps"] == 0
+    # Live weights carried over (the DP copy is the state transfer).
+    p_after, _ = eng._collect_layer_state()
+    for li, want in saved.items():
+        for g, w in zip(_leaves(p_after[li]), want, strict=True):
+            np.testing.assert_allclose(g, w, rtol=1e-6)
+
+    assert np.isfinite(eng._train_step())
+
+    # The fleet now owns all 8 chips; a further arrival has no devices
+    # to bind and must be REFUSED (flight-recorded), not half-admitted.
+    n1 = len(metrics.flight_recorder().events())
+    eng.request_grow(["10.0.0.9"])
+    eng._maybe_grow()
+    assert "10.0.0.9" not in eng.host_ips
+    assert "10.0.0.9" not in eng._spare_hosts
+    refused = next(e for e in _flight_tail(n1)
+                   if e.get("event") == "join_refused")
+    assert refused["ip"] == "10.0.0.9"
+    assert refused["reason"] == "no_free_devices"
+
+
+def test_absorb_parks_spares_and_spot_lifetime_expires(cache_env, devices8):
+    """absorb_spare is the zero-interruption arm: the live pipelines are
+    untouched (same objects), the arrivals park as spares, and the chaos
+    spot-lifetime hint read at admit arms a deadline. When it expires, a
+    parked spare just unparks; an ACTIVE host leaves through the regular
+    loss path as one synthetic incident."""
+    eng = _small_engine(devices8, steps=6)
+    eng._train_step()
+    pipe_ids = [id(p) for p in eng.pipelines]
+    try:
+        chaos_mod.reset(f"spot_lifetime={JOINERS[0]}:30")
+        eng._policy = PolicyEngine(multihost=False, mode=MECH_ABSORB)
+        eng.request_grow(list(JOINERS))
+        eng._maybe_grow()
+    finally:
+        chaos_mod.reset("")
+
+    assert eng._spare_hosts == JOINERS
+    assert eng.host_ips == ["10.0.0.0", "10.0.0.1"]
+    assert [id(p) for p in eng.pipelines] == pipe_ids  # truly untouched
+    assert JOINERS[0] in eng._spot_deadlines  # armed from the hint
+    assert JOINERS[1] not in eng._spot_deadlines  # on-demand joiner
+
+    # Spare expiry: unparks, no incident (it was never in the plan).
+    n0 = len(metrics.flight_recorder().events())
+    eng._spot_deadlines[JOINERS[0]] = time.monotonic() - 1.0
+    eng._maybe_spot_expire()
+    assert JOINERS[0] not in eng._spare_hosts
+    assert not eng._pending_lost
+    ev = next(e for e in _flight_tail(n0)
+              if e.get("event") == "spot_lifetime_expired")
+    assert ev["was_spare"] is True
+
+    # Active-host expiry: the priced-in churn actually happens -> the
+    # REGULAR loss path gets one synthetic incident.
+    eng._spot_deadlines["10.0.0.1"] = time.monotonic() - 1.0
+    eng._maybe_spot_expire()
+    assert len(eng._pending_lost) == 1
+    lost_ip, trace, _ = eng._pending_lost[0]
+    assert lost_ip == "10.0.0.1"
+    assert trace["cause"] == "spot_lifetime"
+
+    # Drive the loss to completion: the survivor + remaining spare fleet
+    # keeps training.
+    eng._maybe_reconfigure()
+    assert "10.0.0.1" not in eng.host_ips
+    assert np.isfinite(eng._train_step())
+
+
+def test_grow_batching_folds_one_boundary_into_one_incident(cache_env,
+                                                            devices8):
+    """Two request_grow calls pending at ONE step boundary are ONE grow
+    incident (the grow mirror of correlated-loss batching): one policy
+    decision prices the whole batch."""
+    eng = _small_engine(devices8, steps=6)
+    eng._train_step()
+    n0 = len(metrics.flight_recorder().events())
+    eng._policy = PolicyEngine(multihost=False, mode=MECH_ABSORB)
+    eng.request_grow([JOINERS[0]])
+    eng.request_grow([JOINERS[1], JOINERS[0]])  # dup folded, not re-grown
+    eng._maybe_grow()
+
+    absorbed = [e for e in _flight_tail(n0)
+                if e.get("event") == "grow_absorbed"]
+    assert len(absorbed) == 1
+    assert absorbed[0]["joined_ips"] == JOINERS
+    decisions = [e for e in _flight_tail(n0)
+                 if e.get("event") == "policy_decision"]
+    assert len(decisions) == 1

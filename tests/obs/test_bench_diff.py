@@ -84,6 +84,30 @@ def test_new_and_gone_keys_reported_without_regression():
     assert any("(gone)" in line and "bubble" in line for line in lines)
 
 
+def test_probe_attempted_is_provenance_not_a_metric():
+    # probe_attempted is a boolean provenance stamp: the numeric diff must
+    # ignore it even when it flips between rounds (a round that probed and
+    # found the relay down vs one that crashed before probing is a fact
+    # about the harness, not a performance delta).
+    old = {"value": 1.0, "stale": False, "probe_attempted": False}
+    new = {"value": 1.0, "stale": False, "probe_attempted": True}
+    lines, regressions = bench.bench_diff(old, new)
+    assert regressions == []
+    assert not any("probe_attempted" in line for line in lines)
+
+
+def test_stamp_provenance_covers_every_section():
+    result = {"value": 1.0, "grow": {"join_to_step_s": 2.0},
+              "sim": {"error": "sim bench hung >120s"}}
+    bench._stamp_provenance(result)
+    assert result["probe_attempted"] in (True, False)
+    # Every dict-valued section carries explicit freshness — even an
+    # errored one (the error string is the signal, the stamp still lands).
+    for section in ("grow", "sim"):
+        assert result[section]["stale"] is False
+        assert result[section]["stale_from"] is None
+
+
 def test_probe_timeout_env(monkeypatch, capsys):
     monkeypatch.delenv("BENCH_PROBE_TIMEOUT", raising=False)
     assert bench._probe_timeout_s() == bench.PROBE_TIMEOUT_S

@@ -255,6 +255,145 @@ def test_every_decision_flight_recorded_with_costs():
     assert recs[0]["projected_cost_s"] == pytest.approx(d.projected_cost_s)
 
 
+# --------------------------------------------------------------------- #
+# grow direction (decide_grow)
+
+
+def test_grow_scores_all_three_arms():
+    from oobleck_tpu.policy import GROW_MODES, MECH_ABSORB
+
+    eng = _engine()
+    d = eng.decide_grow(["10.0.0.5"], current_hosts=4, staleness_steps=0.0)
+    assert d.mechanism in GROW_MODES
+    assert d.lost_ips == [] and d.joined_ips == ["10.0.0.5"]
+    assert set(d.costs) == set(GROW_MODES)
+    assert d.reason == "cheapest"
+    # absorb's retention is measured against the POST-grow ceiling: the
+    # foregone gain of parking 1 arrival next to 4 hosts is 4/5.
+    assert d.arms[MECH_ABSORB]["retention"] == pytest.approx(4 / 5)
+
+
+def test_short_spot_lifetime_flips_grow_to_absorb():
+    """The amortization horizon is the arriving capacity's expected
+    LIFETIME: a spot host that vanishes in seconds cannot amortize a
+    reshape (or the churn risk of committing state to it), so absorb
+    wins; a long-lived arrival flips the verdict to a real grow arm."""
+    from oobleck_tpu.policy import GROW_MODES, MECH_ABSORB
+
+    eng = _engine()
+    ephemeral = eng.decide_grow(
+        ["10.0.0.5"], current_hosts=4, staleness_steps=0.0,
+        step_seconds=1.0, lifetime_hints={"10.0.0.5": 3.0})
+    assert ephemeral.mechanism == MECH_ABSORB
+    assert ephemeral.mtbf_s == pytest.approx(3.0)
+
+    durable = eng.decide_grow(
+        ["10.0.0.5"], current_hosts=4, staleness_steps=0.0,
+        step_seconds=1.0, lifetime_hints={"10.0.0.5": 86400.0})
+    assert durable.mechanism in set(GROW_MODES) - {MECH_ABSORB}
+    assert durable.costs[durable.mechanism] < durable.costs[MECH_ABSORB]
+
+
+def test_grow_lifetime_precedence_hint_then_own_mtbf_then_fleet():
+    """lifetime_hints wins over the joiner's own failure history, which
+    wins over the fleet MTBF (the joiner may be a flapper that left and
+    came back, carrying its record)."""
+    eng = _engine()
+    # Fleet history: some OTHER host churns at 5 s.
+    for _ in range(3):
+        eng.observe_failure("10.0.0.1")
+        eng.health._clock.advance(5.0)
+    d = eng.decide_grow(["10.0.0.5"], current_hosts=4)
+    assert d.mtbf_s == pytest.approx(5.0)  # fleet MTBF: joiner unknown
+
+    # The joiner's own record beats the fleet's.
+    eng.observe_failure("10.0.0.5")
+    eng.health._clock.advance(120.0)
+    eng.observe_failure("10.0.0.5")
+    d = eng.decide_grow(["10.0.0.5"], current_hosts=4)
+    assert d.mtbf_s == pytest.approx(120.0)
+
+    # An explicit hint beats both.
+    d = eng.decide_grow(["10.0.0.5"], current_hosts=4,
+                        lifetime_hints={"10.0.0.5": 600.0})
+    assert d.mtbf_s == pytest.approx(600.0)
+
+
+def test_grow_dp_infeasibility_travels_with_reason():
+    from oobleck_tpu.policy import MECH_GROW_DP
+
+    eng = _engine()
+    d = eng.decide_grow(["10.0.0.5"], current_hosts=4, dp_feasible=False,
+                        dp_reason="arrivals(1)<smallest_template(2)")
+    assert d.mechanism != MECH_GROW_DP
+    assert d.infeasible[MECH_GROW_DP] == "arrivals(1)<smallest_template(2)"
+
+
+def test_forced_grow_arm_wins_and_falls_back_to_absorb():
+    from oobleck_tpu.policy import MECH_ABSORB, MECH_GROW_DP, \
+        MECH_GROW_RESHAPE
+
+    eng = _engine(mode=MECH_GROW_RESHAPE)
+    d = eng.decide_grow(["10.0.0.5"], current_hosts=4, staleness_steps=50.0)
+    assert d.mechanism == MECH_GROW_RESHAPE
+    assert d.reason == "forced:grow_reshape"
+
+    # An infeasible forced grow arm falls back to absorb_spare — the grow
+    # direction's always-available mechanism.
+    eng = _engine(mode=MECH_GROW_DP)
+    d = eng.decide_grow(["10.0.0.5"], current_hosts=4, dp_feasible=False,
+                        dp_reason="no_template_fit")
+    assert d.mechanism == MECH_ABSORB
+    assert d.reason == "forced:grow_dp:infeasible:no_template_fit"
+
+
+def test_forced_modes_do_not_cross_directions():
+    """A loss-direction forced mode consulted in the GROW direction (and
+    vice versa) degrades to adaptive — a bench forcing `restore` must not
+    wedge the join path, and forcing `grow_dp` must not wedge recovery."""
+    from oobleck_tpu.policy import GROW_MODES, MECH_GROW_DP
+
+    eng = _engine(mode=MECH_RESTORE)
+    d = eng.decide_grow(["10.0.0.5"], current_hosts=4, staleness_steps=0.0)
+    assert d.mechanism in GROW_MODES
+    assert d.reason == "cheapest"
+
+    eng = _engine(mode=MECH_GROW_DP)
+    d = eng.decide(["10.0.0.1"], staleness_steps=0.0)
+    assert d.mechanism == MECH_REROUTE
+    assert d.reason == "cheapest"
+
+
+def test_grow_decision_payload_roundtrip_and_flight_record():
+    from oobleck_tpu.policy import GROW_MODES
+
+    eng = _engine()
+    d = eng.decide_grow(["10.0.0.5", "10.0.0.6"], current_hosts=2,
+                        staleness_steps=1.0)
+    r = decision_from_payload(d.as_payload())
+    assert r.mechanism == d.mechanism
+    assert r.joined_ips == ["10.0.0.5", "10.0.0.6"]
+    assert r.lost_ips == []
+    assert set(r.costs) == set(GROW_MODES)
+    recs = [e for e in metrics.flight_recorder().events()
+            if e["event"] == "policy_decision"
+            and e.get("trace_id") == d.trace_id]
+    assert len(recs) == 1
+    assert set(recs[0]["costs"]) == set(GROW_MODES)
+
+
+def test_grow_measured_feedback_feeds_next_decision():
+    """A measured grow latency (engine _observe_policy_measured) becomes
+    the EWMA the NEXT grow decision scores with."""
+    from oobleck_tpu.policy import MECH_GROW_DP
+
+    eng = _engine()
+    eng.observe_measured(MECH_GROW_DP, 0.08)
+    d = eng.decide_grow(["10.0.0.5"], current_hosts=4, staleness_steps=0.0)
+    assert d.arms[MECH_GROW_DP]["latency_source"] == "measured"
+    assert d.arms[MECH_GROW_DP]["latency_s"] == pytest.approx(0.08)
+
+
 def test_status_block_is_bounded():
     eng = _engine()
     for i in range(40):

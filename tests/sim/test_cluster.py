@@ -123,6 +123,86 @@ def test_traffic_swing_scales_demand():
     assert run["goodput_ratio"] > 0.9
 
 
+def test_join_runs_real_grow_decide_chain():
+    # One on-demand arrival mid-run: a grow-direction incident decided by
+    # the REAL PolicyEngine.decide_grow, with all three arms costed.
+    sc = _scenario([ScenarioEvent(t=100.0, kind="join", host=4,
+                                  incident_id=1_000_000, cause="capacity",
+                                  repair_delay_s=0.0)])
+    run = SimCluster(SimConfig(hosts=4), sc).run()
+    assert len(run["incidents"]) == 1
+    inc = run["incidents"][0]
+    assert inc["direction"] == "grow"
+    assert inc["lost_hosts"] == 0
+    assert inc["joined_hosts"] == 1
+    assert inc["correlated"] is False
+    assert inc["cause"] == "capacity"
+    assert {"absorb_spare", "grow_dp", "grow_reshape"} <= set(inc["arms"])
+    assert inc["mechanism"] in ("absorb_spare", "grow_dp", "grow_reshape")
+    assert run["final"]["live_hosts"] == 5
+
+
+def test_join_batch_grows_fleet_under_grow_dp():
+    # Two same-instant arrivals sharing an incident_id are ONE correlated
+    # grow incident; at 2 hosts/pipeline they form a whole replica block,
+    # so forced grow_dp adds a pipeline without touching survivor groups.
+    sc = _scenario([
+        ScenarioEvent(t=100.0, kind="join", host=4,
+                      incident_id=1_000_000, cause="capacity"),
+        ScenarioEvent(t=100.0, kind="join", host=5,
+                      incident_id=1_000_000, cause="capacity"),
+    ])
+    run = SimCluster(SimConfig(hosts=4, hosts_per_pipeline=2,
+                               mode="grow_dp"), sc).run()
+    assert len(run["incidents"]) == 1
+    inc = run["incidents"][0]
+    assert inc["mechanism"] == "grow_dp"
+    assert inc["correlated"] is True
+    assert inc["joined_hosts"] == 2
+    assert inc["pipelines"] == 3  # 2 survivors untouched + 1 new replica
+    assert inc["arms"]["grow_dp"]["feasible"] is True
+    assert run["final"]["live_hosts"] == 6
+    assert run["final"]["pipelines"] == 3
+
+
+def test_absorb_spare_parks_arrival_without_stall():
+    # Forced absorb: the arrival parks as a spare — no layout change, no
+    # recovery stall, rate unchanged. The spare then soaks a later loss.
+    sc = _scenario([
+        ScenarioEvent(t=100.0, kind="join", host=4,
+                      incident_id=1_000_000, cause="capacity"),
+        ScenarioEvent(t=300.0, kind="fail", host=1, incident_id=0,
+                      cause="test", repair_delay_s=1000.0),
+    ])
+    run = SimCluster(SimConfig(hosts=4, mode="absorb_spare"), sc).run()
+    grow = run["incidents"][0]
+    assert grow["mechanism"] == "absorb_spare"
+    assert grow["pipelines"] == 4      # layout untouched
+    assert grow["rate_after"] == grow["rate_before"]
+    # The parked spare soaks the t=300 loss: 5 live minus 1 dead leaves
+    # the fleet at its original size with the spare back in rotation.
+    assert run["final"]["live_hosts"] == 4
+
+
+def test_spot_joiner_expires_into_permanent_loss():
+    # A spot arrival advertises a finite lifetime (repair_delay_s doubles
+    # as the lifetime): forced grow_dp puts it in the layout, then the
+    # deadline lapses and the host dies FOR GOOD — a real incident with
+    # cause spot_lifetime and no repair ever scheduled.
+    sc = _scenario([ScenarioEvent(t=100.0, kind="join", host=4,
+                                  incident_id=1_000_000, cause="capacity",
+                                  repair_delay_s=60.0)])
+    run = SimCluster(SimConfig(hosts=4, mode="grow_dp"), sc).run()
+    assert len(run["incidents"]) == 2
+    assert run["incidents"][0]["direction"] == "grow"
+    expiry = run["incidents"][1]
+    assert expiry["cause"] == "spot_lifetime"
+    assert expiry["lost_hosts"] == 1
+    assert expiry["t"] == pytest.approx(160.0)
+    # Never repaired: the fleet ends back at its pre-arrival size.
+    assert run["final"]["live_hosts"] == 4
+
+
 def test_hermetic_registry_no_global_leak():
     sc = _scenario([ScenarioEvent(t=100.0, kind="fail", host=1,
                                   incident_id=0, cause="test",

@@ -55,6 +55,31 @@ def test_preemption_is_proactive_kind():
     assert all(e.kind == "preempt" for e in sc.events)
 
 
+def test_capacity_arrival_structure():
+    sc = make_scenario("capacity_arrival", seed=9, hosts=16,
+                       duration_s=600.0)
+    joins = [e for e in sc.events if e.kind == "join"]
+    fails = [e for e in sc.events if e.kind == "fail"]
+    assert joins and fails  # churn in BOTH directions
+    # Joins live in their own incident-id namespace and arrive on fresh
+    # host indices, so a grow batch can never alias a failure batch.
+    assert all(e.incident_id >= 1_000_000 for e in joins)
+    assert all(e.incident_id < 1_000_000 for e in fails)
+    assert all(e.host >= 16 for e in joins)
+    assert len({e.host for e in joins}) == len(joins)
+    # repair_delay_s doubles as the advertised spot lifetime; 0 means
+    # on-demand (no deadline), never negative.
+    assert all(e.repair_delay_s >= 0.0 for e in joins)
+    # Burst arrivals share an incident id at one instant — the JOIN
+    # window's one-grow-incident batching, pre-scripted.
+    by_id: dict[int, list] = {}
+    for e in joins:
+        by_id.setdefault(e.incident_id, []).append(e)
+    for batch in by_id.values():
+        assert len({e.t for e in batch}) == 1
+        assert len(batch) <= 2
+
+
 def test_unknown_scenario_raises():
     with pytest.raises(ValueError, match="unknown scenario"):
         make_scenario("no_such", seed=0, hosts=8, duration_s=10.0)
