@@ -2,7 +2,7 @@
 
 PY ?= python
 
-.PHONY: lint analyze gen-registry test test-slow tier1 bench bench-diff trace-report ckpt-bench serve-bench pipeline-bench degrade-bench policy-bench sim-bench grow-bench
+.PHONY: lint analyze gen-registry test test-slow tier1 bench bench-diff trace-report ckpt-bench serve-bench pipeline-bench degrade-bench policy-bench sim-bench grow-bench overlap-bench
 
 # Lint = the project-native analyzer (always available, stdlib-only)
 # plus ruff (config in pyproject.toml). Ruff degrades to a skip when not
@@ -100,6 +100,16 @@ policy-bench:
 	JAX_PLATFORMS=cpu OOBLECK_METRICS_DIR= \
 		XLA_FLAGS="--xla_force_host_platform_device_count=8" \
 		$(PY) -m oobleck_tpu.policy.bench
+
+# Collective/compute overlap: comm-hidden fraction (overlapped vs
+# compute-only vs ring-alone arms), serialized vs overlapped tokens/sec,
+# bucketed-ring grad parity, flash-vs-xla pallas-interpret sub-key on 8
+# virtual CPU devices (also under bench.py's "overlap" key, diffed by
+# bench --diff). CPU numbers are a scheduling proxy; device truth is TPU.
+overlap-bench:
+	JAX_PLATFORMS=cpu OOBLECK_METRICS_DIR= \
+		XLA_FLAGS="--xla_force_host_platform_device_count=8" \
+		$(PY) -m oobleck_tpu.parallel.overlap_bench
 
 # Grow plane: join-to-first-post-grow-step per grow arm (absorb_spare /
 # grow_dp / grow_reshape / adaptive) on a 2-host rig growing by 2

@@ -561,6 +561,44 @@ def _grow_summary() -> dict:
         return {"error": f"unparseable grow bench output: {exc}"}
 
 
+OVERLAP_BENCH_TIMEOUT_S = 480
+
+
+def _overlap_summary() -> dict:
+    """Collective/compute overlap microbench
+    (oobleck_tpu/parallel/overlap_bench.py) in a throwaway CPU subprocess
+    with 8 virtual devices. Reports per-mesh comm_hidden_fraction
+    (overlapped vs compute-only vs ring-alone arms), serialized vs
+    overlapped tokens/sec, the bucketed-sync grad parity gate, and the
+    flash-vs-XLA pallas-interpret sub-key. CPU numbers are a scheduling
+    proxy — the module's own `note` says so and device truth is TPU-only."""
+    env = dict(os.environ)
+    env.update({
+        "JAX_PLATFORMS": "cpu", "PALLAS_AXON_POOL_IPS": "",
+        "OOBLECK_METRICS_DIR": "",
+        "XLA_FLAGS": (env.get("XLA_FLAGS", "")
+                      + " --xla_force_host_platform_device_count=8").strip(),
+    })
+    env.pop(_INNER_ENV, None)
+    env.pop(_PIPELINE_ENV, None)
+    proc = subprocess.Popen(
+        [sys.executable, "-m", "oobleck_tpu.parallel.overlap_bench"],
+        stdout=subprocess.PIPE, stderr=subprocess.PIPE, text=True, env=env)
+    try:
+        out, err = proc.communicate(timeout=OVERLAP_BENCH_TIMEOUT_S)
+    except subprocess.TimeoutExpired:
+        proc.kill()
+        return {"error": f"overlap bench hung >{OVERLAP_BENCH_TIMEOUT_S}s"}
+    if proc.returncode != 0:
+        tail = (err or "").strip().splitlines()[-1:] or ["no stderr"]
+        return {"error":
+                f"overlap bench exit {proc.returncode}: {tail[0][:160]}"}
+    try:
+        return json.loads(out.strip().splitlines()[-1])
+    except Exception as exc:  # noqa: BLE001
+        return {"error": f"unparseable overlap bench output: {exc}"}
+
+
 SERVE_BENCH_TIMEOUT_S = 150
 
 
@@ -735,6 +773,13 @@ def _emit(result: dict) -> None:
         result["grow"] = _grow_summary()
     except Exception as exc:  # noqa: BLE001 — emit must never fail
         result["grow"] = {"error": f"{type(exc).__name__}: {exc}"}
+    # Collective/compute overlap (comm-hidden fraction, bucketed-ring
+    # parity, flash-vs-xla sub-key): CPU subprocess, bounded, best-effort
+    # — see _overlap_summary.
+    try:
+        result["overlap"] = _overlap_summary()
+    except Exception as exc:  # noqa: BLE001 — emit must never fail
+        result["overlap"] = {"error": f"{type(exc).__name__}: {exc}"}
     # Simulated SLOs (recovery percentiles, goodput under churn, regret
     # vs the hindsight oracle, determinism gate): CPU subprocess, jax-
     # free, bounded, best-effort — see _sim_summary.
@@ -789,10 +834,10 @@ DIFF_THRESHOLD = 0.05
 # throughput keys, so unit suffixes are matched as suffixes only.
 _HIGHER_BETTER = ("per_sec", "per_second", "speedup", "retention",
                   "throughput", "goodput", "agreement", "sustained",
-                  "hit_rate")
+                  "hit_rate", "hidden_fraction")
 _LOWER_BETTER = ("latency", "seconds", "ttft", "pause", "bubble", "stall",
                  "p50", "p90", "p99", "findings", "parse_errors", "regret",
-                 "bytes_per_token")
+                 "bytes_per_token", "abs_diff")
 _LOWER_BETTER_SUFFIXES = ("_s", "_ms")
 
 

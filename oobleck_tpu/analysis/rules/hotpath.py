@@ -30,6 +30,7 @@ HOT_MODULES = (
     "oobleck_tpu/execution/engine.py",
     "oobleck_tpu/execution/pipeline.py",
     "oobleck_tpu/parallel/train.py",
+    "oobleck_tpu/parallel/overlap.py",
 )
 
 FUNNEL_CLASSES = {"DeferredLoss"}

@@ -163,6 +163,16 @@ class ExecutionArguments:
     # OOBLECK_DEGRADE_MAX_SLOWDOWN override at runtime.
     degrade_enabled: bool = True
     degrade_max_slowdown: float = 4.0
+    # Collective/compute overlap on the fused path (parallel/overlap.py):
+    # bucketed ppermute-ring grad sync, FSDP gather prefetch, double-buffered
+    # cross-stage sends, and XLA async-collective flag passthrough.
+    # OOBLECK_OVERLAP, OOBLECK_OVERLAP_BUCKET_MB, OOBLECK_OVERLAP_PREFETCH,
+    # OOBLECK_OVERLAP_DB_SENDS, OOBLECK_OVERLAP_XLA_FLAGS override at runtime.
+    overlap_enabled: bool = False
+    overlap_bucket_bytes: int = 4 * 1024 * 1024
+    overlap_prefetch: bool = True
+    overlap_db_sends: bool = False
+    overlap_xla_flags: bool = True
 
     def __post_init__(self) -> None:
         if self.engine_path not in ("auto", "mpmd", "fused"):
@@ -198,6 +208,11 @@ class ExecutionArguments:
                 "degrade_max_slowdown must be > 1 (a reroute always costs "
                 f"some step time), got {self.degrade_max_slowdown}"
             )
+        if self.overlap_bucket_bytes <= 0:
+            raise ValueError(
+                f"overlap_bucket_bytes must be > 0, got "
+                f"{self.overlap_bucket_bytes}"
+            )
 
     @property
     def resolved_virtual_stages(self) -> int:
@@ -229,6 +244,33 @@ class ExecutionArguments:
         v = os.environ.get("OOBLECK_DEGRADE_MAX_SLOWDOWN")
         if v:
             self.degrade_max_slowdown = float(v)
+        v = os.environ.get("OOBLECK_OVERLAP")
+        if v:
+            self.overlap_enabled = v.lower() not in ("0", "false", "no")
+        v = os.environ.get("OOBLECK_OVERLAP_BUCKET_MB")
+        if v:
+            self.overlap_bucket_bytes = int(float(v) * 1024 * 1024)
+        v = os.environ.get("OOBLECK_OVERLAP_PREFETCH")
+        if v:
+            self.overlap_prefetch = v.lower() not in ("0", "false", "no")
+        v = os.environ.get("OOBLECK_OVERLAP_DB_SENDS")
+        if v:
+            self.overlap_db_sends = v.lower() not in ("0", "false", "no")
+        v = os.environ.get("OOBLECK_OVERLAP_XLA_FLAGS")
+        if v:
+            self.overlap_xla_flags = v.lower() not in ("0", "false", "no")
+
+    def overlap_config(self):
+        """The parallel.overlap.OverlapConfig these arguments describe."""
+        from oobleck_tpu.parallel.overlap import OverlapConfig
+
+        return OverlapConfig(
+            enabled=self.overlap_enabled,
+            bucket_bytes=self.overlap_bucket_bytes,
+            prefetch_fsdp=self.overlap_prefetch,
+            double_buffer_sends=self.overlap_db_sends,
+            xla_flags=self.overlap_xla_flags,
+        )
 
     def resolved_path(self) -> str:
         # auto: fused is still the default home for sequence parallelism

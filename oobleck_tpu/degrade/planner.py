@@ -30,18 +30,30 @@ from oobleck_tpu.execution.schedule import Op, replay_schedule
 class PipelineSpec:
     """What the planner needs to know about one pipeline: schedule shape
     plus calibrated op durations (pipe.last_op_times — (total_s, count)
-    per (stage, chunk, 'f'|'b') — populated when sync_op_timing is on)."""
+    per (stage, chunk, 'f'|'b'|'cf'|'cb') — populated when sync_op_timing
+    is on; 'cf'/'cb' are the cross-stage transfer times the same mode
+    splits out of compute)."""
 
     num_stages: int
     num_microbatches: int
     virtual_stages: int = 1
     op_times: dict = field(default_factory=dict)
+    # Measured fraction of cross-stage transfer time hidden under compute
+    # (bench `overlap` key / oobleck_comm_hidden_fraction gauge). 0.0 keeps
+    # the classic fully-serialized projection; 1.0 projects comm as free.
+    comm_hidden_fraction: float = 0.0
 
     def duration_fn(self):
         """instruction -> seconds from calibrated means; falls back to the
         classic fwd=1/bwd=2 cost model for uncalibrated (stage, chunk)
         units, scaled to the calibrated mean when any calibration exists
-        so mixed dictionaries stay on one time base."""
+        so mixed dictionaries stay on one time base. When the calibration
+        carries comm entries ('cf'/'cb'), each compute op is charged its
+        EFFECTIVE comm — max(0, comm - hidden_fraction * compute) — so an
+        overlap-enabled deployment's degraded projection doesn't double-
+        count latency the schedule already hides."""
+        from oobleck_tpu.parallel.overlap import effective_comm
+
         means: dict[tuple[int, int, str], float] = {}
         for (stage, chunk, kind), (total, count) in self.op_times.items():
             if count > 0:
@@ -57,9 +69,13 @@ class PipelineSpec:
         def dur(inst):
             kind = "b" if inst.op is Op.BACKWARD else "f"
             mean = means.get((inst.stage, inst.chunk, kind))
-            if mean is not None:
-                return mean
-            return base_f * (2.0 if kind == "b" else 1.0)
+            base = mean if mean is not None else (
+                base_f * (2.0 if kind == "b" else 1.0))
+            comm = means.get((inst.stage, inst.chunk, "c" + kind))
+            if comm is not None:
+                base += effective_comm(comm, base,
+                                       self.comm_hidden_fraction)
+            return base
 
         return dur
 
@@ -172,7 +188,7 @@ def plan_reroute(report: FailureReport, specs: list[PipelineSpec],
 
     def makespan(spec: PipelineSpec, microbatches: int) -> float:
         key = (spec.num_stages, microbatches, spec.virtual_stages,
-               id(spec.op_times))
+               id(spec.op_times), spec.comm_hidden_fraction)
         if key not in memo:
             memo[key] = replay_schedule(spec.num_stages, microbatches,
                                         spec.virtual_stages,

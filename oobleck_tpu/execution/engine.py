@@ -1420,6 +1420,7 @@ class OobleckEngine:
             microbatch_size=self.args.job.microbatch_size,
             seq_len=self.seq_len, optimizer=self.optimizer,
             restored=restored,
+            overlap=self.args.execution.overlap_config(),
         )
         self.dataloaders = [self._fused_dataloader(
             global_num_microbatch, num_iterations_done, epoch)]

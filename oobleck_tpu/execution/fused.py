@@ -199,16 +199,17 @@ class FusedPipeline:
 
     def __init__(self, model, mesh, *, num_microbatches: int,
                  microbatch_size: int, seq_len: int, optimizer,
-                 restored: dict | None = None):
+                 restored: dict | None = None, overlap=None):
         self.model = model
         self.mesh = mesh
         self.num_microbatches = num_microbatches
         self.microbatch_size = microbatch_size
         self.seq_len = seq_len
         self.optimizer = optimizer
+        self.overlap = overlap
         self._init_fn, self._step_fn = build_train_step(
             model, mesh, num_microbatches=num_microbatches,
-            optimizer=optimizer,
+            optimizer=optimizer, overlap=overlap,
         )
         self._eval_fn = jax.jit(self._step_fn.loss_fn)
         if restored is None:
@@ -312,7 +313,7 @@ class FusedPipeline:
             self.model, mesh, num_microbatches=self.num_microbatches,
             microbatch_size=self.microbatch_size, seq_len=self.seq_len,
             optimizer=self.optimizer,
-            restored=_PREPLACED,
+            restored=_PREPLACED, overlap=self.overlap,
         )
         fresh.state = fresh._place(host_state)
         return fresh

@@ -242,7 +242,11 @@ class PipelineInstance:
         # timed region so last_op_times records true per-op durations
         # instead of async-dispatch enqueue times (which absorb upstream
         # backpressure and misattribute the whole step's drain to whichever
-        # op happens to block). Serializes execution — bench/tests only,
+        # op happens to block). Also splits comm from compute: cross-stage
+        # activation/grad transfers are sent eagerly (unbatched) and timed
+        # as kinds "cf"/"cb", which stay OUT of stage-busy — they are the
+        # overlappable component the degrade planner's effective_comm
+        # projection discounts. Serializes execution — bench/tests only,
         # never the training hot path.
         self.sync_op_timing = False
         my_process = comm.process_index if comm is not None else None
@@ -828,7 +832,12 @@ class PipelineInstance:
         def record_op(stage, chunk, kind, dt):
             tot, n = op_times.get((stage, chunk, kind), (0.0, 0))
             op_times[(stage, chunk, kind)] = (tot + dt, n + 1)
-            stage_busy[stage] = stage_busy.get(stage, 0.0) + dt
+            # Comm kinds ("cf"/"cb": cross-stage activation/grad transfers)
+            # are the overlappable component — they do not occupy the stage's
+            # compute, so they stay out of the bubble gauge's busy time and
+            # feed the planner's effective_comm projection separately.
+            if kind in ("f", "b"):
+                stage_busy[stage] = stage_busy.get(stage, 0.0) + dt
 
         def chunk_params(st, c):
             return tuple(self.params[li] for li in st.chunks[c])
@@ -915,11 +924,29 @@ class PipelineInstance:
                 y = stash.pop((ins.stage, c, m, "out"), None)
                 aval_layer = st.chunks[c][-1]
                 if st.is_local and nxt.is_local:
+                    if self.sync_op_timing and y is not None:
+                        # Timed mode sends eagerly (no batching) so each
+                        # edge's transfer cost is attributed to its own
+                        # (stage, chunk) as comm kind "cf".
+                        t0 = time.perf_counter()
+                        moved = jax.device_put(y, nxt.batch_sharding)
+                        # oobleck: allow[OBL002] -- opt-in per-op profiling mode
+                        jax.block_until_ready(moved)
+                        record_op(ins.stage, c, "cf",
+                                  time.perf_counter() - t0)
+                        acts[(ds, dc, m)] = moved
+                        return
                     pending_sends.append(
                         (y, nxt.batch_sharding, acts, (ds, dc, m)))
                     return
+                t0 = time.perf_counter()
                 moved = self._move_edge(y, st, nxt, aval_layer=aval_layer)
                 if moved is not None:
+                    if self.sync_op_timing:
+                        # oobleck: allow[OBL002] -- opt-in per-op profiling mode
+                        jax.block_until_ready(moved)
+                        record_op(ins.stage, c, "cf",
+                                  time.perf_counter() - t0)
                     acts[(ds, dc, m)] = moved
             elif ins.op == Op.BACKWARD:
                 if not st.is_local:
@@ -956,11 +983,28 @@ class PipelineInstance:
                 # of the PRODUCING chunk's output activation.
                 aval_layer = prev.chunks[dc][-1]
                 if st.is_local and prev.is_local:
+                    if self.sync_op_timing and dx is not None:
+                        # oobleck: allow[OBL002] -- opt-in per-op profiling mode
+                        jax.block_until_ready(dx)  # exclude bwd compute
+                        t0 = time.perf_counter()
+                        moved = jax.device_put(dx, prev.batch_sharding)
+                        # oobleck: allow[OBL002] -- opt-in per-op profiling mode
+                        jax.block_until_ready(moved)
+                        record_op(ins.stage, c, "cb",
+                                  time.perf_counter() - t0)
+                        gacts[(ds, dc, m)] = moved
+                        return
                     pending_sends.append(
                         (dx, prev.batch_sharding, gacts, (ds, dc, m)))
                     return
+                t0 = time.perf_counter()
                 moved = self._move_edge(dx, st, prev, aval_layer=aval_layer)
                 if moved is not None:
+                    if self.sync_op_timing:
+                        # oobleck: allow[OBL002] -- opt-in per-op profiling mode
+                        jax.block_until_ready(moved)
+                        record_op(ins.stage, c, "cb",
+                                  time.perf_counter() - t0)
                     gacts[(ds, dc, m)] = moved
 
         # Execute the canonical total order (identical on every process;

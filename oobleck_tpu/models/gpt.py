@@ -32,6 +32,7 @@ from jax.sharding import PartitionSpec as P
 from oobleck_tpu.models.base import stack_layer_params
 from oobleck_tpu.ops.attention import causal_attention
 from oobleck_tpu.parallel.collectives import (
+    megatron_f,
     reduce_from_tp,
     unshard_fsdp,
     vocab_parallel_embed,
@@ -48,6 +49,11 @@ class ShardCtx:
     tensor: str | None = None
     fsdp: str | None = None
     seq: str | None = None   # sequence parallelism: ring attention + offsets
+    # Explicit-backward mode (parallel/overlap.py): value_and_grad runs INSIDE
+    # one check_rep=False shard_map, so no spec transposes insert backward
+    # psums — the model must place Megatron `f` at each replicated->column-
+    # parallel entry and make every forward tensor-psum identity-backward.
+    explicit_bwd: bool = False
 
     def tp_size(self) -> int:
         return lax.axis_size(self.tensor) if self.tensor else 1
@@ -128,8 +134,20 @@ def _layer_norm(x: jax.Array, scale: jax.Array, bias: jax.Array, eps: float) -> 
     return (y * scale + bias).astype(dtype)
 
 
-def _maybe_reduce_from_tp(x, axis):
-    return reduce_from_tp(x, axis) if axis else x
+def _maybe_reduce_from_tp(x, axis, identity_bwd=False):
+    return reduce_from_tp(x, axis, identity_bwd=identity_bwd) if axis else x
+
+
+def _maybe_megatron_f(x, ctx: "ShardCtx | None"):
+    """Megatron `f` at a replicated->column-parallel entry, only in
+    explicit-backward mode (the default path's spec transposes handle it)."""
+    if ctx is not None and ctx.explicit_bwd and ctx.tensor:
+        return megatron_f(x, ctx.tensor)
+    return x
+
+
+def _explicit_bwd(ctx: "ShardCtx | None") -> bool:
+    return ctx is not None and ctx.explicit_bwd
 
 
 def _maybe_unshard(p, axis, dim):
@@ -259,7 +277,8 @@ class GPTModel:
         if ctx and ctx.tensor:
             vlocal = p["wte"].shape[0]
             offset = ctx.tp_rank() * vlocal
-            x = vocab_parallel_embed(p["wte"], tokens, offset, ctx.tensor)
+            x = vocab_parallel_embed(p["wte"], tokens, offset, ctx.tensor,
+                                     identity_bwd=_explicit_bwd(ctx))
         else:
             x = p["wte"][tokens]
         if c.position_embedding == "learned":
@@ -283,11 +302,12 @@ class GPTModel:
         t = ctx.tensor if ctx else None
         f_ = ctx.fsdp if ctx else None
         h = _layer_norm(x, p["ln2"]["scale"], p["ln2"]["bias"], c.layer_norm_epsilon)
+        h = _maybe_megatron_f(h, ctx)
         wi = _maybe_unshard(p["mlp"]["wi"], f_, 0).astype(dt)           # [E,Fl]
         h = jax.nn.gelu(h @ wi + p["mlp"]["bi"].astype(dt))
         wo = _maybe_unshard(p["mlp"]["wo"], f_, 1).astype(dt)           # [Fl,E]
         out = h @ wo
-        out = _maybe_reduce_from_tp(out, t) + p["mlp"]["bo"].astype(dt)
+        out = _maybe_reduce_from_tp(out, t, _explicit_bwd(ctx)) + p["mlp"]["bo"].astype(dt)
         return x + out
 
     def attention_sublayer(self, p, x: jax.Array,
@@ -303,9 +323,11 @@ class GPTModel:
         f_ = ctx.fsdp if ctx else None
 
         # --- attention ---
-        # (No Megatron `f` here: shard_map's vma transpose psums the
-        # replicated->varying boundary cotangent itself; see collectives.py.)
+        # (Megatron `f` only in explicit_bwd mode: on the default path the
+        # shard_map spec transpose psums the replicated->varying boundary
+        # cotangent itself; see the regime note in collectives.py.)
         h = _layer_norm(x, p["ln1"]["scale"], p["ln1"]["bias"], c.layer_norm_epsilon)
+        h = _maybe_megatron_f(h, ctx)
         wqkv = _maybe_unshard(p["attn"]["wqkv"], f_, 0).astype(dt)     # [E,3,Hl,D]
         bqkv = p["attn"]["bqkv"].astype(dt)                             # [3,Hl,D]
         qkv = jnp.einsum("bse,ethd->tbhsd", h, wqkv) + bqkv[:, None, :, None, :]
@@ -352,7 +374,7 @@ class GPTModel:
             )
         wo = _maybe_unshard(p["attn"]["wo"], f_, 2).astype(dt)          # [Hl,D,E]
         out = jnp.einsum("bhsd,hde->bse", attn_out, wo)
-        out = _maybe_reduce_from_tp(out, t) + p["attn"]["bo"].astype(dt)
+        out = _maybe_reduce_from_tp(out, t, _explicit_bwd(ctx)) + p["attn"]["bo"].astype(dt)
         if return_kv:
             return x + out, qkv[1], qkv[2]
         return x + out
@@ -377,13 +399,15 @@ class GPTModel:
         caller shifts globally before sharding instead."""
         c = self.config
         x = _layer_norm(x, p["ln_f"]["scale"], p["ln_f"]["bias"], c.layer_norm_epsilon)
+        x = _maybe_megatron_f(x, ctx)
         local_logits = (x @ p["w"].astype(c.dtype)).astype(jnp.float32)
         vlocal = local_logits.shape[-1]
         offset = (ctx.tp_rank() * vlocal) if (ctx and ctx.tensor) else 0
         col_ids = jnp.arange(vlocal) + offset
         local_logits = jnp.where(col_ids < c.vocab_size, local_logits, NEG_INF)
         per_pos = vocab_parallel_logits_loss(
-            local_logits, targets, offset, ctx.tensor if ctx else None
+            local_logits, targets, offset, ctx.tensor if ctx else None,
+            identity_bwd=_explicit_bwd(ctx),
         )
         return jnp.sum(per_pos * mask)
 

@@ -22,7 +22,12 @@ from jax import lax
 from jax.sharding import PartitionSpec as P
 
 from oobleck_tpu.models.base import stack_layer_params
-from oobleck_tpu.models.gpt import NEG_INF, ShardCtx
+from oobleck_tpu.models.gpt import (
+    NEG_INF,
+    ShardCtx,
+    _explicit_bwd,
+    _maybe_megatron_f,
+)
 from oobleck_tpu.ops.attention import causal_attention
 from oobleck_tpu.parallel.collectives import (
     reduce_from_tp,
@@ -119,6 +124,10 @@ def _rope_one(x: jax.Array, pos: jax.Array, theta: float) -> jax.Array:
 
 def _maybe(fn, x, axis, *a):
     return fn(x, axis, *a) if axis else x
+
+
+def _maybe_reduce(x, axis, ctx):
+    return reduce_from_tp(x, axis, identity_bwd=_explicit_bwd(ctx)) if axis else x
 
 
 class LlamaModel:
@@ -226,7 +235,8 @@ class LlamaModel:
         if ctx and ctx.tensor:
             vlocal = p["wte"].shape[0]
             x = vocab_parallel_embed(p["wte"], tokens,
-                                     ctx.tp_rank() * vlocal, ctx.tensor)
+                                     ctx.tp_rank() * vlocal, ctx.tensor,
+                                     identity_bwd=_explicit_bwd(ctx))
         else:
             x = p["wte"][tokens]
         return x.astype(c.dtype)
@@ -252,9 +262,11 @@ class LlamaModel:
         b, s, _ = x.shape
         pos = self._positions(s, ctx)
 
-        # (No Megatron `f`: shard_map's vma transpose supplies the backward
-        # psum at the replicated->varying boundary; see collectives.py.)
+        # (Megatron `f` only in explicit_bwd mode: on the default path the
+        # shard_map spec transpose supplies the backward psum at the
+        # replicated->varying boundary; see the regime note in collectives.py.)
         h = _rms_norm(x, p["ln1"]["scale"], c.rms_norm_eps)
+        h = _maybe_megatron_f(h, ctx)
         wq = _maybe(unshard_fsdp, p["attn"]["wq"], f_, 0).astype(dt)      # [E,Hl,D]
         wkv = _maybe(unshard_fsdp, p["attn"]["wkv"], f_, 0).astype(dt)    # [E,2,KVl,D]
         q = jnp.einsum("bse,ehd->bhsd", h, wq)
@@ -275,7 +287,7 @@ class LlamaModel:
             attn = causal_attention(q, k, v, impl=c.attention_impl)
         wo = _maybe(unshard_fsdp, p["attn"]["wo"], f_, 2).astype(dt)      # [Hl,D,E]
         out = jnp.einsum("bhsd,hde->bse", attn, wo)
-        y = x + _maybe(reduce_from_tp, out, t)
+        y = x + _maybe_reduce(out, t, ctx)
         if return_kv:
             return y, cached_k, cached_v
         return y
@@ -288,12 +300,13 @@ class LlamaModel:
         t = ctx.tensor if ctx else None
         f_ = ctx.fsdp if ctx else None
         h = _rms_norm(x, p["ln2"]["scale"], c.rms_norm_eps)
+        h = _maybe_megatron_f(h, ctx)
         wg = _maybe(unshard_fsdp, p["mlp"]["wg"], f_, 0).astype(dt)
         wu = _maybe(unshard_fsdp, p["mlp"]["wu"], f_, 0).astype(dt)
         g = jax.nn.silu(h @ wg) * (h @ wu)
         wo = _maybe(unshard_fsdp, p["mlp"]["wo"], f_, 1).astype(dt)
         out = g @ wo
-        return x + _maybe(reduce_from_tp, out, t)
+        return x + _maybe_reduce(out, t, ctx)
 
     def head(self, p, x, ctx: ShardCtx | None = None):
         c = self.config
@@ -307,13 +320,15 @@ class LlamaModel:
     def head_loss_shifted(self, p, x, targets, mask, ctx: ShardCtx | None = None):
         c = self.config
         x = _rms_norm(x, p["ln_f"]["scale"], c.rms_norm_eps)
+        x = _maybe_megatron_f(x, ctx)
         local_logits = (x @ p["w"].astype(c.dtype)).astype(jnp.float32)
         vlocal = local_logits.shape[-1]
         offset = (ctx.tp_rank() * vlocal) if (ctx and ctx.tensor) else 0
         col_ids = jnp.arange(vlocal) + offset
         local_logits = jnp.where(col_ids < c.vocab_size, local_logits, NEG_INF)
         per_pos = vocab_parallel_logits_loss(
-            local_logits, targets, offset, ctx.tensor if ctx else None
+            local_logits, targets, offset, ctx.tensor if ctx else None,
+            identity_bwd=_explicit_bwd(ctx),
         )
         return jnp.sum(per_pos * mask)
 

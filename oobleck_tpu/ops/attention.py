@@ -24,6 +24,17 @@ import jax.numpy as jnp
 NEG_INF = -1e9  # large-but-finite: jnp.finfo(bf16).min overflows under softmax subtraction
 
 
+def _pallas_ok() -> bool:
+    """True when Pallas TPU kernels run compiled (i.e. the backend is TPU).
+
+    Shared by the flash/paged "auto" policies and the kernels' interpret
+    toggles: off-TPU the kernels would run in interpreter mode — correct but
+    slow — so auto selection falls back to XLA and explicit pallas requests
+    flip `interpret=True` (CPU parity tests). One helper so the policy and
+    the toggle can never disagree."""
+    return jax.default_backend() == "tpu"
+
+
 def _xla_causal_attention(
     q: jax.Array, k: jax.Array, v: jax.Array, *, scale: float | None = None,
     bias: jax.Array | None = None, causal: bool = True
@@ -177,7 +188,7 @@ def select_attention_impl(impl: str = "auto"):
         # [S, S] logits. Elsewhere (CPU mesh tests) the kernel would run in
         # interpreter mode, so the fused XLA path is faster. Never silently
         # swallow an ImportError here — a masked fallback hides real bugs.
-        if jax.default_backend() == "tpu":
+        if _pallas_ok():
             from oobleck_tpu.ops.flash import flash_attention
 
             return flash_attention

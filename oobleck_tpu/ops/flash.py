@@ -258,7 +258,9 @@ def _canon_bias(bias, h, s_len):
 
 def _interpret() -> bool:
     # Interpreter mode off-TPU: tests validate kernel math on the CPU mesh.
-    return jax.default_backend() != "tpu"
+    from oobleck_tpu.ops.attention import _pallas_ok
+
+    return not _pallas_ok()
 
 
 def _bias_specs(has_bias: bool, h: int, outer_is_q: bool):

@@ -167,7 +167,10 @@ def _paged_kernel(bt_ref, len_ref, q_ref, k_ref, v_ref, *rest,
 
 
 def _interpret() -> bool:
-    return jax.default_backend() != "tpu"
+    # Interpreter mode off-TPU, same toggle as the flash kernel.
+    from oobleck_tpu.ops.attention import _pallas_ok
+
+    return not _pallas_ok()
 
 
 def _paged_decode_pallas(
@@ -249,7 +252,9 @@ def _select_paged_impl(impl: str = "auto"):
         # Same policy as select_attention_impl("auto"): the Pallas kernel
         # on TPU (streamed pages, no HBM gather), the fused XLA gather on
         # CPU where the kernel would run interpreted.
-        if jax.default_backend() == "tpu":
+        from oobleck_tpu.ops.attention import _pallas_ok
+
+        if _pallas_ok():
             return _paged_decode_pallas
         return _paged_decode_xla
     raise ValueError(f"unknown paged attention impl: {impl!r}")

@@ -16,7 +16,8 @@ re-lowering the step function (pre-compiled per template at startup).
 
 from oobleck_tpu.parallel.mesh import MeshShape, make_mesh
 
-__all__ = ["MeshShape", "make_mesh", "TrainState", "build_train_step", "make_optimizer"]
+__all__ = ["MeshShape", "make_mesh", "TrainState", "build_train_step",
+           "make_optimizer", "OverlapConfig"]
 
 
 def __getattr__(name):
@@ -25,4 +26,8 @@ def __getattr__(name):
         from oobleck_tpu.parallel import train
 
         return getattr(train, name)
+    if name == "OverlapConfig":
+        from oobleck_tpu.parallel.overlap import OverlapConfig
+
+        return OverlapConfig
     raise AttributeError(name)

@@ -18,16 +18,61 @@ from jax import lax
 from jax.sharding import PartitionSpec as P
 
 
-# NOTE on Megatron `f` (identity forward / psum backward): it is deliberately
-# ABSENT. Modern shard_map tracks varying-manual-axes (vma) and inserts the
-# backward psum itself when a tensor-replicated activation enters a
-# column-parallel region — an explicit custom_vjp psum there DOUBLE-counts
-# the cotangent (verified numerically: grads off by ~2x with it, exact
-# without). Only the forward reduction `g` needs writing out.
+# NOTE on Megatron `f` (identity forward / psum backward): the default fused
+# path takes value_and_grad OUTSIDE shard_map, where the in/out-spec transposes
+# insert the backward psum at each replicated->varying boundary themselves —
+# an explicit custom_vjp psum there DOUBLE-counts the cotangent (verified
+# numerically: grads off by ~2x with it, exact without), so that path writes
+# only the forward reduction `g`. The OVERLAP path (parallel/overlap.py) is the
+# opposite regime: value_and_grad runs INSIDE one check_rep=False shard_map, no
+# spec transposes run, and the transpose of a bare lax.psum is psum (cotangents
+# of axis-invariant values get multiplied by the axis size — measured 2e+01
+# grad error). There every forward tensor-psum must be `psum_idbwd` and every
+# replicated->column-parallel entry needs an explicit `megatron_f`; the
+# `identity_bwd` flags below switch the shared building blocks between the two
+# regimes.
 
 
-def reduce_from_tp(x, axis: str):
-    """Megatron `g`: psum forward (row-parallel output), identity backward."""
+def psum_idbwd(x, axis: str):
+    """psum forward, identity backward (the stop_gradient trick).
+
+    For explicit-backward bodies (grad taken inside shard_map) where the
+    cotangent is already axis-invariant and a real psum transpose would
+    multiply it by the axis size.
+    """
+    return x + lax.stop_gradient(lax.psum(x, axis) - x)
+
+
+def megatron_f(x, axis: str):
+    """Megatron `f`: identity forward, psum-over-`axis` backward.
+
+    Placed at each replicated->column-parallel entry in explicit-backward
+    bodies: each tensor rank's backward produces only its own partial input
+    cotangent, and `f` sums them into the full one.
+    """
+
+    @jax.custom_vjp
+    def f(v):
+        return v
+
+    def fwd(v):
+        return v, None
+
+    def bwd(_, g):
+        return (lax.psum(g, axis),)
+
+    f.defvjp(fwd, bwd)
+    return f(x)
+
+
+def reduce_from_tp(x, axis: str, *, identity_bwd: bool = False):
+    """Megatron `g`: psum forward (row-parallel output), identity backward.
+
+    identity_bwd=True makes the identity backward explicit (overlap path);
+    False relies on the shard_map spec transpose (default path).
+    """
+    if identity_bwd:
+        return psum_idbwd(x, axis)
     return lax.psum(x, axis)
 
 
@@ -46,6 +91,8 @@ def vocab_parallel_logits_loss(
     targets: jax.Array,
     vocab_offset: jax.Array | int,
     tensor_axis: str | None,
+    *,
+    identity_bwd: bool = False,
 ) -> jax.Array:
     """Cross-entropy over vocab-sharded logits without materializing the full
     vocab dimension on any device (Megatron-style three-psum construction).
@@ -73,8 +120,9 @@ def vocab_parallel_logits_loss(
     gold = jnp.take_along_axis(shifted, safe_ids[..., None], axis=-1)[..., 0]
     gold = jnp.where(in_range, gold, 0.0)
     if tensor_axis is not None:
-        sumexp = lax.psum(sumexp, tensor_axis)
-        gold = lax.psum(gold, tensor_axis)
+        reduce = psum_idbwd if identity_bwd else lax.psum
+        sumexp = reduce(sumexp, tensor_axis)
+        gold = reduce(gold, tensor_axis)
     return jnp.log(sumexp) - gold
 
 
@@ -83,8 +131,17 @@ def vocab_parallel_embed(
     tokens: jax.Array,
     vocab_offset: jax.Array | int,
     tensor_axis: str | None,
+    *,
+    identity_bwd: bool = False,
 ) -> jax.Array:
-    """Embedding lookup over a vocab-sharded table: masked local gather + psum."""
+    """Embedding lookup over a vocab-sharded table: masked local gather + psum.
+
+    identity_bwd: the residual-stream cotangent arriving here in explicit-
+    backward bodies is already tensor-summed (every downstream tensor-parallel
+    branch is guarded by a `megatron_f`), so the psum's backward must be
+    identity — each rank scatters the full row cotangent into only the rows
+    its shard owns.
+    """
     vlocal = wte_local.shape[0]
     local_ids = tokens - vocab_offset
     in_range = (local_ids >= 0) & (local_ids < vlocal)
@@ -92,7 +149,7 @@ def vocab_parallel_embed(
     out = wte_local[safe_ids]
     out = jnp.where(in_range[..., None], out, 0.0)
     if tensor_axis is not None:
-        out = lax.psum(out, tensor_axis)
+        out = psum_idbwd(out, tensor_axis) if identity_bwd else lax.psum(out, tensor_axis)
     return out
 
 

@@ -21,14 +21,24 @@ Design rules learned the hard way (enforced throughout):
   * collectives are issued unconditionally and identically on all devices —
     never inside a `lax.cond` on a device-varying predicate (XLA matches
     collectives by program position; divergence deadlocks the rendezvous);
-  * gradient cross-device reductions are not hand-written: they fall out of
-    the shard_map in_spec transposes (replicated input -> psum of cotangents,
-    all_gather -> psum_scatter), which is exactly the DP/fsdp/TP grad sync the
-    reference builds NCCL process-group grids for (engine.py:363-412).
+  * gradient cross-device reductions are not hand-written on the DEFAULT
+    path: they fall out of the shard_map in_spec transposes (replicated
+    input -> psum of cotangents, all_gather -> psum_scatter), which is
+    exactly the DP/fsdp/TP grad sync the reference builds NCCL process-group
+    grids for (engine.py:363-412).
+  * the OVERLAP path (build_train_step(..., overlap=OverlapConfig(enabled=
+    True))) inverts that last rule: the whole step is ONE check_rep=False
+    shard_map with value_and_grad INSIDE and the grad sync written out —
+    bucketed ppermute rings over the data axis, psums over the other
+    non-spec axes, Megatron f / identity-backward g inside the model
+    (ShardCtx.explicit_bwd) for the tensor axis — so collectives can be
+    bucketed, interleaved, and latency-hidden behind compute. See
+    parallel/overlap.py.
 """
 
 from __future__ import annotations
 
+from dataclasses import replace as _dc_replace
 from typing import Any, NamedTuple
 
 import jax
@@ -39,6 +49,7 @@ from jax import lax
 from jax.sharding import NamedSharding, PartitionSpec as P
 
 from oobleck_tpu.models.gpt import ShardCtx
+from oobleck_tpu.parallel import overlap as ovl
 from oobleck_tpu.parallel.collectives import pvary_to
 from oobleck_tpu.parallel.mesh import (
     ALL_AXES,
@@ -144,14 +155,138 @@ def peak_flops(device_kind: str) -> float | None:
     return None
 
 
+def _overlap_loss_and_grads(model, mesh, specs, ctx: ShardCtx, cfg,
+                            *, num_mb: int, remat: bool):
+    """Overlap-mode core: ONE check_rep=False shard_map over every mesh axis
+    computing (loss, synced grads) with value_and_grad INSIDE.
+
+    Boundary collectives that the three-phase default path gets from its
+    in/out specs are written out: an all_gather over `stage` reconstructs
+    the stage-replicated activation block after the stage-sharded embed, a
+    psum over `stage` broadcasts the last stage's pipeline outputs (zeros
+    elsewhere — each stage then slices its own head chunk, so the psum
+    transpose correctly accumulates every stage's head cotangent), and the
+    per-leaf grad sync goes through overlap.sync_grads (bucketed ppermute
+    rings over data; psums over the other non-spec axes; tensor completed
+    by the model's explicit_bwd f/g — see the regime note in collectives.py).
+    """
+    S = mesh.shape[AXIS_STAGE]
+    axis_sizes = dict(mesh.shape)
+    ctx_u = _dc_replace(ctx, explicit_bwd=True)
+    ctx_nofsdp = _dc_replace(ctx_u, fsdp=None)
+    tok_stage = P(AXIS_STAGE, (AXIS_DATA, AXIS_FSDP), AXIS_SEQ)
+    chunk = num_mb // S
+    block_specs_1 = ovl.unstacked_specs(specs["blocks"])
+    prefetch = cfg.prefetch_fsdp and axis_sizes[AXIS_FSDP] > 1
+    db_sends = cfg.double_buffer_sends and S > 1
+    lead = 2 * (S - 1) if db_sends else S - 1
+    n_ticks = num_mb + lead
+    perm = [(i, (i + 1) % S) for i in range(S)]
+
+    def body(params, tokens_loc, targets_loc):
+        stage_idx = lax.axis_index(AXIS_STAGE)
+        is_first = stage_idx == 0
+        is_last = stage_idx == S - 1
+        mb_local, seq_local = tokens_loc.shape[1], tokens_loc.shape[2]
+        seq_global = seq_local * axis_sizes[AXIS_SEQ]
+        valid = num_mb * (mb_local * axis_sizes[AXIS_DATA]
+                          * axis_sizes[AXIS_FSDP]) * (seq_global - 1)
+        # Local shard of the next-token mask (last global position invalid).
+        pos = lax.axis_index(AXIS_SEQ) * seq_local + jnp.arange(seq_local)
+        mask_loc = jnp.broadcast_to(
+            (pos < seq_global - 1).astype(jnp.float32), tokens_loc.shape)
+
+        def apply_stage(blocks_local, h):
+            if prefetch:
+                return ovl.prefetched_block_scan(
+                    lambda bp, hh: model.apply_block(bp, hh, ctx_nofsdp),
+                    lambda bp: ovl.fsdp_gather_block(
+                        bp, block_specs_1, AXIS_FSDP),
+                    blocks_local, h, model.config.num_layers // S)
+
+            def bodyb(h, bp):
+                return model.apply_block(bp, h, ctx_u), None
+
+            h, _ = lax.scan(bodyb, h, blocks_local)
+            return h
+
+        def local_loss(params):
+            x_loc = model.embed(params["embed"], tokens_loc, ctx_u)
+            x = (lax.all_gather(x_loc, AXIS_STAGE, axis=0, tiled=True)
+                 if S > 1 else x_loc)
+            blocks_local = params["blocks"]
+
+            def tick_plain(carry, t):
+                state, outputs = carry
+                inp = lax.dynamic_index_in_dim(
+                    x, jnp.minimum(t, num_mb - 1), 0, keepdims=False)
+                cur = jnp.where(is_first, inp, state)
+                out = apply_stage(blocks_local, cur)
+                out_idx = t - lead
+                upd = lax.dynamic_update_index_in_dim(
+                    outputs, out, jnp.maximum(out_idx, 0), 0)
+                outputs = jnp.where(is_last & (out_idx >= 0), upd, outputs)
+                state = lax.ppermute(out, AXIS_STAGE, perm)
+                return (state, outputs), None
+
+            def tick_db(carry, t):
+                # The ppermute issued at tick t is consumed at tick t+2:
+                # microbatch m reaches stage s at tick m + 2s, and the send
+                # of m rides under the compute of m+1 (one extra in-flight
+                # buffer, S-1 extra warmup ticks).
+                ready, in_flight, outputs = carry
+                inp = lax.dynamic_index_in_dim(
+                    x, jnp.minimum(t, num_mb - 1), 0, keepdims=False)
+                cur = jnp.where(is_first, inp, ready)
+                out = apply_stage(blocks_local, cur)
+                out_idx = t - lead
+                upd = lax.dynamic_update_index_in_dim(
+                    outputs, out, jnp.maximum(out_idx, 0), 0)
+                outputs = jnp.where(is_last & (out_idx >= 0), upd, outputs)
+                return (in_flight, lax.ppermute(out, AXIS_STAGE, perm),
+                        outputs), None
+
+            tick_fn = tick_db if db_sends else tick_plain
+            tick = jax.checkpoint(tick_fn) if remat else tick_fn
+            zero = jnp.zeros_like(x[0])
+            init = ((zero, zero, jnp.zeros_like(x)) if db_sends
+                    else (zero, jnp.zeros_like(x)))
+            carry, _ = lax.scan(tick, init, jnp.arange(n_ticks))
+            outputs = carry[-1]
+            ys = lax.psum(outputs, AXIS_STAGE) if S > 1 else outputs
+            ys_chunk = lax.dynamic_slice_in_dim(
+                ys, stage_idx * chunk, chunk, axis=0)
+            loss_sum = model.head_loss_shifted(
+                params["head"], ys_chunk, targets_loc, mask_loc, ctx_u)
+            return loss_sum / valid
+
+        loss_local, grads = jax.value_and_grad(local_loss)(params)
+        grads = ovl.sync_grads(
+            grads, specs, axis_sizes,
+            data_impl=cfg.grad_sync, bucket_bytes=cfg.bucket_bytes)
+        loss = lax.psum(
+            loss_local, (AXIS_STAGE, AXIS_DATA, AXIS_FSDP, AXIS_SEQ))
+        return loss, grads
+
+    return jax.shard_map(
+        body, mesh=mesh, in_specs=(specs, tok_stage, tok_stage),
+        out_specs=(P(), specs), axis_names=set(ALL_AXES), check_vma=False,
+    )
+
+
 def build_train_step(model, mesh, *, num_microbatches: int, optimizer=None,
-                     remat: bool | None = None):
+                     remat: bool | None = None,
+                     overlap: "ovl.OverlapConfig | None" = None):
     """Build (init_fn, step_fn) for the fused SPMD path.
 
     init_fn(rng) -> TrainState, sharded over `mesh`.
     step_fn(state, tokens) -> (TrainState, StepMetrics); tokens [batch, seq]
     with batch = num_microbatches * microbatch_size (microbatch split is
     internal). Fully jit-compiled, state donated.
+
+    overlap: an enabled OverlapConfig switches grad computation to the
+    explicit-collective overlap path (see _overlap_loss_and_grads);
+    None/disabled keeps the default three-phase path unchanged.
     """
     if optimizer is None:
         optimizer = make_optimizer()
@@ -271,9 +406,19 @@ def build_train_step(model, mesh, *, num_microbatches: int, optimizer=None,
         valid = num_mb * tokens_mb.shape[1] * (seq - 1)
         return loss_sum / valid
 
+    overlap = overlap if (overlap is not None and overlap.enabled) else None
+    if overlap is not None:
+        ovl_sm = _overlap_loss_and_grads(
+            model, mesh, specs, ctx, overlap, num_mb=num_mb, remat=remat)
+
+        def loss_and_grads(params, tokens_mb, targets_mb):
+            return ovl_sm(params, tokens_mb, targets_mb)
+    else:
+        def loss_and_grads(params, tokens_mb, targets_mb):
+            return jax.value_and_grad(loss_fn)(params, tokens_mb, targets_mb)
+
     def step_fn(state: TrainState, tokens_mb, targets_mb):
-        loss, grads = jax.value_and_grad(loss_fn)(state.params, tokens_mb,
-                                                  targets_mb)
+        loss, grads = loss_and_grads(state.params, tokens_mb, targets_mb)
         updates, new_opt = optimizer.update(grads, state.opt_state, state.params)
         new_params = optax.apply_updates(state.params, updates)
         metrics = StepMetrics(loss=loss, grad_norm=optax.global_norm(grads))
@@ -344,4 +489,11 @@ def build_train_step(model, mesh, *, num_microbatches: int, optimizer=None,
     wrapped_step.prepare = prepare_tokens
     wrapped_step.state_shardings = state_shardings
     wrapped_step.token_sharding = token_sharding
+    wrapped_step.overlap = overlap
+    # (loss, grads) probe for parity tests and the overlap bench — the same
+    # core the step uses, without the optimizer update or donation.
+    wrapped_step.loss_and_grads = jax.jit(
+        loss_and_grads,
+        in_shardings=(state_shardings.params, token_sharding, token_sharding),
+    )
     return jit_init, wrapped_step

@@ -70,17 +70,27 @@ class StageSpec:
 
     @classmethod
     def build(cls, profiles: list[LayerProfile], start: int, end: int,
-              num_chips: int) -> "StageSpec":
+              num_chips: int,
+              comm_hidden_fraction: float = 0.0) -> "StageSpec":
+        """`comm_hidden_fraction` > 0 charges each layer its EFFECTIVE
+        allreduce cost — max(0, ar - hf * layer_compute) — instead of the
+        fully-serialized ar, matching a deployment that runs the overlapped
+        step (parallel/overlap.py). 0.0 reproduces the reference cost model
+        exactly."""
+        from oobleck_tpu.parallel.overlap import effective_comm
+
         fwd = bwd = 0.0
         mem = 0
         for i in range(start, end):
             p = profiles[i]
-            fwd += p.forward / num_chips
-            bwd += p.backward / num_chips
+            f = p.forward / num_chips
+            b = p.backward / num_chips
+            fwd += f
+            bwd += b
             if num_chips > 1:
                 ar = p.allreduce_in_host.get(num_chips, 0.0)
-                fwd += ar
-                bwd += ar
+                fwd += effective_comm(ar, f, comm_hidden_fraction)
+                bwd += effective_comm(ar, b, comm_hidden_fraction)
             mem += 6 * p.mem_params + p.mem_activation
         return cls(tuple(range(start, end)), num_chips, fwd, bwd, mem)
 
@@ -223,15 +233,19 @@ class TemplateGenerator:
         num_hosts: tuple[int, int],
         chips_per_host: int,
         virtual_stages: int = 1,
+        comm_hidden_fraction: float = 0.0,
     ) -> list[PipelineTemplate]:
         """One min-cost template per feasible host count in
         [num_hosts[0], num_hosts[1]] (reference pipeline_template.cpp:82-161).
 
         virtual_stages > 1 evaluates the cost model under the interleaved
-        schedule (warmup/drain ramp divided by v) — python engine only,
-        since the C++ planner predates the interleaved schedule.
+        schedule (warmup/drain ramp divided by v); comm_hidden_fraction > 0
+        evaluates it under the overlapped step (allreduce discounted by the
+        measured hidden fraction). Both are python-engine only — the C++
+        planner predates the interleaved schedule and the overlap path.
         """
-        if self.engine in ("auto", "native") and virtual_stages == 1:
+        if (self.engine in ("auto", "native") and virtual_stages == 1
+                and comm_hidden_fraction == 0.0):
             try:
                 from oobleck_tpu.planning import _native
 
@@ -242,7 +256,8 @@ class TemplateGenerator:
                 if self.engine == "native":
                     raise
         return _python_create_templates(profiles, num_hosts, chips_per_host,
-                                        virtual_stages)
+                                        virtual_stages,
+                                        comm_hidden_fraction)
 
 
 def _python_create_templates(
@@ -250,6 +265,7 @@ def _python_create_templates(
     num_hosts: tuple[int, int],
     chips_per_host: int,
     virtual_stages: int = 1,
+    comm_hidden_fraction: float = 0.0,
 ) -> list[PipelineTemplate]:
     lo, hi = num_hosts
     num_layers = len(profiles)
@@ -257,13 +273,14 @@ def _python_create_templates(
     # One memo across every host count: keys include num_hosts, and multi-host
     # splits recurse into smaller host counts, so sharing is both safe and a
     # large win (the reference shares one dc_cache_ the same way). The
-    # virtual-stage degree is fixed per call, so it stays out of the key.
+    # virtual-stage degree and comm-hidden fraction are fixed per call, so
+    # they stay out of the key (the memo never outlives the call).
     memo: dict = {}
     for n in range(lo, hi + 1):
         best: _DCResult | None = None
         for num_stages in range(n, num_layers + 1):
             r = _dc(profiles, 0, num_layers, num_stages, n, chips_per_host,
-                    memo, virtual_stages)
+                    memo, virtual_stages, comm_hidden_fraction)
             if r is not None and (best is None or r.t < best.t):
                 best = r
         if best is None:
@@ -275,7 +292,7 @@ def _python_create_templates(
 
 
 def _dc(profiles, start, end, num_stages, num_hosts, chips_per_host, memo,
-        virtual_stages: int = 1):
+        virtual_stages: int = 1, comm_hidden_fraction: float = 0.0):
     """Reference divide_and_conquer (pipeline_template.cpp:166-339)."""
     key = (num_stages, start, end, num_hosts, chips_per_host)
     if key in memo:
@@ -298,7 +315,8 @@ def _dc(profiles, start, end, num_stages, num_hosts, chips_per_host, memo,
 
     # Base case
     if num_stages == 1:
-        stage = StageSpec.build(profiles, start, end, chips_per_host)
+        stage = StageSpec.build(profiles, start, end, chips_per_host,
+                                comm_hidden_fraction)
         result = _DCResult.base(stage, virtual_stages)
         memo[key] = result
         return result
@@ -312,9 +330,10 @@ def _dc(profiles, start, end, num_stages, num_hosts, chips_per_host, memo,
                 continue
             for s_left in range(1, num_stages):
                 left = _dc(profiles, start, k, s_left, 1, half, memo,
-                           virtual_stages)
+                           virtual_stages, comm_hidden_fraction)
                 right = _dc(profiles, k, end, num_stages - s_left, 1,
-                            chips_per_host - half, memo, virtual_stages)
+                            chips_per_host - half, memo, virtual_stages,
+                            comm_hidden_fraction)
                 if left is None or right is None:
                     continue
                 cand = _DCResult.combine(left, right, virtual_stages)
@@ -324,10 +343,11 @@ def _dc(profiles, start, end, num_stages, num_hosts, chips_per_host, memo,
             for h_left in range(1, num_hosts):
                 for s_left in range(1, num_stages):
                     left = _dc(profiles, start, k, s_left, h_left,
-                               chips_per_host, memo, virtual_stages)
+                               chips_per_host, memo, virtual_stages,
+                               comm_hidden_fraction)
                     right = _dc(profiles, k, end, num_stages - s_left,
                                 num_hosts - h_left, chips_per_host, memo,
-                                virtual_stages)
+                                virtual_stages, comm_hidden_fraction)
                     if left is None or right is None:
                         continue
                     cand = _DCResult.combine(left, right, virtual_stages)

@@ -125,6 +125,13 @@ def test_obl002_quiet_outside_hot_modules(analyze):
     assert codes(analyze({"oobleck_tpu/utils/misc.py": LEAK})) == []
 
 
+def test_obl002_covers_overlap_module(analyze):
+    # parallel/overlap.py is on the fused hot path (bucketed grad sync,
+    # gather prefetch) — a stray host sync there breaks the overlap win.
+    assert codes(analyze({"oobleck_tpu/parallel/overlap.py": LEAK})) == \
+        ["OBL002"]
+
+
 def test_obl002_funnel_is_exempt(analyze):
     assert codes(analyze({HOT: FUNNELED})) == []
 

@@ -304,3 +304,54 @@ def test_chaos_kill_stage_resolves_to_replica_host(cache_env, devices8):
                 if e.get("event") == "chaos_kill_stage_resolved"]
     assert resolved and resolved[-1]["lost_ip"] == "10.0.0.1"
     assert np.isfinite(eng._train_step())
+
+
+# --------------------------------------------------------------------- #
+# comm-hidden-fraction in the degraded projection (parallel/overlap)
+# --------------------------------------------------------------------- #
+
+def test_duration_fn_charges_effective_comm():
+    """Calibrations that carry 'cf'/'cb' comm entries charge each compute
+    op its EFFECTIVE comm — max(0, comm - hf * compute) — so an
+    overlap-enabled deployment's degraded projection doesn't double-count
+    latency the schedule already hides."""
+    from oobleck_tpu.execution.schedule import Instruction, Op
+
+    op_times = {(0, 0, "f"): (10.0, 10), (0, 0, "cf"): (5.0, 10),
+                (0, 0, "b"): (20.0, 10), (0, 0, "cb"): (5.0, 10)}
+    f_inst = Instruction(Op.FORWARD, 0, 0)
+    b_inst = Instruction(Op.BACKWARD, 0, 0)
+
+    serial = PipelineSpec(1, 4, op_times=op_times).duration_fn()
+    assert serial(f_inst) == pytest.approx(1.0 + 0.5)
+    assert serial(b_inst) == pytest.approx(2.0 + 0.5)
+
+    # hf=0.4: forward keeps 0.5 - 0.4*1.0 = 0.1 of its comm; backward's
+    # larger compute window (2.0) hides all of it
+    partial = PipelineSpec(1, 4, op_times=op_times,
+                           comm_hidden_fraction=0.4).duration_fn()
+    assert partial(f_inst) == pytest.approx(1.1)
+    assert partial(b_inst) == pytest.approx(2.0)
+
+    hidden = PipelineSpec(1, 4, op_times=op_times,
+                          comm_hidden_fraction=1.0).duration_fn()
+    assert hidden(f_inst) == pytest.approx(1.0)
+    assert hidden(b_inst) == pytest.approx(2.0)
+
+
+def test_planner_projection_discounts_hidden_comm():
+    """Same calibration, different measured hidden fraction: the overlap-
+    aware projection must land on a strictly smaller post-reroute
+    makespan (and not be served from the hf=0 memo entry)."""
+    op_times = {(s, 0, k): (v, 1) for s in (0, 1)
+                for k, v in (("f", 1.0), ("b", 2.0),
+                             ("cf", 0.8), ("cb", 0.8))}
+    report = FailureReport(lost_host=1, dead=[1], surviving=[0])
+    makespan = {}
+    for hf in (0.0, 1.0):
+        spec = PipelineSpec(2, 4, op_times=op_times,
+                            comm_hidden_fraction=hf)
+        plan = plan_reroute(report, [spec, spec])
+        assert plan.feasible
+        makespan[hf] = plan.makespan_after
+    assert makespan[1.0] < makespan[0.0]

@@ -120,3 +120,40 @@ def test_reconfigure_under_interleaved_schedule(cache_env, devices8):
     losses = [engine._train_step() for _ in range(3)]
     assert all(np.isfinite(l) for l in losses)
     assert losses[-1] < loss_before
+
+
+def test_sync_op_timing_splits_comm_from_compute(devices8):
+    """The calibration mode's comm/compute split (the overlap measurement
+    hook): with sync_op_timing on, cross-stage transfers are recorded as
+    'cf'/'cb' entries in last_op_times, and stage-busy time — the bubble
+    gauge's numerator — covers ONLY the compute kinds, so hidden comm can
+    never masquerade as pipeline utilization."""
+    from oobleck_tpu.execution.pipeline import PipelineInstance
+    from oobleck_tpu.models import build_model
+    from tests.execution.test_pipeline_mpmd import (
+        MB, NUM_MB, SEQ, make_template)
+
+    model = build_model("gpt2-tiny")  # 6 pipeline layers
+    template = make_template([(0, 3), (3, 6)], [1, 1])
+    rng = np.random.default_rng(0)
+    batch = rng.integers(0, model.config.vocab_size,
+                         size=(NUM_MB, MB, SEQ), dtype=np.int32)
+    pipe = PipelineInstance(
+        pipeline_id=0, template=template, ranks=[0, 1], model=model,
+        devices=devices8[:2], num_microbatches=NUM_MB,
+        total_num_microbatches=NUM_MB, microbatch_size=MB, seq_len=SEQ)
+    pipe.sync_op_timing = True
+    for _ in range(2):  # first step compiles; second gives clean timings
+        pipe.train_step(batch)
+
+    kinds = {k for (_, _, k) in pipe.last_op_times}
+    assert {"f", "b", "cf", "cb"} <= kinds
+    # every comm record carries real measured time
+    for (_, _, k), (t, n) in pipe.last_op_times.items():
+        if k in ("cf", "cb"):
+            assert t > 0.0 and n > 0
+    # and none of it leaks into the stage-busy (bubble) accounting
+    for stage, busy in pipe.last_stage_busy_s.items():
+        compute = sum(t for (s, _, k), (t, _) in pipe.last_op_times.items()
+                      if s == stage and k in ("f", "b"))
+        assert busy == pytest.approx(compute), "comm leaked into stage-busy"

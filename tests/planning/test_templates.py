@@ -143,3 +143,60 @@ def test_json_roundtrip(profiles):
     [t] = gen.create_pipeline_templates(profiles, (2, 2), 4)
     t2 = PipelineTemplate.from_json(t.to_json(), t.num_layers)
     assert t2 == t
+
+
+# --------------------------------------------------------------------- #
+# comm-hidden-fraction: the overlapped-step cost model (parallel/overlap)
+# --------------------------------------------------------------------- #
+
+def test_comm_hidden_fraction_zero_is_reference(profiles):
+    """hf=0.0 must reproduce the reference cost model bit-for-bit — the
+    default argument cannot perturb existing plans."""
+    gen = TemplateGenerator(engine="python")
+    base = gen.create_pipeline_templates(profiles, (1, 4), 4)
+    hf0 = gen.create_pipeline_templates(profiles, (1, 4), 4,
+                                        comm_hidden_fraction=0.0)
+    assert hf0 == base
+
+
+def test_stage_spec_discounts_hidden_allreduce(profiles):
+    from oobleck_tpu.planning.templates import StageSpec
+
+    s0 = StageSpec.build(profiles, 0, 4, 4)
+    sh = StageSpec.build(profiles, 0, 4, 4, comm_hidden_fraction=0.05)
+    s1 = StageSpec.build(profiles, 0, 4, 4, comm_hidden_fraction=1.0)
+    # dummy profiles: in-host ar (0.2) < every layer's per-chip compute
+    # share, so hf=1 hides it entirely — forward collapses to pure compute.
+    assert s1.forward == pytest.approx(
+        sum(p.forward for p in profiles[:4]) / 4)
+    assert s1.latency < sh.latency < s0.latency
+    # only the latency projection moves; shape and memory are untouched
+    assert (s0.layer_indices, s0.num_chips, s0.mem_required) == (
+        s1.layer_indices, s1.num_chips, s1.mem_required)
+
+
+def test_comm_hidden_fraction_lowers_iteration_time(profiles):
+    gen = TemplateGenerator(engine="python")
+    base = gen.create_pipeline_templates(profiles, (1, 4), 4)
+    hf = gen.create_pipeline_templates(profiles, (1, 4), 4,
+                                       comm_hidden_fraction=0.9)
+    assert len(hf) == len(base)
+    for t_hf, t_base in zip(hf, base):
+        assert t_hf.iteration_time <= t_base.iteration_time + 1e-12
+    # single-host template: every stage runs 4 chips, so the in-host
+    # allreduce is on the path and the discount must strictly win
+    [b1] = gen.create_pipeline_templates(profiles, (1, 1), 4)
+    [h1] = gen.create_pipeline_templates(profiles, (1, 1), 4,
+                                         comm_hidden_fraction=0.9)
+    assert h1.iteration_time < b1.iteration_time
+
+
+def test_auto_engine_honors_hf_via_python_fallback(profiles):
+    """comm_hidden_fraction > 0 must bypass the native engine (which
+    predates the overlap cost model): auto == python at the same hf, not
+    the native hf=0 answer."""
+    auto = TemplateGenerator(engine="auto").create_pipeline_templates(
+        profiles, (1, 4), 4, comm_hidden_fraction=0.5)
+    py = TemplateGenerator(engine="python").create_pipeline_templates(
+        profiles, (1, 4), 4, comm_hidden_fraction=0.5)
+    assert auto == py
