@@ -2,7 +2,7 @@
 
 PY ?= python
 
-.PHONY: lint analyze gen-registry test test-slow tier1 bench bench-diff trace-report ckpt-bench serve-bench pipeline-bench degrade-bench policy-bench sim-bench grow-bench overlap-bench
+.PHONY: lint analyze gen-registry test test-slow tier1 bench bench-diff trace-report ckpt-bench serve-bench pipeline-bench degrade-bench policy-bench sim-bench grow-bench overlap-bench master-bench
 
 # Lint = the project-native analyzer (always available, stdlib-only)
 # plus ruff (config in pyproject.toml). Ruff degrades to a skip when not
@@ -119,3 +119,13 @@ grow-bench:
 	JAX_PLATFORMS=cpu OOBLECK_METRICS_DIR= \
 		XLA_FLAGS="--xla_force_host_platform_device_count=8" \
 		$(PY) -m oobleck_tpu.policy.grow_bench
+
+# Control-plane outage: journaling master killed mid-job, restarted
+# against its journal — restart-to-reconciled latency (replay + every
+# REATTACH + the reattach window) and the stale-membership case where a
+# host died DURING the outage and recovery must come from the journal
+# alone. Real sockets, scripted agent clients, no workers (also under
+# bench.py's "master" key, diffed by bench --diff).
+master-bench:
+	JAX_PLATFORMS=cpu OOBLECK_METRICS_DIR= \
+		$(PY) -m oobleck_tpu.elastic.master_bench

@@ -707,6 +707,43 @@ def _sim_summary() -> dict:
         return {"error": f"unparseable sim bench output: {exc}"}
 
 
+MASTER_BENCH_TIMEOUT_S = 120
+
+
+def _master_summary() -> dict:
+    """Control-plane outage microbench
+    (oobleck_tpu/elastic/master_bench.py) in a throwaway CPU subprocess:
+    journaling master killed mid-job, restarted against the journal, and
+    timed to reattach-reconciled — plus the stale-membership case where a
+    host died DURING the outage and only the journal knows it existed.
+    Real sockets, scripted agent clients, no workers."""
+    env = dict(os.environ)
+    env.update({"JAX_PLATFORMS": "cpu", "PALLAS_AXON_POOL_IPS": "",
+                "OOBLECK_METRICS_DIR": ""})
+    env.pop(_INNER_ENV, None)
+    env.pop(_PIPELINE_ENV, None)
+    # The bench owns its journal dir and reattach window; an ambient
+    # operator config must not leak into the measurement.
+    env.pop("OOBLECK_MASTER_STATE_DIR", None)
+    env.pop("OOBLECK_REATTACH_WINDOW", None)
+    proc = subprocess.Popen(
+        [sys.executable, "-m", "oobleck_tpu.elastic.master_bench"],
+        stdout=subprocess.PIPE, stderr=subprocess.PIPE, text=True, env=env)
+    try:
+        out, err = proc.communicate(timeout=MASTER_BENCH_TIMEOUT_S)
+    except subprocess.TimeoutExpired:
+        proc.kill()
+        return {"error": f"master bench hung >{MASTER_BENCH_TIMEOUT_S}s"}
+    if proc.returncode != 0:
+        tail = (err or "").strip().splitlines()[-1:] or ["no stderr"]
+        return {"error":
+                f"master bench exit {proc.returncode}: {tail[0][:160]}"}
+    try:
+        return json.loads(out.strip().splitlines()[-1])
+    except Exception as exc:  # noqa: BLE001
+        return {"error": f"unparseable master bench output: {exc}"}
+
+
 def _analysis_summary() -> dict:
     """One oobleck-lint run over the tree: rule inventory plus finding
     counts, so the bench line records the static-analysis posture the
@@ -787,6 +824,13 @@ def _emit(result: dict) -> None:
         result["sim"] = _sim_summary()
     except Exception as exc:  # noqa: BLE001 — emit must never fail
         result["sim"] = {"error": f"{type(exc).__name__}: {exc}"}
+    # Control-plane outage (restart-to-reconciled, failure-during-outage
+    # recovery): CPU subprocess, real sockets, bounded, best-effort — see
+    # _master_summary.
+    try:
+        result["master"] = _master_summary()
+    except Exception as exc:  # noqa: BLE001 — emit must never fail
+        result["master"] = {"error": f"{type(exc).__name__}: {exc}"}
     # Static-analysis posture (oobleck_tpu/analysis): in-process, cheap.
     # `findings` counts NEW findings — anything nonzero means the tree
     # regressed against the lint gate, so the diff treats it lower-is-

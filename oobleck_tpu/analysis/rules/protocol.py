@@ -8,7 +8,7 @@ RECONFIGURATION, and extra payload keys are carried by named constants
 ignore. Those rules lived in reviewer memory; the PR-8 cleanup found
 stale dispatch code precisely because nothing machine-checked them.
 
-Three checks, all cross-file:
+Four checks, all cross-file:
 
 1. every ``ResponseType`` member is dispatched in the agent
    (``ResponseType.X`` must appear in ``elastic/agent.py``);
@@ -18,7 +18,12 @@ Three checks, all cross-file:
    here — that forced stop is the point;
 3. broadcast payload construction in ``elastic/master.py`` may only use
    the core literal keys; anything new must be a named constant
-   (the TRACE_KEY / DECISION_KEY legacy-tolerant pattern).
+   (the TRACE_KEY / DECISION_KEY legacy-tolerant pattern) — this is
+   what forces epoch stamps to ride ``EPOCH_KEY``;
+4. every ``RequestType`` member is dispatched in the master
+   (``RequestType.X`` must appear in ``elastic/master.py``) — PR 16's
+   REATTACH rode this: an agent-originated verb with no master arm is a
+   handshake that hangs forever, not a protocol extension.
 """
 
 from __future__ import annotations
@@ -133,6 +138,15 @@ class ProtocolRule(Rule):
 
         for master in project.modules_matching(MASTER_MODULE):
             yield from self._check_broadcast_keys(master)
+            requests = _enum_members(msg, "RequestType")
+            handled = _attr_accesses(master, "RequestType")
+            for name, node in requests.items():
+                if name not in handled:
+                    yield msg.finding(
+                        self, node,
+                        f"RequestType.{name} has no dispatch arm in "
+                        f"{master.relpath} — an agent-originated verb the "
+                        f"master never handles is a hung handshake")
 
     def _check_broadcast_keys(self, master: ModuleInfo) -> Iterator[Finding]:
         for fns in astutil.functions_of(master.tree).values():

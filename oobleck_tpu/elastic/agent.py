@@ -23,6 +23,7 @@ Responsibilities:
 from __future__ import annotations
 
 import asyncio
+import collections
 import logging
 import multiprocessing as mp
 import os
@@ -31,6 +32,7 @@ from dataclasses import dataclass
 
 from oobleck_tpu.config import OobleckArguments
 from oobleck_tpu.elastic.message import (
+    EPOCH_KEY,
     JOINED_KEY,
     PROTOCOL_VERSION,
     RequestType,
@@ -53,11 +55,17 @@ WORKER_DEATH_GRACE = 30.0
 # Bounded connect/register retries with exponential backoff: a master that
 # is still binding its port (agents race the launcher) or briefly
 # partitioned gets retried; a genuinely absent master fails loudly in
-# bounded time instead of hanging the host forever.
+# bounded time instead of hanging the host forever. The bound applies to
+# BRING-UP only — once a job is established, losing the master flips the
+# agent into masterless mode (capped-backoff redial forever, training
+# uninterrupted) instead of terminating: a master outage must stall
+# *detection*, never *training*.
 CONNECT_ATTEMPTS = 6
 REGISTER_ATTEMPTS = 4
 BACKOFF_INITIAL = 0.5
 BACKOFF_CAP = 10.0
+# Worker-observed events buffered while masterless, replayed on REATTACH.
+MASTERLESS_BUFFER = 64
 
 
 def _env_float(name: str, default: float) -> float:
@@ -107,6 +115,20 @@ class OobleckAgent:
         # the response/ping loops must ride it out instead of terminating
         # on the (intentional) connection loss.
         self._flapping = False
+        # Masterless degraded mode: monotonic stamp of when the master
+        # link died mid-job (None while attached). Training continues;
+        # the response loop owns the redial-forever/REATTACH cycle.
+        self._masterless_since: float | None = None
+        # Highest master epoch this agent has applied a verb from: the
+        # split-brain fence floor. 0 = no epoch seen (legacy trust).
+        self._last_epoch = 0
+        # Worker-observed failures / committed incidents that could not be
+        # pushed while masterless; bounded, replayed on REATTACH.
+        self._buffer: collections.deque = collections.deque(
+            maxlen=MASTERLESS_BUFFER)
+        # chaos partition_master: monotonic deadline before which redial
+        # attempts are suppressed (the link is "partitioned", not down).
+        self._partition_until = 0.0
         reg = metrics.registry()
         self._m_rtt = reg.gauge(
             "oobleck_agent_heartbeat_rtt_seconds",
@@ -117,6 +139,9 @@ class OobleckAgent:
         self._m_respawns = reg.counter(
             "oobleck_agent_worker_respawns_total",
             "Worker respawns triggered by reconfiguration")
+        self._m_masterless = reg.gauge(
+            "oobleck_agent_masterless_seconds",
+            "Seconds this agent has been without a master (0 = attached)")
 
     # ------------------------------------------------------------------ #
 
@@ -140,6 +165,9 @@ class OobleckAgent:
         notice = chaos().preempt_notice(self.agent_ip)
         if notice is not None:
             tasks.append(self._preemption_chaos(*notice))
+        partition = chaos().partition_master_secs(self.agent_ip)
+        if partition is not None:
+            tasks.append(self._partition_chaos(partition))
         await asyncio.gather(*tasks)
 
     async def _bringup(self) -> None:
@@ -181,6 +209,13 @@ class OobleckAgent:
                                    WORKER_DEATH_GRACE)
                 if pending is None or pending[0] is not w:
                     pending = (w, time.monotonic())
+                    if self._masterless_since is not None:
+                        # Nobody is watching: queue the observation for
+                        # replay on REATTACH so the restarted master still
+                        # learns about the death.
+                        self._buffer.append(
+                            {"kind": "failure", "ip": self.agent_ip,
+                             "cause": "worker_exit"})
                     logger.warning(
                         "worker died (exit=%s); waiting %.0fs for a "
                         "reconfiguration that explains it",
@@ -222,6 +257,25 @@ class OobleckAgent:
             await self.register()  # raises once quarantined -> agent exits
             self._flapping = False
             logger.warning("chaos: flap — re-registered")
+
+    async def _partition_chaos(self, secs: float) -> None:
+        """partition_master: sever this host's master link for `secs`
+        seconds — the master stays up, the agent simply cannot reach it.
+        The agent must ride it out in masterless mode (training
+        uninterrupted) and REATTACH once the partition heals; the master
+        meanwhile sees a heartbeat-deadline eviction and broadcasts the
+        loss, so healing also exercises the stale-membership reconcile."""
+        await asyncio.sleep(1.0)  # let registration settle first
+        logger.warning("chaos: partitioned from master for %.1fs", secs)
+        metrics.flight_recorder().record(
+            "chaos_injection", action="partition_master", ip=self.agent_ip,
+            seconds=secs)
+        self._partition_until = time.monotonic() + secs
+        try:
+            self._writer.close()
+            await self._writer.wait_closed()
+        except (ConnectionError, OSError):
+            pass
 
     async def _preemption_chaos(self, warn_s: float, delay_s: float) -> None:
         """preempt_notice: after `delay_s`, send the master a SIGTERM-style
@@ -291,12 +345,24 @@ class OobleckAgent:
                     )
                 msg = await recv_msg(self._reader)
                 if msg.get("kind") == ResponseType.SUCCESS.value:
-                    self.args = OobleckArguments.from_dict(msg["args"])
-                    self.node_ips = list(self.args.dist.node_ips)
-                    logger.info("registered; job model=%s",
-                                self.args.model.model_name)
-                    return
-                last = RuntimeError(f"registration failed: {msg}")
+                    # A master that crashes mid-handshake can emit the
+                    # SUCCESS frame without (or with a torn) job-args
+                    # payload; that is a retryable half-handshake against
+                    # the restarted master, not a fatal protocol error.
+                    try:
+                        args = OobleckArguments.from_dict(msg["args"])
+                    except (KeyError, TypeError, ValueError) as e:
+                        last = RuntimeError(
+                            f"half-handshake: SUCCESS without usable "
+                            f"job args ({e})")
+                    else:
+                        self.args = args
+                        self.node_ips = list(self.args.dist.node_ips)
+                        logger.info("registered; job model=%s",
+                                    self.args.model.model_name)
+                        return
+                else:
+                    last = RuntimeError(f"registration failed: {msg}")
             except (ConnectionError, OSError, asyncio.IncompleteReadError,
                     asyncio.TimeoutError, TimeoutError) as e:
                 last = e
@@ -430,10 +496,14 @@ class OobleckAgent:
             except (asyncio.IncompleteReadError, ConnectionError, OSError):
                 if self._flapping:
                     continue
-                logger.error("master connection lost; exiting")
-                self.terminate()
-                return
+                # Masterless degraded mode: a lost master mid-job stalls
+                # detection, never training. Redial forever; the worker
+                # keeps stepping the whole time.
+                await self._ride_out_masterless()
+                continue
             kind = msg.get("kind")
+            if not self._epoch_admits(msg):
+                continue
             if kind == ResponseType.PONG.value:
                 if self._ping_sent_at is not None:
                     rtt = time.monotonic() - self._ping_sent_at
@@ -474,6 +544,119 @@ class OobleckAgent:
                 # fatal — log it so the verb never vanishes silently.
                 logger.warning("master replied FAILURE: %s",
                                msg.get("error", msg))
+
+    def _epoch_admits(self, msg: dict) -> bool:
+        """Split-brain fence: reject any verb stamped with a master epoch
+        LOWER than the highest this agent has applied — a resurrected old
+        master (or a delayed frame from one) must never drive the fleet.
+        Unstamped messages are admitted (legacy masters predate the fence;
+        untagged trust is the pre-fence behavior)."""
+        epoch = msg.get(EPOCH_KEY)
+        if epoch is None:
+            return True
+        epoch = int(epoch)
+        if epoch < self._last_epoch:
+            logger.error(
+                "rejecting %s from stale master epoch %d (< applied %d)",
+                msg.get("kind"), epoch, self._last_epoch)
+            metrics.flight_recorder().record(
+                "stale_epoch_rejected", ip=self.agent_ip,
+                kind=msg.get("kind"), epoch=epoch,
+                applied_epoch=self._last_epoch)
+            return False
+        self._last_epoch = epoch
+        return True
+
+    async def _ride_out_masterless(self) -> None:
+        """Masterless degraded mode: the master link died mid-job. Training
+        continues untouched; this coroutine owns the capped-backoff
+        redial-forever cycle and returns only once a REATTACH (or legacy
+        re-register fallback) lands. The bring-up CONNECT_ATTEMPTS bound
+        deliberately does NOT apply here — an established job must survive
+        an arbitrarily long master outage."""
+        self._masterless_since = time.monotonic()
+        logger.error("master connection lost mid-job; entering masterless "
+                     "mode (training continues; redialing forever)")
+        metrics.flight_recorder().record("masterless_enter",
+                                         ip=self.agent_ip)
+        delay = BACKOFF_INITIAL
+        while True:
+            self._m_masterless.set(
+                time.monotonic() - self._masterless_since)
+            wait = self._partition_until - time.monotonic()
+            if wait > 0:  # chaos partition: link is severed, not down
+                await asyncio.sleep(min(1.0, wait))
+                continue
+            try:
+                self._reader, self._writer = await asyncio.open_connection(
+                    self.master_ip, self.master_port)
+            except OSError:
+                await asyncio.sleep(delay)
+                delay = min(delay * 2, BACKOFF_CAP)
+                continue
+            if await self._reattach():
+                break
+            try:
+                self._writer.close()
+                await self._writer.wait_closed()
+            except (ConnectionError, OSError):
+                pass
+            await asyncio.sleep(delay)
+            delay = min(delay * 2, BACKOFF_CAP)
+        outage = time.monotonic() - self._masterless_since
+        self._masterless_since = None
+        self._m_masterless.set(0.0)
+        logger.warning("reattached to master after %.1fs masterless",
+                       outage)
+        metrics.flight_recorder().record(
+            "masterless_exit", ip=self.agent_ip,
+            outage_s=round(outage, 3))
+
+    async def _reattach(self) -> bool:
+        """One REATTACH handshake against a freshly dialed master. Carries
+        the worker's liveness (the master must NOT relaunch it), the
+        highest applied epoch (fence baseline exchange), and the bounded
+        buffer of events observed while masterless."""
+        w = self.worker
+        worker_alive = bool(w is not None and w.process.is_alive())
+        try:
+            async with self._send_lock:
+                await send_request(
+                    self._writer, RequestType.REATTACH,
+                    {"ip": self.agent_ip,
+                     "protocol": PROTOCOL_VERSION,
+                     "ping_interval": self.ping_interval,
+                     "last_epoch": self._last_epoch,
+                     "worker_alive": worker_alive,
+                     "buffered": list(self._buffer)})
+            msg = await recv_msg(self._reader)
+        except (ConnectionError, OSError, asyncio.IncompleteReadError,
+                asyncio.TimeoutError, TimeoutError):
+            return False
+        if msg.get("kind") == ResponseType.SUCCESS.value:
+            epoch = msg.get(EPOCH_KEY)
+            if epoch is not None:
+                self._last_epoch = max(self._last_epoch, int(epoch))
+            self._buffer.clear()  # delivered — the master replayed them
+            return True
+        if "stale master" in str(msg.get("error", "")):
+            # The fence cut the other way: WE have seen a newer epoch than
+            # this master. Keep dialing — the current master will answer.
+            logger.error("dialed a stale master (our epoch %d); retrying",
+                         self._last_epoch)
+            return False
+        # Legacy master (predates REATTACH) answers FAILURE: fall back to
+        # plain REGISTER_AGENT, which it treats as a fresh bring-up —
+        # slower (worker relaunch on the next reconfiguration), never wrong.
+        logger.warning("master refused REATTACH (%s); falling back to "
+                       "REGISTER_AGENT", msg.get("error", msg))
+        try:
+            await self.connect_to_master()
+            await self.register()
+        except (RuntimeError, OSError):
+            return False
+        self._buffer.clear()
+        return True
 
     async def on_reconfiguration(self, lost_ip: str,
                                  degrade: bool = False,
@@ -620,6 +803,8 @@ class OobleckAgent:
             await asyncio.sleep(self.ping_interval)
             if self._flapping:
                 continue  # connection intentionally down (chaos flap)
+            if self._masterless_since is not None:
+                continue  # the response loop owns the redial cycle
             if chaos().heartbeat_stalled(self.agent_ip):
                 # Fault injection: go silent WITHOUT closing the socket —
                 # the hung-peer case only the master's heartbeat deadline
@@ -635,12 +820,21 @@ class OobleckAgent:
                 await self._push_metrics("agent",
                                          metrics.registry().snapshot())
             except (ConnectionError, OSError):
-                if self._flapping:
-                    continue
-                return
+                # The response loop observes the same dead socket and
+                # enters masterless mode; keep ticking for the reattach.
+                continue
 
     async def _push_metrics(self, role: str, snapshot: dict) -> None:
         """Ship one registry snapshot to the master (METRICS, no reply)."""
+        if self._masterless_since is not None:
+            # No master to push to. The only snapshot content the master
+            # cannot reconstruct after the outage is the engine's committed
+            # incident report — keep it in the bounded replay buffer.
+            report = (snapshot or {}).get("incident")
+            if isinstance(report, dict):
+                self._buffer.append({"kind": "incident",
+                                     "report": dict(report)})
+            return
         try:
             async with self._send_lock:
                 await send_request(self._writer, RequestType.METRICS,

@@ -1,0 +1,273 @@
+"""Durable control-plane journal: the master survives its own failure.
+
+Everything the master knows that cannot be re-derived from the fleet —
+registrations and departures, quarantine transitions, per-host MTBF
+observations, policy latency EWMAs, open incident and grow batches, and
+the monotonic ``master_epoch`` itself — is write-ahead journaled here so
+a restarted master resumes *deciding* instead of resuming *amnesiac*.
+
+Layout under ``OOBLECK_MASTER_STATE_DIR``:
+
+    <dir>/
+      SNAPSHOT.json       compacted state + the epoch (atomic-rename commit)
+      journal.jsonl       entries since the snapshot (append, fsync'd)
+      .tmp-SNAPSHOT.json  in-flight snapshot (invisible to recovery)
+
+Durability discipline mirrors the checkpoint plane (ckpt/manifest.py):
+the snapshot commits via tmp + fsync + ``os.replace`` + dir fsync, so it
+either exists with full content or not at all; journal appends are one
+JSON object per line, fsync'd per entry — a torn final line (crash mid-
+append) is detected and dropped at replay, never propagated. Replay =
+snapshot + tail, and compaction (every ``OOBLECK_JOURNAL_SNAPSHOT_EVERY``
+entries) folds the tail into a fresh snapshot then truncates the journal.
+
+The epoch is bumped and PERSISTED inside ``open()`` before the caller
+sees it: a master that crashes between boot and its first broadcast still
+burned the epoch, so no two master incarnations can ever stamp the same
+one (the split-brain fence's ground truth).
+
+Timestamps in journal entries are wall-clock (``time.time``) — monotonic
+clocks do not survive a process restart, and the health tracker's replay
+path converts ages back into its own clock domain.
+"""
+
+from __future__ import annotations
+
+import json
+import logging
+import os
+import time
+from pathlib import Path
+
+from oobleck_tpu.ckpt.manifest import atomic_write_json, fsync_dir, read_json
+
+logger = logging.getLogger("oobleck.journal")
+
+ENV_STATE_DIR = "OOBLECK_MASTER_STATE_DIR"
+ENV_SNAPSHOT_EVERY = "OOBLECK_JOURNAL_SNAPSHOT_EVERY"
+DEFAULT_SNAPSHOT_EVERY = 64
+
+SNAPSHOT_FILE = "SNAPSHOT.json"
+JOURNAL_FILE = "journal.jsonl"
+FORMAT_VERSION = 1
+
+# Entry kinds, named here so master/replay/tests share one vocabulary.
+EV_REGISTER = "register"
+EV_DEPART = "depart"
+EV_QUARANTINE = "quarantine"
+EV_FAILURE = "failure"            # per-host MTBF observation
+EV_EWMA = "ewma"                  # policy latency EWMA snapshot
+EV_INCIDENT_OPEN = "incident_open"
+EV_INCIDENT_CLOSE = "incident_close"
+EV_JOB = "job"                    # job launched (args ride the entry)
+EV_JOB_DONE = "job_done"
+
+
+def state_dir() -> str | None:
+    """The configured journal directory, or None (journaling off)."""
+    return os.environ.get(ENV_STATE_DIR) or None
+
+
+def snapshot_every() -> int:
+    raw = os.environ.get(ENV_SNAPSHOT_EVERY, "")
+    try:
+        n = int(raw) if raw else DEFAULT_SNAPSHOT_EVERY
+    except ValueError:
+        n = DEFAULT_SNAPSHOT_EVERY
+    return max(n, 1)
+
+
+class MasterJournal:
+    """Write-ahead journal + snapshot compaction for one master daemon.
+
+    Not thread-safe by itself: the master's single event loop serializes
+    every append (same contract as the registry / policy engine)."""
+
+    def __init__(self, directory: str | Path):
+        self.dir = Path(directory)
+        self.dir.mkdir(parents=True, exist_ok=True)
+        self.epoch = 0
+        # In-memory mirror of the durable state, replayed on open() and
+        # folded into SNAPSHOT.json at compaction.
+        self.state: dict = _empty_state()
+        self.entries_since_snapshot = 0
+        self.last_replay_s: float | None = None
+        self.replayed_entries = 0
+        self._fh = None  # append handle, opened lazily
+
+    # -- boot -------------------------------------------------------------- #
+
+    def open(self) -> None:
+        """Replay snapshot + journal tail, then bump and persist the epoch.
+
+        After open() returns, ``self.epoch`` is a value no previous master
+        incarnation ever stamped on a broadcast — even one that crashed
+        before broadcasting anything."""
+        t0 = time.monotonic()
+        snap_path = self.dir / SNAPSHOT_FILE
+        if snap_path.exists():
+            try:
+                snap = read_json(snap_path)
+                self.state = _merge_state(snap.get("state") or {})
+                self.epoch = int(snap.get("epoch") or 0)
+            except (json.JSONDecodeError, OSError, ValueError) as e:
+                # A torn snapshot cannot happen (atomic rename) — this is
+                # operator damage; refuse to guess and start fresh loudly.
+                logger.error("unreadable %s (%s); starting fresh", snap_path, e)
+                self.state = _empty_state()
+                self.epoch = 0
+        self.replayed_entries = self._replay_tail()
+        self.epoch += 1
+        # Persist the bumped epoch BEFORE the caller can broadcast with it:
+        # the snapshot write is the epoch burn.
+        self._write_snapshot()
+        self._truncate_journal()
+        self.last_replay_s = time.monotonic() - t0
+        logger.info(
+            "journal replayed: epoch=%d entries=%d agents=%s (%.3fs)",
+            self.epoch, self.replayed_entries,
+            sorted(self.state["agents"]), self.last_replay_s)
+
+    def _replay_tail(self) -> int:
+        path = self.dir / JOURNAL_FILE
+        if not path.exists():
+            return 0
+        n = 0
+        try:
+            raw = path.read_bytes()
+        except OSError:
+            return 0
+        for line in raw.splitlines():
+            if not line.strip():
+                continue
+            try:
+                entry = json.loads(line)
+            except json.JSONDecodeError:
+                # Torn final line: the crash hit mid-append. Everything
+                # before it is intact (one fsync per entry); drop the tail.
+                logger.warning("dropping torn journal tail (%d bytes)",
+                               len(line))
+                break
+            self._apply(entry)
+            n += 1
+        return n
+
+    # -- append ------------------------------------------------------------ #
+
+    def append(self, kind: str, **fields) -> None:
+        """Write-ahead: the entry is durable before the caller proceeds."""
+        entry = {"kind": kind, "ts": time.time(), **fields}
+        self._apply(entry)
+        if self._fh is None:
+            self._fh = open(self.dir / JOURNAL_FILE, "ab")
+        self._fh.write(json.dumps(entry).encode() + b"\n")
+        self._fh.flush()
+        os.fsync(self._fh.fileno())
+        self.entries_since_snapshot += 1
+        if self.entries_since_snapshot >= snapshot_every():
+            self.compact()
+
+    def _apply(self, entry: dict) -> None:
+        """Fold one entry into the in-memory state mirror."""
+        kind = entry.get("kind")
+        s = self.state
+        ip = entry.get("ip")
+        if kind == EV_REGISTER:
+            if ip:
+                s["agents"][ip] = {"registered_at": entry.get("ts")}
+        elif kind == EV_DEPART:
+            s["agents"].pop(ip, None)
+        elif kind == EV_FAILURE:
+            log = s["failures"].setdefault(ip, [])
+            log.append(entry.get("ts"))
+            del log[:-32]
+            if entry.get("cause"):
+                s["causes"][ip] = entry["cause"]
+        elif kind == EV_QUARANTINE:
+            if entry.get("entered"):
+                s["quarantined"][ip] = entry.get("ts")
+            else:
+                s["quarantined"].pop(ip, None)
+        elif kind == EV_EWMA:
+            s["ewma"] = dict(entry.get("ewma") or {})
+        elif kind == EV_INCIDENT_OPEN:
+            tid = entry.get("trace_id")
+            if tid:
+                s["open_incidents"][tid] = {
+                    k: entry.get(k) for k in
+                    ("lost_ip", "joined_ips", "cause", "ts")}
+        elif kind == EV_INCIDENT_CLOSE:
+            s["open_incidents"].pop(entry.get("trace_id"), None)
+        elif kind == EV_JOB:
+            s["job"] = entry.get("args")
+        elif kind == EV_JOB_DONE:
+            s["job"] = None
+
+    # -- compaction -------------------------------------------------------- #
+
+    def compact(self) -> None:
+        """Fold the tail into a fresh snapshot, then truncate the journal.
+        Crash-ordering: the snapshot rename commits FIRST; a crash between
+        it and the truncate leaves already-folded entries in the journal,
+        which replay idempotently (set/dict semantics), never corrupt."""
+        self._write_snapshot()
+        self._truncate_journal()
+
+    def _write_snapshot(self) -> None:
+        atomic_write_json(self.dir / SNAPSHOT_FILE, {
+            "version": FORMAT_VERSION,
+            "epoch": self.epoch,
+            "written_at": time.time(),
+            "state": self.state,
+        })
+
+    def _truncate_journal(self) -> None:
+        if self._fh is not None:
+            self._fh.close()
+            self._fh = None
+        path = self.dir / JOURNAL_FILE
+        with open(path, "wb") as f:
+            f.flush()
+            os.fsync(f.fileno())
+        fsync_dir(self.dir)
+        self.entries_since_snapshot = 0
+
+    def close(self) -> None:
+        if self._fh is not None:
+            self._fh.close()
+            self._fh = None
+
+    # -- /status ----------------------------------------------------------- #
+
+    def status(self) -> dict:
+        """Bounded control_plane digest for the master's /status."""
+        return {
+            "epoch": self.epoch,
+            "journal_lag": self.entries_since_snapshot,
+            "last_replay_s": (round(self.last_replay_s, 6)
+                              if self.last_replay_s is not None else None),
+            "replayed_entries": self.replayed_entries,
+            "open_incidents": len(self.state["open_incidents"]),
+        }
+
+
+def _empty_state() -> dict:
+    return {
+        "agents": {},          # ip -> {"registered_at": ts}
+        "failures": {},        # ip -> [wall ts, ...]
+        "causes": {},          # ip -> last cause
+        "quarantined": {},     # ip -> entered ts
+        "ewma": {},            # mechanism -> seconds
+        "open_incidents": {},  # trace_id -> digest
+        "job": None,           # job args dict while one is running
+    }
+
+
+def _merge_state(loaded: dict) -> dict:
+    """A snapshot from an older format is merged over the empty shape so
+    missing keys never KeyError the replay path."""
+    s = _empty_state()
+    for k in s:
+        if k in loaded and loaded[k] is not None:
+            s[k] = loaded[k]
+    return s

@@ -37,8 +37,10 @@ import time
 from dataclasses import dataclass, field
 
 from oobleck_tpu.config import OobleckArguments
+from oobleck_tpu.elastic import journal as journal_mod
 from oobleck_tpu.elastic.message import (
     DEFAULT_PING_INTERVAL,
+    EPOCH_KEY,
     JOINED_KEY,
     DistributionInfo,
     RequestType,
@@ -65,6 +67,14 @@ DEFAULT_JOIN_WINDOW_S = 0.25
 
 # Committed incident reports pushed up from workers, kept for /status.
 MAX_INCIDENTS = 16
+
+# Post-restart reconciliation window: a restarted master waits this long
+# for masterless agents to REATTACH before journal-vs-reality reconcile —
+# every expected host still missing at the close becomes ONE batched loss
+# incident through the normal policy chain (the grow-window mirror for
+# the restart direction).
+ENV_REATTACH_WINDOW = "OOBLECK_REATTACH_WINDOW"
+DEFAULT_REATTACH_WINDOW_S = 10.0
 
 logger = logging.getLogger("oobleck.master")
 
@@ -198,6 +208,18 @@ class OobleckMasterDaemon:
         # restore per incident from live signals (oobleck_tpu/policy).
         self.policy = PolicyEngine(
             multihost=os.environ.get("OOBLECK_MULTIHOST") == "1")
+        # Durable control-plane journal (OOBLECK_MASTER_STATE_DIR): the
+        # master's own survival plane. None = journaling off (the pre-PR
+        # in-memory-only behavior); epoch 0 means "no fence" to agents.
+        self.journal: journal_mod.MasterJournal | None = None
+        self.master_epoch = 0
+        # Post-restart reconciliation: agents the replayed journal expects,
+        # the set that actually REATTACHed, and the window-close task.
+        self._expected_reattach: set[str] = set()
+        self._reattached: set[str] = set()
+        self._reattached_total = 0
+        self._reconcile_task: asyncio.Task | None = None
+        self._outage_trace_id: str | None = None
         self.metrics_port: int | None = None
         self._http: metrics.MetricsHTTPServer | None = None
         reg = metrics.registry()
@@ -214,17 +236,103 @@ class OobleckMasterDaemon:
         self._m_grows = reg.counter(
             "oobleck_master_grow_broadcasts_total",
             "GROW broadcasts sent for mid-training JOIN batches")
+        self._m_epoch = reg.gauge(
+            "oobleck_master_epoch",
+            "Monotonic master incarnation epoch (split-brain fence)")
+        self._m_reattaches = reg.counter(
+            "oobleck_master_reattaches_total",
+            "Agents re-attached after a master restart")
+        self._m_journal_lag = reg.gauge(
+            "oobleck_master_journal_lag_entries",
+            "Journal entries appended since the last snapshot compaction")
 
     # ------------------------------------------------------------------ #
 
     async def start(self) -> None:
         metrics.set_role("master")
+        self._open_journal()
         self._server = await asyncio.start_server(
             self._on_connected, host="0.0.0.0", port=self._requested_port
         )
         self.port = self._server.sockets[0].getsockname()[1]
         logger.info("master listening on :%d", self.port)
         self._start_metrics_endpoint()
+        if self._expected_reattach:
+            # A restarted master with a replayed fleet: give masterless
+            # agents one reattach window before journal-vs-reality
+            # reconciliation declares the no-shows lost.
+            self._reconcile_task = asyncio.ensure_future(
+                self._reconcile_after_window())
+        kill = chaos().kill_master_after()
+        if kill is not None:
+            asyncio.ensure_future(self._kill_master_chaos(kill[0]))
+
+    @staticmethod
+    async def _kill_master_chaos(after_s: float) -> None:
+        """kill_master: SIGKILL this process after `after_s` — no cleanup,
+        no dying gasp, exactly the outage the journal's per-entry fsync
+        must survive. The flight recorder is dumped first: SIGKILL leaves
+        no other trace of the injection in the postmortem artifacts."""
+        import signal
+
+        await asyncio.sleep(after_s)
+        logger.warning("chaos: master SIGKILLing itself now")
+        metrics.flight_recorder().dump("chaos_kill_master")
+        logging.shutdown()
+        os.kill(os.getpid(), signal.SIGKILL)
+
+    def _open_journal(self) -> None:
+        """Boot against the durable journal when configured: replay the
+        snapshot + tail, burn a fresh epoch, rehydrate the policy plane's
+        adaptive state, and — when the journal shows a job mid-flight —
+        arm the reattach/reconcile machinery for the fleet it expects."""
+        state_dir = journal_mod.state_dir()
+        if not state_dir:
+            return
+        self.journal = journal_mod.MasterJournal(state_dir)
+        self.journal.open()
+        self.master_epoch = self.journal.epoch
+        self._m_epoch.set(self.master_epoch)
+        state = self.journal.state
+        restart = bool(state["agents"]) or state["job"] is not None
+        self.policy.restore_persisted(state)
+        if state["job"] is not None:
+            try:
+                self.job = OobleckArguments.from_dict(state["job"])
+            except Exception as e:  # noqa: BLE001 — a bad journaled job must
+                logger.error("journaled job unparseable (%s); dropped", e)
+                self.job = None  # not brick the restart
+        if self.job is not None:
+            self._expected_reattach = set(state["agents"])
+        if restart:
+            # The outage is itself an incident: one trace stitches the
+            # restart → replay → reattached → reconciled phase marks (the
+            # detect mark belongs to whoever killed us — SIGKILL leaves
+            # no dying gasp — so the trace opens at restart).
+            self._outage_trace_id = spans.new_trace_id()
+            t = time.time()
+            spans.span_recorder().record(
+                "outage.restart", t, t, trace_id=self._outage_trace_id,
+                epoch=self.master_epoch)
+            spans.span_recorder().record(
+                "outage.replay", t - (self.journal.last_replay_s or 0.0), t,
+                trace_id=self._outage_trace_id,
+                entries=self.journal.replayed_entries)
+            metrics.flight_recorder().record(
+                "master_restart", epoch=self.master_epoch,
+                trace_id=self._outage_trace_id,
+                expected_agents=sorted(self._expected_reattach),
+                replayed_entries=self.journal.replayed_entries,
+                replay_s=round(self.journal.last_replay_s or 0.0, 6))
+            logger.warning(
+                "master restarted at epoch %d: %d journal entries replayed, "
+                "expecting %d agents to reattach", self.master_epoch,
+                self.journal.replayed_entries, len(self._expected_reattach))
+
+    def _journal(self, kind: str, **fields) -> None:
+        if self.journal is not None:
+            self.journal.append(kind, **fields)
+            self._m_journal_lag.set(self.journal.entries_since_snapshot)
 
     def _start_metrics_endpoint(self) -> None:
         raw = os.environ.get(metrics.ENV_METRICS_PORT, "0")
@@ -260,6 +368,11 @@ class OobleckMasterDaemon:
         if self._http is not None:
             self._http.close()
             self._http = None
+        if self._reconcile_task is not None:
+            self._reconcile_task.cancel()
+            self._reconcile_task = None
+        if self.journal is not None:
+            self.journal.close()
 
     # ------------------------------------------------------------------ #
     # metrics plane (called from the HTTP server's daemon threads)
@@ -338,7 +451,26 @@ class OobleckMasterDaemon:
             # Bounded like the incident digest: quarantine set, per-host
             # MTBF estimates, and the last MAX_DECISIONS policy decisions.
             "policy": self.policy.status(),
+            "control_plane": self._control_plane_status(),
         }
+
+    def _control_plane_status(self) -> dict:
+        """Bounded control-plane block: the master's own survival state —
+        epoch, journal lag, replay cost, and how much of the fleet came
+        back after the last restart."""
+        block: dict = {
+            "master_epoch": self.master_epoch,
+            "journaling": self.journal is not None,
+            "reattached_agents": self._reattached_total,
+            "awaiting_reattach": sorted(self._expected_reattach),
+        }
+        if self.journal is not None:
+            j = self.journal.status()
+            block["journal_lag"] = j["journal_lag"]
+            block["last_replay_s"] = j["last_replay_s"]
+            block["replayed_entries"] = j["replayed_entries"]
+            block["open_incidents"] = j["open_incidents"]
+        return block
 
     # -- live signals for the policy scorer (worker-pushed metrics) ------ #
 
@@ -427,6 +559,8 @@ class OobleckMasterDaemon:
                 if not any(i.get("trace_id") == tid for i in self._incidents):
                     self._incidents.append(incident)
                     del self._incidents[:-MAX_INCIDENTS]
+        resolved: list[str] = []
+        with self._snap_lock:
             if role == "worker":
                 # A worker shipping fresh metrics after a broadcast means
                 # the pipeline is stepping again: close open recoveries.
@@ -434,6 +568,15 @@ class OobleckMasterDaemon:
                     if (r.get("resolved_at") is None
                             and r.get("broadcast_at") is not None):
                         r["resolved_at"] = time.time()
+                        if r.get("trace_id"):
+                            resolved.append(r["trace_id"])
+        for tid in resolved:
+            self._journal(journal_mod.EV_INCIDENT_CLOSE, trace_id=tid)
+        if resolved:
+            # Snapshot the policy EWMAs alongside the close: the adaptive
+            # state a restarted master scores its first decisions with.
+            self._journal(journal_mod.EV_EWMA,
+                          ewma=self.policy.ewma_snapshot())
 
     # ------------------------------------------------------------------ #
 
@@ -456,6 +599,8 @@ class OobleckMasterDaemon:
             await self._handle_register_agent(msg, reader, writer)
         elif kind == RequestType.JOIN.value:
             await self._handle_join(msg, reader, writer)
+        elif kind == RequestType.REATTACH.value:
+            await self._handle_reattach(msg, reader, writer)
         else:
             await send_response(writer, ResponseType.FAILURE,
                                 {"error": f"unexpected first message {kind}"})
@@ -484,6 +629,7 @@ class OobleckMasterDaemon:
             return
         self.job = args
         self._pending_ips = list(args.dist.node_ips)
+        self._journal(journal_mod.EV_JOB, args=args.to_dict())
         await send_response(writer, ResponseType.SUCCESS)
         if self.launcher is not None and hasattr(self.launcher, "start_job"):
             self.launcher.start_job(args)
@@ -524,6 +670,7 @@ class OobleckMasterDaemon:
         )
         self.agents[ip] = info
         self._m_registrations.inc()
+        self._journal(journal_mod.EV_REGISTER, ip=ip)
         if self.policy.health.consume_lift(ip):
             # A host whose flap quarantine lifted (hysteresis satisfied) is
             # re-registering: accepted like any other, but the handshake is
@@ -605,6 +752,7 @@ class OobleckMasterDaemon:
         )
         self.agents[ip] = info
         self._m_registrations.inc()
+        self._journal(journal_mod.EV_REGISTER, ip=ip)
         # Expected-lifetime hint for the policy's amortization horizon: the
         # joiner may advertise one (spot instances know their own market),
         # else a chaos spot_lifetime directive supplies it for drills.
@@ -677,6 +825,171 @@ class OobleckMasterDaemon:
         decision = self.decide_grow(joined, lifetime_hints=hints)
         await self._broadcast_grow(joined, decision,
                                    include=list(self.agents.values()))
+
+    async def _handle_reattach(self, msg, reader, writer) -> None:
+        """Post-outage re-attachment: an agent that rode out a master
+        outage in masterless mode re-dials the restarted master. Its
+        worker is ALIVE and mid-training — nothing is launched, nothing
+        respawns; the handshake only restores the liveness channel,
+        replays the agent's buffered masterless-era observations, and
+        marks the host present for the reconcile window. Quarantine does
+        NOT gate reattach: the host never left the job, and evicting a
+        healthy running worker over pre-outage flap history would turn
+        the master's own outage into a training incident."""
+        ip = msg.get("ip") or writer.get_extra_info("peername")[0]
+        if self.job is None:
+            await send_response(writer, ResponseType.FAILURE,
+                                {"error": "no job configured"})
+            writer.close()
+            return
+        last_epoch = int(msg.get("last_epoch") or 0)
+        if self.master_epoch and last_epoch > self.master_epoch:
+            # The agent has applied verbs from a HIGHER epoch than ours:
+            # we are the zombie (resurrected from an older journal or a
+            # partitioned copy). Refuse — the fence cuts both ways.
+            logger.error(
+                "agent %s reports epoch %d > ours %d; this master is "
+                "stale and must not drive the fleet", ip, last_epoch,
+                self.master_epoch)
+            metrics.flight_recorder().record(
+                "stale_master_refused", ip=ip, agent_epoch=last_epoch,
+                master_epoch=self.master_epoch)
+            await send_response(writer, ResponseType.FAILURE,
+                                {"error": "stale master epoch"})
+            writer.close()
+            return
+        interval = float(msg.get("ping_interval") or DEFAULT_PING_INTERVAL)
+        info = AgentInfo(
+            ip, reader, writer,
+            protocol=int(msg.get("protocol") or 1),
+            ping_interval=interval,
+            read_deadline=read_deadline(interval),
+        )
+        old = self.agents.get(ip)
+        if old is not None:
+            old.writer.close()  # superseded pre-outage connection
+        self.agents[ip] = info
+        self._m_reattaches.inc()
+        self._reattached.add(ip)
+        self._reattached_total += 1
+        self._journal(journal_mod.EV_REGISTER, ip=ip)
+        worker_alive = bool(msg.get("worker_alive", True))
+        metrics.flight_recorder().record(
+            "reattach", ip=ip, last_epoch=last_epoch,
+            epoch=self.master_epoch, worker_alive=worker_alive,
+            buffered=len(msg.get("buffered") or ()))
+        if self._outage_trace_id is not None:
+            t = time.time()
+            spans.span_recorder().record(
+                "outage.reattached", t, t, trace_id=self._outage_trace_id,
+                ip=ip, worker_alive=worker_alive)
+        logger.info("agent %s reattached (last_epoch=%d, worker_alive=%s)",
+                    ip, last_epoch, worker_alive)
+        self._replay_buffered(ip, msg.get("buffered"))
+        await send_response(
+            writer, ResponseType.SUCCESS,
+            {"args": self.job.to_dict(), EPOCH_KEY: self.master_epoch})
+        if self.coordinator is not None:
+            await send_response(writer, ResponseType.FORWARD_COORDINATOR,
+                                self._coordinator_payload())
+        try:
+            await self._agent_loop(info)
+        finally:
+            if self.agents.get(ip) is info:
+                await self._close_agent(ip)
+            else:
+                info.writer.close()
+
+    def _replay_buffered(self, ip: str, buffered) -> None:
+        """Fold an agent's masterless-era queue back into the planes that
+        missed it: worker-observed failures feed the MTBF/quarantine
+        estimator (and the journal), committed incident reports land in
+        the /status forensics ring. Bounded — the agent's queue already
+        is, but a hostile payload must not be."""
+        if not isinstance(buffered, list):
+            return
+        for ev in buffered[:64]:
+            if not isinstance(ev, dict):
+                continue
+            if ev.get("kind") == "failure" and ev.get("ip"):
+                cause = str(ev.get("cause") or "masterless")
+                self.policy.observe_failure(str(ev["ip"]), cause)
+                self._journal(journal_mod.EV_FAILURE, ip=str(ev["ip"]),
+                              cause=cause)
+                metrics.flight_recorder().record(
+                    "masterless_replay", ip=str(ev["ip"]), cause=cause,
+                    via=ip)
+            elif ev.get("kind") == "incident" \
+                    and isinstance(ev.get("report"), dict):
+                rep = ev["report"]
+                tid = rep.get("trace_id")
+                with self._snap_lock:
+                    if not any(i.get("trace_id") == tid
+                               for i in self._incidents):
+                        self._incidents.append(rep)
+                        del self._incidents[:-MAX_INCIDENTS]
+
+    def _reattach_window_s(self) -> float:
+        raw = os.environ.get(ENV_REATTACH_WINDOW, "")
+        try:
+            return float(raw) if raw else DEFAULT_REATTACH_WINDOW_S
+        except ValueError:
+            return DEFAULT_REATTACH_WINDOW_S
+
+    async def _reconcile_after_window(self) -> None:
+        """Close the post-restart reconciliation: journal-vs-reality.
+        Every host the replayed journal expected that neither REATTACHed
+        nor freshly registered inside the window died DURING the outage —
+        all of them become ONE batched loss incident (one trace, one
+        policy decision) through the normal recovery chain."""
+        await asyncio.sleep(self._reattach_window_s())
+        missing = sorted(ip for ip in self._expected_reattach
+                         if ip not in self.agents)
+        self._expected_reattach = set()
+        fr = metrics.flight_recorder()
+        fr.record("reattach_reconciled", epoch=self.master_epoch,
+                  reattached=sorted(self._reattached), missing=missing)
+        if self._outage_trace_id is not None:
+            t = time.time()
+            spans.span_recorder().record(
+                "outage.reconciled", t, t, trace_id=self._outage_trace_id,
+                reattached=len(self._reattached),
+                missing=",".join(missing))
+        if not missing:
+            logger.info("reconciled after restart: all %d agents "
+                        "reattached", len(self._reattached))
+            return
+        logger.warning("reconciled after restart: hosts %s died during "
+                       "the outage", missing)
+        trace_id = spans.new_trace_id()
+        detected_at = time.time()
+        for ip in missing:
+            self.policy.observe_failure(ip, "master_outage")
+            self._journal(journal_mod.EV_FAILURE, ip=ip,
+                          cause="master_outage")
+            self._journal(journal_mod.EV_DEPART, ip=ip)
+            with self._snap_lock:
+                self._recoveries.append({
+                    "lost_ip": ip, "cause": "master_outage",
+                    "trace_id": trace_id, "detected_at": detected_at,
+                    "broadcast_at": None, "resolved_at": None,
+                })
+        self._journal(journal_mod.EV_INCIDENT_OPEN, trace_id=trace_id,
+                      lost_ip=",".join(missing), cause="master_outage")
+        spans.span_recorder().record(
+            "incident.detect", detected_at, detected_at, trace_id=trace_id,
+            lost_ip=",".join(missing), cause="master_outage")
+        fr.record("detect", ip=",".join(missing), cause="master_outage",
+                  trace_id=trace_id)
+        fr.dump(f"failure_detected:{'+'.join(missing)}")
+        recovery.mark(recovery.DETECT, lost_ip=",".join(missing),
+                      cause="master_outage")
+        # ONE policy decision for the correlated batch; the per-ip
+        # broadcasts share it (agents prune membership one ip at a time).
+        decision = self.decide_recovery(missing)
+        for ip in missing:
+            await self._broadcast_recovery(
+                ip, decision, include=list(self.agents.values()))
 
     def _coordinator_payload(self) -> dict:
         """Coordinator relay payload; the generation tag is included only
@@ -768,7 +1081,13 @@ class OobleckMasterDaemon:
         # Feed the online MTBF/flap estimator — the failure log IS the
         # policy plane's churn signal.
         self.policy.observe_failure(lost_ip, cause)
+        self._journal(journal_mod.EV_FAILURE, ip=lost_ip, cause=cause)
+        if self.policy.is_quarantined(lost_ip):
+            self._journal(journal_mod.EV_QUARANTINE, ip=lost_ip,
+                          entered=True)
         trace_id = spans.new_trace_id()
+        self._journal(journal_mod.EV_INCIDENT_OPEN, trace_id=trace_id,
+                      lost_ip=lost_ip, cause=cause)
         with self._snap_lock:
             self._recoveries.append({
                 "lost_ip": lost_ip, "cause": cause, "trace_id": trace_id,
@@ -812,7 +1131,13 @@ class OobleckMasterDaemon:
         agent = self.agents.pop(ip, None)
         if agent is not None:
             agent.writer.close()
+            self._journal(journal_mod.EV_DEPART, ip=ip)
         if agent is not None and agent.clean_exit:
+            if not self.agents:
+                # The last agent's clean exit closes the job in the
+                # journal: a later master restart must not wait for a
+                # completed fleet to reattach.
+                self._journal(journal_mod.EV_JOB_DONE)
             return
         # Adaptive policy (oobleck_tpu/policy): score reroute /
         # reinstantiate / restore from live signals and broadcast the
@@ -855,6 +1180,12 @@ class OobleckMasterDaemon:
                             "cause": r.get("cause"),
                         }
         payload: dict = {"lost_ip": ip, DECISION_KEY: decision.as_payload()}
+        if self.master_epoch:
+            # Split-brain fence: agents reject verbs below their
+            # highest-applied epoch, so a zombie pre-restart master's
+            # broadcasts are refused fleet-wide. Epoch 0 (journaling off)
+            # stays unstamped — legacy untagged trust.
+            payload[EPOCH_KEY] = self.master_epoch
         if trace_ctx is not None:
             payload[spans.TRACE_KEY] = trace_ctx
             decision.trace_id = trace_ctx["trace_id"]
@@ -904,6 +1235,8 @@ class OobleckMasterDaemon:
                         }
         payload: dict = {"lost_ip": "", DECISION_KEY: decision.as_payload()}
         payload[JOINED_KEY] = list(joined_ips)
+        if self.master_epoch:
+            payload[EPOCH_KEY] = self.master_epoch
         if trace_ctx is not None:
             payload[spans.TRACE_KEY] = trace_ctx
             decision.trace_id = trace_ctx["trace_id"]

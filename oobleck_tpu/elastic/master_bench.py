@@ -1,0 +1,222 @@
+"""Control-plane outage microbench: master death, journal replay,
+REATTACH, and journal-vs-reality reconcile over real sockets.
+
+Two measured phases against a journaling master (fresh tmpdir state):
+
+  * restart_to_reconciled: kill the master mid-job (every agent
+    survives), restart it against the journal, and time restart ->
+    replay -> all REATTACHes -> reconcile-window close. The reattach
+    window is part of the number on purpose — it is the price the
+    config pays for tolerating stragglers.
+  * failure_during_outage: kill the master AND one agent, restart, and
+    time restart -> the recovery verb landing at the surviving agents —
+    the stale-membership case where only the journal knows the fleet
+    ever had that host. Scripted agents do not train, so verb receipt
+    (the moment a real worker would begin recovery) is the endpoint.
+
+The fleet is scripted agent CLIENTS (register/reattach/read-broadcasts
+over real TCP), not full OobleckAgents: no workers, no JAX — the
+numbers isolate the control plane. The in-process master "kill"
+emulates SIGKILL faithfully: journaling stops instantly, every agent
+transport is aborted (RST, no FIN), and nothing runs a dying gasp.
+
+Prints ONE JSON line (consumed by bench.py's "master" key and
+`make master-bench`).
+"""
+
+from __future__ import annotations
+
+import asyncio
+import json
+import os
+import statistics
+import tempfile
+import time
+
+from oobleck_tpu.config import OobleckArguments
+from oobleck_tpu.elastic import journal as journal_mod
+from oobleck_tpu.elastic import master as master_mod
+from oobleck_tpu.elastic.message import (
+    EPOCH_KEY,
+    PROTOCOL_VERSION,
+    RequestType,
+    ResponseType,
+    recv_msg,
+    send_request,
+)
+
+AGENTS = ("10.9.0.1", "10.9.0.2", "10.9.0.3")
+REATTACH_WINDOW_S = 0.5
+PHASE_TIMEOUT_S = 30.0
+
+
+class ScriptedAgent:
+    """A fleet member reduced to its control-plane behavior: register,
+    reattach after an outage, and collect broadcasts."""
+
+    def __init__(self, ip: str):
+        self.ip = ip
+        self.reader = None
+        self.writer = None
+        self.inbox: list[dict] = []
+        self.last_epoch = 0
+        self._drain: asyncio.Task | None = None
+
+    async def register(self, port: int) -> None:
+        self.reader, self.writer = await asyncio.open_connection(
+            "127.0.0.1", port)
+        await send_request(self.writer, RequestType.REGISTER_AGENT,
+                           {"ip": self.ip, "protocol": PROTOCOL_VERSION,
+                            "ping_interval": 10.0})
+        msg = await recv_msg(self.reader)
+        assert msg["kind"] == ResponseType.SUCCESS.value, msg
+        self._start_drain()
+
+    async def reattach(self, port: int) -> float:
+        """Redial + REATTACH; returns handshake seconds."""
+        if self._drain is not None:
+            self._drain.cancel()
+        t0 = time.monotonic()
+        self.reader, self.writer = await asyncio.open_connection(
+            "127.0.0.1", port)
+        await send_request(self.writer, RequestType.REATTACH,
+                           {"ip": self.ip, "protocol": PROTOCOL_VERSION,
+                            "ping_interval": 10.0,
+                            "last_epoch": self.last_epoch,
+                            "worker_alive": True, "buffered": []})
+        msg = await recv_msg(self.reader)
+        assert msg["kind"] == ResponseType.SUCCESS.value, msg
+        if msg.get(EPOCH_KEY) is not None:
+            self.last_epoch = int(msg[EPOCH_KEY])
+        self._start_drain()
+        return time.monotonic() - t0
+
+    def _start_drain(self) -> None:
+        async def _loop(reader):
+            try:
+                while True:
+                    self.inbox.append(await recv_msg(reader, timeout=None))
+            except (asyncio.IncompleteReadError, ConnectionError, OSError):
+                pass
+
+        self._drain = asyncio.ensure_future(_loop(self.reader))
+
+    async def wait_verb(self, verbs: set[str], timeout: float) -> dict:
+        deadline = time.monotonic() + timeout
+        while time.monotonic() < deadline:
+            for msg in self.inbox:
+                if msg.get("kind") in verbs:
+                    return msg
+            await asyncio.sleep(0.01)
+        raise TimeoutError(f"{self.ip}: no {verbs} broadcast in {timeout}s")
+
+    def close(self) -> None:
+        if self._drain is not None:
+            self._drain.cancel()
+        if self.writer is not None:
+            self.writer.close()
+
+
+def _hard_kill(m) -> None:
+    """Emulate SIGKILL on an in-process master: journaling stops NOW (a
+    dead master appends nothing), registrations vanish without close
+    handlers, and every agent transport is aborted (RST — the fleet sees
+    a dead connection, never a goodbye)."""
+    infos = list(m.agents.values())
+    m.agents.clear()       # _is_failure: loops exit without detection
+    m.journal = None       # no EV_DEPART dying gasp
+    for info in infos:
+        try:
+            info.writer.transport.abort()
+        except Exception:  # noqa: BLE001 — teardown best-effort
+            pass
+
+
+async def _start_master(port: int):
+    m = master_mod.OobleckMasterDaemon(port=port, launcher=None)
+    await m.start()
+    return m, asyncio.create_task(m.serve_forever())
+
+
+async def _bench() -> dict:
+    tmp = tempfile.mkdtemp(prefix="oobleck-master-bench-")
+    os.environ[journal_mod.ENV_STATE_DIR] = tmp
+    os.environ[master_mod.ENV_REATTACH_WINDOW] = str(REATTACH_WINDOW_S)
+
+    args = OobleckArguments()
+    args.dist.node_ips = list(AGENTS)
+
+    m1, t1 = await _start_master(0)
+    port = m1.port
+    r, w = await asyncio.open_connection("127.0.0.1", port)
+    await send_request(w, RequestType.LAUNCH_JOB, {"args": args.to_dict()})
+    assert (await recv_msg(r))["kind"] == ResponseType.SUCCESS.value
+    w.close()
+    fleet = [ScriptedAgent(ip) for ip in AGENTS]
+    for a in fleet:
+        await a.register(port)
+
+    # ---- phase 1: outage, full fleet survives ------------------------- #
+    _hard_kill(m1)
+    t1.cancel()
+    await m1.stop()
+    t_restart = time.monotonic()
+    m2, t2 = await _start_master(port)
+    replay_s = m2.journal.last_replay_s or 0.0
+    replayed = m2.journal.replayed_entries
+    reattach_lat = [await a.reattach(port) for a in fleet]
+    await asyncio.wait_for(m2._reconcile_task, timeout=PHASE_TIMEOUT_S)
+    restart_to_reconciled = time.monotonic() - t_restart
+    epoch_after_restart = m2.master_epoch
+    zero_lost = not any(
+        msg.get("lost_ip") for a in fleet for msg in a.inbox)
+
+    # ---- phase 2: one host dies DURING the outage --------------------- #
+    _hard_kill(m2)
+    t2.cancel()
+    await m2.stop()
+    fleet[2].close()  # the host the journal remembers but reality lost
+    t_restart2 = time.monotonic()
+    m3, t3 = await _start_master(port)
+    for a in fleet[:2]:
+        await a.reattach(port)
+    verbs = {ResponseType.RECONFIGURATION.value, ResponseType.DEGRADE.value,
+             ResponseType.RESTORE.value}
+    msg = await fleet[0].wait_verb(verbs, PHASE_TIMEOUT_S)
+    restart_to_recovery = time.monotonic() - t_restart2
+
+    summary = {
+        "agents": len(AGENTS),
+        "reattach_window_s": REATTACH_WINDOW_S,
+        "journal_replay_s": round(replay_s, 6),
+        "journal_replayed_entries": replayed,
+        "reattach_handshake_p50_s": round(
+            statistics.median(reattach_lat), 6),
+        "reattach_handshake_max_s": round(max(reattach_lat), 6),
+        "restart_to_reconciled_s": round(restart_to_reconciled, 6),
+        "clean_reattach_zero_recoveries": zero_lost,
+        "epoch_after_restart": epoch_after_restart,
+        "failure_during_outage": {
+            "lost_ip": msg.get("lost_ip"),
+            "recovery_verb": msg.get("kind"),
+            "restart_to_recovery_broadcast_s": round(
+                restart_to_recovery, 6),
+        },
+        "note": ("scripted agent clients over real TCP, no workers — "
+                 "control-plane latency only; the reattach window is "
+                 "included in restart_to_reconciled_s by design"),
+    }
+    _hard_kill(m3)
+    t3.cancel()
+    await m3.stop()
+    for a in fleet:
+        a.close()
+    return summary
+
+
+def main() -> None:
+    print(json.dumps(asyncio.run(_bench())))
+
+
+if __name__ == "__main__":
+    main()

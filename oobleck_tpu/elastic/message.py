@@ -78,6 +78,17 @@ class RequestType(str, Enum):
     # the joining agent falls back to plain REGISTER_AGENT (parked until
     # the next restart picks it up).
     JOIN = "join"
+    # Post-outage re-attachment ({"ip", "protocol", "ping_interval",
+    # "last_epoch", optional "worker_alive", "buffered" events}): an agent
+    # that survived a master outage in masterless mode re-dials the
+    # RESTARTED master and re-attaches — distinct from REGISTER_AGENT
+    # (first contact: the master launches workers and the agent brings one
+    # up) in that the agent's worker is ALIVE and must not be disturbed;
+    # the master reconciles the reattachment against its replayed journal.
+    # Masters that predate the verb answer FAILURE; the agent falls back
+    # to plain REGISTER_AGENT (which the old master treats as a fresh
+    # bring-up — slower, never wrong).
+    REATTACH = "reattach"
 
 
 class ResponseType(str, Enum):
@@ -116,6 +127,14 @@ class ResponseType(str, Enum):
 # named constant so oobleck-lint OBL004 can pin the master's broadcast
 # payloads to the core key set).
 JOINED_KEY = "joined_ips"
+
+# Broadcast-payload key carrying the master's monotonic epoch (split-brain
+# fence): every broadcast from an epoch-aware master is stamped with it,
+# and agents REJECT verbs whose epoch is lower than the highest they have
+# applied — a resurrected old master can never drive the fleet. Legacy
+# receivers ignore the key (untagged trust, the pre-fence behavior); a
+# named constant per the TRACE_KEY/DECISION_KEY legacy-tolerance pattern.
+EPOCH_KEY = "master_epoch"
 
 
 @dataclass

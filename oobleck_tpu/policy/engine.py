@@ -199,6 +199,34 @@ class PolicyEngine:
     def is_quarantined(self, ip: str) -> bool:
         return self.health.is_quarantined(ip)
 
+    # -- journal persistence ------------------------------------------------- #
+
+    def ewma_snapshot(self) -> dict[str, float]:
+        """The measured-latency EWMAs, for the master's durable journal —
+        the adaptive state a restarted master must not re-learn from
+        scratch (every decision before the first post-restart measurement
+        would otherwise score on cold priors)."""
+        return dict(self._ewma)
+
+    def restore_persisted(self, state: dict, *,
+                          wall_now: float | None = None) -> None:
+        """Rehydrate journal-persisted adaptive state after a master
+        restart: latency EWMAs verbatim, per-host failure logs and
+        quarantine entries via the health tracker's clock-domain
+        conversion. Decisions are NOT restored — a decision log from a
+        dead incarnation describes incidents that incarnation closed."""
+        for m, v in (state.get("ewma") or {}).items():
+            try:
+                self._ewma[str(m)] = float(v)
+            except (TypeError, ValueError):
+                continue
+        self.health.restore(
+            failures=state.get("failures") or {},
+            causes=state.get("causes") or {},
+            quarantined=state.get("quarantined") or {},
+            wall_now=wall_now,
+        )
+
     # -- the decision ------------------------------------------------------- #
 
     def decide(self, lost_ips: list[str], *,

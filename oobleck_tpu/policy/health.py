@@ -119,6 +119,39 @@ class HostHealthTracker:
         return sorted(ip for ip in list(self._quarantined_at)
                       if self.is_quarantined(ip))
 
+    # -- journal restore ----------------------------------------------------- #
+
+    def restore(self, *, failures: dict[str, list[float]],
+                causes: dict[str, str] | None = None,
+                quarantined: dict[str, float] | None = None,
+                wall_now: float | None = None) -> None:
+        """Rehydrate journaled state after a master restart.
+
+        Journal timestamps are wall-clock (monotonic clocks do not survive
+        a process restart); each is converted into this tracker's clock
+        domain by age — an event `wall_now - ts` seconds old lands
+        `clock() - age` on the injected clock, so MTBF intervals and the
+        quarantine hysteresis keep their real-world meaning across the
+        restart. Quarantined entries without a failure log are dropped
+        (is_quarantined reads the last failure to judge the lift)."""
+        if wall_now is None:
+            wall_now = time.time()
+        now_clock = self._clock()
+
+        def conv(ts: float) -> float:
+            return now_clock - max(wall_now - float(ts), 0.0)
+
+        self._failures = {
+            ip: sorted(conv(t) for t in log)[-MAX_EVENTS_PER_HOST:]
+            for ip, log in failures.items() if log
+        }
+        self._causes = dict(causes or {})
+        self._quarantined_at = {
+            ip: conv(t) for ip, t in (quarantined or {}).items()
+            if ip in self._failures
+        }
+        self._lifted = set()
+
     # -- /status ------------------------------------------------------------ #
 
     def snapshot(self) -> dict:

@@ -113,6 +113,11 @@ class SimCluster:
         self._makespan_cache: dict[tuple, float] = {}
         self._base_rate = self._rate()
         self._recovery_until = 0.0
+        # Control-plane outage window: while now < _master_down_until,
+        # losses are buffered (detection stalls, training does not) and
+        # decided as ONE reconcile incident when the master returns.
+        self._master_down_until = 0.0
+        self._outage_buffer: list = []
         # Piecewise-constant goodput integration state.
         self._demand = 1.0
         self._last_t = 0.0
@@ -388,6 +393,89 @@ class SimCluster:
             "pipelines": len(self.pipelines),
         })
 
+    # -- control-plane outage (master_outage scenario) ----------------------- #
+
+    def _buffer_outage(self, events: list) -> None:
+        """A failure landing while the master is down: the broken
+        replica stops delivering immediately (that is physics, not
+        policy), but detection and the recovery decision wait for the
+        restarted master's reconcile — nobody is watching."""
+        events = [e for e in events if e.host in self.live]
+        if not events:
+            return
+        self.live -= {e.host for e in events}
+        dead_idx = [i for i, p in enumerate(self.pipelines)
+                    if any(e.host in p.hosts for e in events)]
+        for i in reversed(dead_idx):
+            self.pipelines.pop(i)
+        self._outage_buffer.extend(events)
+
+    def _reconcile_outage(self) -> None:
+        """The restarted master's journal-vs-reality reconcile: every
+        host that died during the outage and is still gone is folded
+        into ONE batched incident through the REAL policy chain, with
+        cause=master_outage — mirroring the live master's
+        _reconcile_after_window (one decision for all no-shows; reroute
+        is never an arm, the moment for an in-place fix passed with the
+        outage). Hosts repaired inside the window are the sim analogue
+        of agents that reattached: not an incident at all."""
+        events = [e for e in self._outage_buffer if e.host not in self.live]
+        self._outage_buffer = []
+        if not events:
+            return
+        lost_ips = [self._ip(e.host) for e in events]
+        for ip in lost_ips:
+            self.engine.observe_failure(ip, cause="master_outage")
+        staleness_steps, stale_s = self._staleness()
+        survivor_frac = (len(self.live) / (len(self.live) + len(events))
+                         if self.live else 0.0)
+        decision = self.engine.decide(
+            lost_ips,
+            degrade_enabled=self.config.degrade_enabled,
+            reroute_retention=None,
+            reroute_feasible=False,
+            reroute_reason="stale_membership_after_master_outage",
+            survivor_frac=survivor_frac,
+            staleness_steps=staleness_steps,
+            step_seconds=self._step_seconds(),
+            proactive=False,
+            cause="master_outage")
+        rate_before = self._rate()
+        self._rebuild()
+        if decision.mechanism == "restore":
+            self.lost_work_s += stale_s
+        realized = (decision.arms[decision.mechanism]["latency_s"]
+                    * self.rng.uniform(JITTER_LO, JITTER_HI))
+        self.engine.observe_measured(decision.mechanism, realized)
+        self._recovery_until = max(self._recovery_until, self.now + realized)
+        self._push(self._recovery_until, "recovered", None)
+
+        reg = self.registry
+        reg.histogram(
+            "oobleck_sim_recovery_seconds",
+            "Simulated realized recovery latency by mechanism",
+        ).observe(realized, mechanism=decision.mechanism)
+        reg.counter(
+            "oobleck_sim_incidents_total",
+            "Simulated incidents by mechanism and cause",
+        ).inc(mechanism=decision.mechanism, cause="master_outage")
+        self.incidents.append({
+            "t": round(self.now, 6),
+            "lost_hosts": len(events),
+            "cause": "master_outage",
+            "correlated": len(events) > 1,
+            "proactive": False,
+            "mechanism": decision.mechanism,
+            "reason": decision.reason,
+            "projected_cost_s": round(decision.projected_cost_s, 6),
+            "realized_recovery_s": round(realized, 6),
+            "arms": decision.arms,
+            "rate_before": round(rate_before, 6),
+            "rate_after": round(self._rate(), 6),
+            "live_hosts": len(self.live),
+            "pipelines": len(self.pipelines),
+        })
+
     # -- the run ------------------------------------------------------------- #
 
     def _push(self, t: float, kind: str, payload) -> None:
@@ -423,7 +511,10 @@ class SimCluster:
                         if ev.host in self.live:
                             self._push(t + max(ev.repair_delay_s, 0.0),
                                        "repair", ev.host)
-                    self._handle_incident(batch)
+                    if t < self._master_down_until:
+                        self._buffer_outage(batch)
+                    else:
+                        self._handle_incident(batch)
                 elif payload.kind == "join":
                     # Same-instant arrivals sharing an incident_id are ONE
                     # grow incident — the live master's JOIN-window batch.
@@ -435,6 +526,15 @@ class SimCluster:
                            and self._heap[0][3].incident_id
                            == payload.incident_id):
                         batch.append(heapq.heappop(self._heap)[3])
+                    if t < self._master_down_until:
+                        # No master to JOIN: the arrival parks and
+                        # re-dials once the master is back (lifetime
+                        # clocks from admission, matching the live
+                        # master reading the hint at admit time).
+                        for ev in batch:
+                            self._push(self._master_down_until,
+                                       "scenario", ev)
+                        continue
                     for ev in batch:
                         if ev.repair_delay_s > 0:
                             # Spot lifetime: the host dies for good when
@@ -442,13 +542,26 @@ class SimCluster:
                             self._push(t + ev.repair_delay_s, "expire",
                                        ev.host)
                     self._handle_join(batch)
+                elif payload.kind == "master_down":
+                    # The control plane dies; training does not. Extend
+                    # (never shorten) on overlapping outages.
+                    up_at = t + max(payload.repair_delay_s, 0.0)
+                    if up_at > self._master_down_until:
+                        self._master_down_until = up_at
+                        self._push(up_at, "master_up", None)
+            elif kind == "master_up":
+                if t >= self._master_down_until:
+                    self._reconcile_outage()
             elif kind == "expire":
                 if payload in self.live:
                     from oobleck_tpu.sim.scenarios import ScenarioEvent
 
-                    self._handle_incident([ScenarioEvent(
-                        t=t, kind="fail", host=payload,
-                        cause="spot_lifetime")])
+                    ev = ScenarioEvent(t=t, kind="fail", host=payload,
+                                       cause="spot_lifetime")
+                    if t < self._master_down_until:
+                        self._buffer_outage([ev])
+                    else:
+                        self._handle_incident([ev])
             elif kind == "repair":
                 if payload not in self.live:
                     self.live.add(payload)

@@ -29,8 +29,11 @@ class ScenarioEvent:
     """One scripted occurrence. kind: "fail" (host dies; rejoins after
     repair_delay_s), "preempt" (spot notice: proactive drain, then the
     host dies), "join" (fresh capacity arrives mid-run; repair_delay_s
-    doubles as the advertised spot lifetime, 0 = on-demand), or
-    "traffic" (demand factor changes)."""
+    doubles as the advertised spot lifetime, 0 = on-demand), "traffic"
+    (demand factor changes), or "master_down" (the control plane itself
+    dies for repair_delay_s; the fleet keeps training masterless and
+    losses inside the window wait for the restarted master's
+    reconcile)."""
 
     t: float
     kind: str
@@ -198,8 +201,35 @@ def capacity_arrival(rng: random.Random, hosts: int, duration_s: float, *,
     return events
 
 
+def master_outage(rng: random.Random, hosts: int, duration_s: float, *,
+                  outages: int = 2, mean_outage_s: float = 45.0,
+                  min_outage_s: float = 5.0,
+                  mean_interarrival_s: float = 40.0,
+                  mean_repair_s: float = 120.0) -> list[ScenarioEvent]:
+    """Control-plane outages under background churn: the master is down
+    for a window while the fleet keeps training masterless. Host failures
+    landing INSIDE a window go undetected until the restarted master's
+    journal-vs-reality reconcile folds every no-show into ONE batched
+    incident (cause=master_outage) — the same deferred-detection shape
+    the live reconcile path produces. Arrivals inside a window park and
+    re-dial once the master is back."""
+    events = churn_storm(rng, hosts, duration_s,
+                         mean_interarrival_s=mean_interarrival_s,
+                         mean_repair_s=mean_repair_s)
+    incident = 2_000_000  # outage incident ids never collide with churn
+    for _ in range(outages):
+        start = round(rng.uniform(0.0, duration_s * 0.8), 6)
+        length = round(max(_exp(rng, mean_outage_s), min_outage_s), 6)
+        events.append(ScenarioEvent(
+            t=start, kind="master_down", incident_id=incident,
+            cause="master_outage", repair_delay_s=length))
+        incident += 1
+    return events
+
+
 GENERATORS = {
     "churn_storm": churn_storm,
+    "master_outage": master_outage,
     "capacity_arrival": capacity_arrival,
     "correlated_rack_loss": correlated_rack_loss,
     "spot_preemption_wave": spot_preemption_wave,

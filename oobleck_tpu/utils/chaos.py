@@ -64,6 +64,21 @@ directives; each directive is ``action=arg[:qual][@ip]``:
                                 grow arms, and the engine arms a deferred
                                 synthetic loss of that host 30 s after it
                                 is admitted — arrival followed by churn
+    kill_master=5               control-plane fault: the MASTER process
+                                SIGKILLs itself 5 s after startup — the
+                                outage the durable journal + agent
+                                masterless mode exist for. With a qual,
+                                ``kill_master=5:3`` advises the harness
+                                to restart the master 3 s after the kill
+                                (the master cannot restart itself; the
+                                bench/test harness reads the qual)
+    partition_master=10.0.0.1:8 network partition: agent 10.0.0.1 loses
+                                its master link for 8 s — the master
+                                stays up and evicts the host on heartbeat
+                                deadline, the agent rides it out
+                                masterless and REATTACHes when the
+                                partition heals (stale-membership
+                                reconcile, not a restart)
 
 Barriers are explicit calls (``chaos().barrier("step_end", ip=...)``)
 placed at recovery-relevant points: worker start, step start/end, and
@@ -92,7 +107,7 @@ ENV_VAR = "OOBLECK_CHAOS"
 _KNOWN_ACTIONS = ("delay_send", "drop_send", "stall_heartbeat", "kill_at",
                   "delay_at", "kill_stage", "flap_host", "kill_hosts",
                   "preempt_notice", "join_host", "join_hosts",
-                  "spot_lifetime")
+                  "spot_lifetime", "kill_master", "partition_master")
 
 
 @dataclass
@@ -171,6 +186,18 @@ def parse_spec(spec: str) -> list[Rule]:
             if float(rule.qual or 0) <= 0:
                 raise ValueError(
                     f"spot_lifetime needs positive seconds: {directive!r}")
+        elif action == "kill_master":
+            if float(rule.arg) <= 0:  # kill_master=<after_s>[:<restart_s>]
+                raise ValueError(
+                    f"kill_master needs positive seconds: {directive!r}")
+            float(rule.qual or 0)
+        elif action == "partition_master":
+            if not rule.arg:        # partition_master=<ip>:<secs>
+                raise ValueError(
+                    f"partition_master needs an agent ip: {directive!r}")
+            if float(rule.qual or 0) <= 0:
+                raise ValueError(
+                    f"partition_master needs positive seconds: {directive!r}")
         elif rule.qual is not None:
             int(rule.qual)
         rules.append(rule)
@@ -364,6 +391,51 @@ class Chaos:
         for r in self.rules:
             if r.action == "spot_lifetime" and r.arg == ip:
                 return float(r.qual or 0)
+        return None
+
+    # -- control-plane outage faults --------------------------------------- #
+
+    def kill_master_after(self) -> tuple[float, float | None] | None:
+        """One-shot (kill_after_s, restart_after_s|None) if a kill_master
+        rule is pending, else None. The MASTER reads this at startup and
+        schedules its own SIGKILL; restart_after_s is advisory — the
+        master cannot restart itself, so the bench/test harness reads the
+        same rule (non-consumed, different process) to time the restart.
+        Consuming within a process: a master only dies once."""
+        for r in self.rules:
+            if r.action != "kill_master":
+                continue
+            i = self.rules.index(r)
+            if self._counts.get(i, 0):
+                continue
+            self._counts[i] = 1
+            after = float(r.arg)
+            restart = float(r.qual) if r.qual else None
+            logger.warning(
+                "chaos: master will SIGKILL itself in %.2fs%s", after,
+                f" (harness restart advised after {restart:.2f}s)"
+                if restart is not None else "")
+            from oobleck_tpu.utils import metrics
+
+            metrics.flight_recorder().record(
+                "chaos_injection", action="kill_master",
+                after_seconds=after, restart_seconds=restart)
+            return after, restart
+        return None
+
+    def partition_master_secs(self, ip: str | None) -> float | None:
+        """One-shot partition length (seconds) for agent `ip`, or None when
+        no partition_master rule names it. The agent severs its master
+        link and suppresses redial for that long — the masterless-mode
+        fault where the master never died. Consuming."""
+        for r in self.rules:
+            if r.action != "partition_master" or r.arg != ip:
+                continue
+            i = self.rules.index(r)
+            if self._counts.get(i, 0):
+                continue
+            self._counts[i] = 1
+            return float(r.qual or 0)
         return None
 
     # -- named barriers ---------------------------------------------------- #

@@ -345,6 +345,66 @@ def test_obl004_broadcast_named_constant_ok(analyze):
     assert codes(analyze(files)) == []
 
 
+def _request_verb_files(master_refs: str) -> dict[str, str]:
+    files = _protocol_files(
+        agent_refs="return (ResponseType.SUCCESS, "
+                   "ResponseType.RECONFIGURATION)",
+        engine_strings="return kind == 'reconfigure'",
+        master=f"""\
+            from oobleck_tpu.elastic.message import RequestType
+
+            def _dispatch(kind):
+                return {master_refs}
+        """)
+    files["oobleck_tpu/elastic/message.py"] += (
+        "\n\nclass RequestType:\n"
+        "    REGISTER_AGENT = 'register_agent'\n"
+        "    REATTACH = 'reattach'\n")
+    return files
+
+
+def test_obl004_fires_on_request_verb_without_master_arm(analyze):
+    """An agent-originated verb (REATTACH) with no master dispatch arm is
+    a handshake that hangs forever — the lint forces the arm to exist."""
+    result = analyze(_request_verb_files(
+        "kind == RequestType.REGISTER_AGENT.value"))
+    assert codes(result) == ["OBL004"]
+    assert "REATTACH" in result.new[0].message
+
+
+def test_obl004_quiet_when_request_verbs_dispatched(analyze):
+    assert codes(analyze(_request_verb_files(
+        "kind in (RequestType.REGISTER_AGENT.value, "
+        "RequestType.REATTACH.value)"))) == []
+
+
+def test_obl004_epoch_stamp_must_ride_named_constant(analyze):
+    """Epoch fencing piggybacks on the broadcast-key contract: a raw
+    'master_epoch' literal in a broadcast payload fails the lint; the
+    EPOCH_KEY named constant passes (legacy receivers skip it knowingly)."""
+    base = dict(
+        agent_refs="return (ResponseType.SUCCESS, "
+                   "ResponseType.RECONFIGURATION)",
+        engine_strings="return kind == 'reconfigure'")
+    result = analyze(_protocol_files(master="""\
+        def _broadcast_recovery(ip, epoch):
+            payload = {"lost_ip": ip}
+            payload["master_epoch"] = epoch
+            return payload
+    """, **base))
+    assert codes(result) == ["OBL004"]
+    assert "named constant" in result.new[0].message
+
+    assert codes(analyze(_protocol_files(master="""\
+        EPOCH_KEY = "master_epoch"
+
+        def _broadcast_recovery(ip, epoch):
+            payload = {"lost_ip": ip}
+            payload[EPOCH_KEY] = epoch
+            return payload
+    """, **base))) == []
+
+
 # --------------------------------------------------------------------------
 # OBL005 — registry names (cross-file, needs obs/registry.py)
 

@@ -68,6 +68,18 @@ def test_parse_spec_grow_directives():
     ]
 
 
+def test_parse_spec_outage_directives():
+    """Control-plane outage grammar: kill_master's qual is the advisory
+    harness restart delay; partition_master names the partitioned AGENT
+    in the arg (the master is the other end by definition)."""
+    rules = parse_spec("kill_master=5:3, partition_master=10.0.0.1:8")
+    assert [(r.action, r.arg, r.qual, r.ip) for r in rules] == [
+        ("kill_master", "5", "3", None),
+        ("partition_master", "10.0.0.1", "8", None),
+    ]
+    assert parse_spec("kill_master=2")[0].qual is None
+
+
 @pytest.mark.parametrize("bad", [
     "explode=now",            # unknown action
     "delay_send",             # no '='
@@ -87,6 +99,12 @@ def test_parse_spec_grow_directives():
     "join_hosts=10.0.0.5++10.0.0.6",  # empty segment
     "spot_lifetime=:30",      # no host ip
     "spot_lifetime=10.0.0.5:0",       # non-positive lifetime
+    "kill_master=0",          # non-positive kill delay
+    "kill_master=soon",       # non-numeric kill delay
+    "kill_master=5:late",     # non-numeric restart delay
+    "partition_master=:8",    # no agent ip
+    "partition_master=10.0.0.1",      # no partition length
+    "partition_master=10.0.0.1:0",    # non-positive length
 ])
 def test_parse_spec_rejects_typos_eagerly(bad):
     # A typo'd injection spec must fail the run at parse time, not
@@ -154,6 +172,26 @@ def test_join_targets_delay_merge_and_one_shot():
     assert c.join_targets() is None                      # consumed
     assert c.join_targets() == ["10.0.0.8"]              # poll 4
     assert c.join_targets() is None
+
+
+def test_outage_directive_semantics():
+    """kill_master_after is one-shot per process (a master only dies once)
+    and carries the advisory restart delay; partition_master_secs is
+    one-shot per victim and None for every other agent."""
+    from oobleck_tpu.utils import metrics
+
+    c = Chaos("kill_master=5:3,partition_master=10.0.0.1:8")
+    assert c.kill_master_after() == (5.0, 3.0)
+    assert c.kill_master_after() is None                    # consumed
+    assert c.partition_master_secs("10.0.0.9") is None      # wrong victim
+    assert c.partition_master_secs("10.0.0.1") == pytest.approx(8.0)
+    assert c.partition_master_secs("10.0.0.1") is None      # consumed
+    injected = {e.get("action")
+                for e in metrics.flight_recorder().events()
+                if e["event"] == "chaos_injection"}
+    assert "kill_master" in injected
+    # the restart qual is optional — absent means harness never restarts
+    assert Chaos("kill_master=2").kill_master_after() == (2.0, None)
 
 
 def test_spot_lifetime_is_non_consuming():

@@ -1,0 +1,160 @@
+"""Durable control-plane journal (elastic/journal.py): WAL round trip,
+epoch burn, torn-tail recovery, snapshot compaction, and the policy-plane
+rehydration path (engine.restore_persisted + health.restore's wall-clock
+to tracker-clock conversion)."""
+
+import json
+
+import pytest
+
+from oobleck_tpu.elastic import journal as journal_mod
+from oobleck_tpu.elastic.journal import (
+    EV_DEPART,
+    EV_EWMA,
+    EV_FAILURE,
+    EV_INCIDENT_CLOSE,
+    EV_INCIDENT_OPEN,
+    EV_JOB,
+    EV_JOB_DONE,
+    EV_QUARANTINE,
+    EV_REGISTER,
+    JOURNAL_FILE,
+    SNAPSHOT_FILE,
+    MasterJournal,
+)
+from oobleck_tpu.policy.engine import PolicyEngine
+from oobleck_tpu.policy.health import HostHealthTracker
+
+
+def reopened(tmp_path):
+    j = MasterJournal(tmp_path)
+    j.open()
+    return j
+
+
+def test_wal_round_trip(tmp_path):
+    j = reopened(tmp_path)
+    j.append(EV_JOB, args={"model": "m"})
+    j.append(EV_REGISTER, ip="10.0.0.1")
+    j.append(EV_REGISTER, ip="10.0.0.2")
+    j.append(EV_DEPART, ip="10.0.0.2")
+    j.append(EV_FAILURE, ip="10.0.0.3", cause="disconnect")
+    j.append(EV_QUARANTINE, ip="10.0.0.3", entered=True)
+    j.append(EV_EWMA, ewma={"reroute": 1.5})
+    j.append(EV_INCIDENT_OPEN, trace_id="t1", lost_ip="10.0.0.3",
+             cause="disconnect")
+    j.close()
+
+    j2 = reopened(tmp_path)
+    s = j2.state
+    assert sorted(s["agents"]) == ["10.0.0.1"]
+    assert len(s["failures"]["10.0.0.3"]) == 1
+    assert s["causes"]["10.0.0.3"] == "disconnect"
+    assert "10.0.0.3" in s["quarantined"]
+    assert s["ewma"] == {"reroute": 1.5}
+    assert list(s["open_incidents"]) == ["t1"]
+    assert s["job"] == {"model": "m"}
+    assert j2.replayed_entries == 8
+
+
+def test_incident_close_and_job_done_fold(tmp_path):
+    j = reopened(tmp_path)
+    j.append(EV_JOB, args={"model": "m"})
+    j.append(EV_INCIDENT_OPEN, trace_id="t1", lost_ip="a")
+    j.append(EV_INCIDENT_CLOSE, trace_id="t1")
+    j.append(EV_JOB_DONE)
+    j.close()
+    j2 = reopened(tmp_path)
+    assert j2.state["open_incidents"] == {}
+    assert j2.state["job"] is None
+
+
+def test_epoch_burn_is_persisted_before_any_append(tmp_path):
+    """Every open() burns an epoch — even an incarnation that crashes
+    before journaling anything. Two sequential opens can never stamp the
+    same epoch (the split-brain fence's ground truth)."""
+    assert reopened(tmp_path).epoch == 1
+    # No append, no close — the "crashed immediately" incarnation.
+    assert reopened(tmp_path).epoch == 2
+    snap = json.loads((tmp_path / SNAPSHOT_FILE).read_text())
+    assert snap["epoch"] == 2
+
+
+def test_torn_tail_dropped_intact_prefix_kept(tmp_path):
+    """A crash mid-append leaves a torn final line; replay must keep every
+    intact entry before it and drop only the tear."""
+    j = reopened(tmp_path)
+    j.append(EV_REGISTER, ip="10.0.0.1")
+    j.append(EV_REGISTER, ip="10.0.0.2")
+    j.close()
+    with open(tmp_path / JOURNAL_FILE, "ab") as f:
+        f.write(b'{"kind": "register", "ip": "10.0.0.3", "ts"')  # torn
+    j2 = reopened(tmp_path)
+    assert sorted(j2.state["agents"]) == ["10.0.0.1", "10.0.0.2"]
+    assert j2.replayed_entries == 2
+
+
+def test_compaction_truncates_and_preserves_state(tmp_path, monkeypatch):
+    monkeypatch.setenv(journal_mod.ENV_SNAPSHOT_EVERY, "3")
+    j = reopened(tmp_path)
+    for i in range(7):
+        j.append(EV_REGISTER, ip=f"10.0.0.{i}")
+    # 7 appends with snapshot_every=3: two compactions, 1 entry in tail.
+    assert j.entries_since_snapshot == 1
+    tail = (tmp_path / JOURNAL_FILE).read_bytes().splitlines()
+    assert len(tail) == 1
+    j.close()
+    j2 = reopened(tmp_path)
+    assert len(j2.state["agents"]) == 7
+
+
+def test_unreadable_snapshot_starts_fresh(tmp_path):
+    (tmp_path / SNAPSHOT_FILE).write_text("not json{")
+    j = reopened(tmp_path)
+    assert j.state["agents"] == {}
+    assert j.epoch == 1  # fresh lineage
+
+
+def test_status_is_bounded_and_plain(tmp_path):
+    j = reopened(tmp_path)
+    j.append(EV_INCIDENT_OPEN, trace_id="t1", lost_ip="a")
+    st = j.status()
+    assert st["epoch"] == 1
+    assert st["journal_lag"] == 1
+    assert st["open_incidents"] == 1
+    assert st["replayed_entries"] == 0
+    json.dumps(st)  # /status must serialize
+
+
+def test_health_restore_converts_wall_clock_to_tracker_clock():
+    """Journal timestamps are wall-clock; the tracker runs on an injected
+    (often monotonic) clock. restore() must convert by AGE so MTBF
+    intervals keep their real-world meaning across the restart."""
+    now = {"t": 1000.0}
+    tracker = HostHealthTracker(clock=lambda: now["t"])
+    wall_now = 5_000_000.0
+    tracker.restore(
+        failures={"10.0.0.1": [wall_now - 120.0, wall_now - 60.0]},
+        causes={"10.0.0.1": "churn"},
+        quarantined={"10.0.0.1": wall_now - 60.0},
+        wall_now=wall_now)
+    assert tracker.mtbf("10.0.0.1") == pytest.approx(60.0)
+    assert tracker.is_quarantined("10.0.0.1")
+    # Hysteresis still lifts after 2x the window of quiet — on the
+    # tracker's own clock.
+    now["t"] += 121.0
+    assert not tracker.is_quarantined("10.0.0.1")
+
+
+def test_engine_restore_persisted_rehydrates_ewma_and_health():
+    engine = PolicyEngine(multihost=True)
+    wall_now = 7_000_000.0
+    engine.restore_persisted({
+        "ewma": {"reroute": 2.5, "bogus": "nan-ish"},
+        "failures": {"10.0.0.9": [wall_now - 10.0, wall_now - 5.0]},
+        "causes": {"10.0.0.9": "flap"},
+        "quarantined": {"10.0.0.9": wall_now - 5.0},
+    }, wall_now=wall_now)
+    assert engine.ewma_snapshot().get("reroute") == pytest.approx(2.5)
+    assert "bogus" not in engine.ewma_snapshot()
+    assert engine.is_quarantined("10.0.0.9")

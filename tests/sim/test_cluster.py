@@ -123,6 +123,58 @@ def test_traffic_swing_scales_demand():
     assert run["goodput_ratio"] > 0.9
 
 
+def test_failure_during_master_outage_reconciles_at_master_up():
+    # Master down t=100..150; host 1 dies at t=110 with nobody watching.
+    # The decision is deferred to reconcile (t=150) and reroute is never
+    # an arm — the moment for an in-place fix passed with the outage.
+    sc = _scenario([
+        ScenarioEvent(t=100.0, kind="master_down", incident_id=2_000_000,
+                      cause="master_outage", repair_delay_s=50.0),
+        ScenarioEvent(t=110.0, kind="fail", host=1, incident_id=0,
+                      cause="test", repair_delay_s=1000.0),
+    ])
+    run = SimCluster(SimConfig(hosts=4), sc).run()
+    assert len(run["incidents"]) == 1
+    inc = run["incidents"][0]
+    assert inc["t"] == pytest.approx(150.0)
+    assert inc["cause"] == "master_outage"
+    assert inc["mechanism"] != "reroute"
+    assert inc["arms"]["reroute"]["feasible"] is False
+    assert run["final"]["live_hosts"] == 3
+
+
+def test_host_repaired_inside_outage_window_is_not_an_incident():
+    # The sim analogue of an agent that reattached: dead at t=110,
+    # repaired at t=130 — gone again by reconcile time? No: back in the
+    # live set, so the restarted master finds nothing missing.
+    sc = _scenario([
+        ScenarioEvent(t=100.0, kind="master_down", incident_id=2_000_000,
+                      cause="master_outage", repair_delay_s=50.0),
+        ScenarioEvent(t=110.0, kind="fail", host=1, incident_id=0,
+                      cause="test", repair_delay_s=20.0),
+    ])
+    run = SimCluster(SimConfig(hosts=4), sc).run()
+    assert run["incidents"] == []
+    assert run["final"]["live_hosts"] == 4
+
+
+def test_correlated_losses_during_outage_fold_into_one_incident():
+    sc = _scenario([
+        ScenarioEvent(t=100.0, kind="master_down", incident_id=2_000_000,
+                      cause="master_outage", repair_delay_s=50.0),
+        ScenarioEvent(t=110.0, kind="fail", host=1, incident_id=0,
+                      cause="test", repair_delay_s=1000.0),
+        ScenarioEvent(t=125.0, kind="fail", host=2, incident_id=1,
+                      cause="test", repair_delay_s=1000.0),
+    ])
+    run = SimCluster(SimConfig(hosts=4), sc).run()
+    assert len(run["incidents"]) == 1
+    inc = run["incidents"][0]
+    assert inc["lost_hosts"] == 2
+    assert inc["correlated"] is True
+    assert inc["cause"] == "master_outage"
+
+
 def test_join_runs_real_grow_decide_chain():
     # One on-demand arrival mid-run: a grow-direction incident decided by
     # the REAL PolicyEngine.decide_grow, with all three arms costed.

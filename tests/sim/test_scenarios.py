@@ -55,6 +55,20 @@ def test_preemption_is_proactive_kind():
     assert all(e.kind == "preempt" for e in sc.events)
 
 
+def test_master_outage_mixes_outages_into_churn():
+    sc = make_scenario("master_outage", seed=11, hosts=32,
+                       duration_s=600.0)
+    downs = [e for e in sc.events if e.kind == "master_down"]
+    assert downs, "no master_down windows generated"
+    for e in downs:
+        assert e.cause == "master_outage"
+        assert e.repair_delay_s > 0  # the outage length
+        assert 0.0 <= e.t < 600.0
+    # The outages ride a normal churn background — the interesting case
+    # is a failure landing INSIDE a window, which needs both present.
+    assert any(e.kind in ("fail", "preempt") for e in sc.events)
+
+
 def test_capacity_arrival_structure():
     sc = make_scenario("capacity_arrival", seed=9, hosts=16,
                        duration_s=600.0)
