@@ -2,7 +2,7 @@
 
 PY ?= python
 
-.PHONY: lint analyze gen-registry test test-slow tier1 bench bench-diff trace-report ckpt-bench serve-bench pipeline-bench degrade-bench policy-bench sim-bench grow-bench overlap-bench master-bench
+.PHONY: lint analyze gen-registry test test-slow tier1 bench bench-diff trace-report ckpt-bench serve-bench pipeline-bench degrade-bench policy-bench sim-bench grow-bench overlap-bench master-bench goodput-bench
 
 # Lint = the project-native analyzer (always available, stdlib-only)
 # plus ruff (config in pyproject.toml). Ruff degrades to a skip when not
@@ -119,6 +119,15 @@ grow-bench:
 	JAX_PLATFORMS=cpu OOBLECK_METRICS_DIR= \
 		XLA_FLAGS="--xla_force_host_platform_device_count=8" \
 		$(PY) -m oobleck_tpu.policy.grow_bench
+
+# Fleet-health/goodput plane: straggler scenario through the real
+# detector + policy chain (goodput fraction, detect-to-drain latency)
+# plus the telemetry ring's and goodput ledger's per-step overhead vs a
+# pessimistic 1 ms synthetic step — the < 1% hot-path bar (also under
+# bench.py's "goodput" key, diffed by bench --diff). Jax-free, CPU-only.
+goodput-bench:
+	JAX_PLATFORMS=cpu OOBLECK_METRICS_DIR= \
+		$(PY) -m oobleck_tpu.obs.goodput_bench
 
 # Control-plane outage: journaling master killed mid-job, restarted
 # against its journal — restart-to-reconciled latency (replay + every

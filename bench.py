@@ -744,6 +744,44 @@ def _master_summary() -> dict:
         return {"error": f"unparseable master bench output: {exc}"}
 
 
+GOODPUT_BENCH_TIMEOUT_S = 120
+
+
+def _goodput_summary() -> dict:
+    """Fleet-health/goodput microbench (oobleck_tpu/obs/goodput_bench.py)
+    in a throwaway CPU subprocess: the straggler scenario through the
+    real detector + policy chain (goodput fraction, detect-to-drain
+    latency) plus the telemetry ring's and goodput ledger's per-step
+    overhead against a pessimistic 1 ms synthetic step — the < 1%
+    hot-path acceptance bar. Jax-free, seeded, bounded."""
+    env = dict(os.environ)
+    env.update({"JAX_PLATFORMS": "cpu", "PALLAS_AXON_POOL_IPS": "",
+                "OOBLECK_METRICS_DIR": ""})
+    env.pop(_INNER_ENV, None)
+    env.pop(_PIPELINE_ENV, None)
+    # The bench pins its own straggler thresholds inside the simulator; an
+    # ambient operator tuning must not skew the tracked numbers.
+    for knob in ("OOBLECK_STRAGGLER_RATIO", "OOBLECK_STRAGGLER_Z",
+                 "OOBLECK_STRAGGLER_PERSIST", "OOBLECK_TELEMETRY"):
+        env.pop(knob, None)
+    proc = subprocess.Popen(
+        [sys.executable, "-m", "oobleck_tpu.obs.goodput_bench"],
+        stdout=subprocess.PIPE, stderr=subprocess.PIPE, text=True, env=env)
+    try:
+        out, err = proc.communicate(timeout=GOODPUT_BENCH_TIMEOUT_S)
+    except subprocess.TimeoutExpired:
+        proc.kill()
+        return {"error": f"goodput bench hung >{GOODPUT_BENCH_TIMEOUT_S}s"}
+    if proc.returncode != 0:
+        tail = (err or "").strip().splitlines()[-1:] or ["no stderr"]
+        return {"error":
+                f"goodput bench exit {proc.returncode}: {tail[0][:160]}"}
+    try:
+        return json.loads(out.strip().splitlines()[-1])
+    except Exception as exc:  # noqa: BLE001
+        return {"error": f"unparseable goodput bench output: {exc}"}
+
+
 def _analysis_summary() -> dict:
     """One oobleck-lint run over the tree: rule inventory plus finding
     counts, so the bench line records the static-analysis posture the
@@ -831,6 +869,13 @@ def _emit(result: dict) -> None:
         result["master"] = _master_summary()
     except Exception as exc:  # noqa: BLE001 — emit must never fail
         result["master"] = {"error": f"{type(exc).__name__}: {exc}"}
+    # Fleet-health/goodput plane (straggler handling quality + telemetry
+    # and ledger per-step overhead): CPU subprocess, jax-free, bounded,
+    # best-effort — see _goodput_summary.
+    try:
+        result["goodput"] = _goodput_summary()
+    except Exception as exc:  # noqa: BLE001 — emit must never fail
+        result["goodput"] = {"error": f"{type(exc).__name__}: {exc}"}
     # Static-analysis posture (oobleck_tpu/analysis): in-process, cheap.
     # `findings` counts NEW findings — anything nonzero means the tree
     # regressed against the lint gate, so the diff treats it lower-is-
@@ -881,8 +926,8 @@ _HIGHER_BETTER = ("per_sec", "per_second", "speedup", "retention",
                   "hit_rate", "hidden_fraction")
 _LOWER_BETTER = ("latency", "seconds", "ttft", "pause", "bubble", "stall",
                  "p50", "p90", "p99", "findings", "parse_errors", "regret",
-                 "bytes_per_token", "abs_diff")
-_LOWER_BETTER_SUFFIXES = ("_s", "_ms")
+                 "bytes_per_token", "abs_diff", "overhead")
+_LOWER_BETTER_SUFFIXES = ("_s", "_ms", "_us")
 
 
 def _round_files() -> list[str]:

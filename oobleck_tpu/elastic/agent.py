@@ -35,6 +35,7 @@ from oobleck_tpu.elastic.message import (
     EPOCH_KEY,
     JOINED_KEY,
     PROTOCOL_VERSION,
+    TELEMETRY_KEY,
     RequestType,
     ResponseType,
     recv_msg,
@@ -122,6 +123,10 @@ class OobleckAgent:
         # Highest master epoch this agent has applied a verb from: the
         # split-brain fence floor. 0 = no epoch seen (legacy trust).
         self._last_epoch = 0
+        # Latest telemetry digest observed in a worker metrics snapshot
+        # (obs/telemetry.py); epoch-stamped onto every heartbeat so the
+        # master's fleet-health plane gets per-host samples for free.
+        self._telemetry_digest: dict | None = None
         # Worker-observed failures / committed incidents that could not be
         # pushed while masterless; bounded, replayed on REATTACH.
         self._buffer: collections.deque = collections.deque(
@@ -814,7 +819,16 @@ class OobleckAgent:
             try:
                 async with self._send_lock:
                     self._ping_sent_at = time.monotonic()
-                    await send_request(self._writer, RequestType.PING)
+                    payload: dict = {"ip": self.agent_ip}
+                    if self._telemetry_digest is not None:
+                        # Piggybacked fleet-health digest: legacy masters
+                        # ignore the key; the epoch stamp lets a restarted
+                        # master drop samples from a dead incarnation.
+                        payload[TELEMETRY_KEY] = dict(
+                            self._telemetry_digest,
+                            epoch=self._last_epoch)
+                    await send_request(self._writer, RequestType.PING,
+                                       payload)
                 # Piggyback this agent's registry snapshot on the heartbeat
                 # cadence — one extra fire-and-forget frame per interval.
                 await self._push_metrics("agent",
@@ -853,8 +867,12 @@ class OobleckAgent:
                     if msg.get("kind") == "metrics":
                         # Relay the worker's registry snapshot upward so the
                         # master's /metrics covers training-quality gauges.
-                        await self._push_metrics(
-                            "worker", msg.get("snapshot") or {})
+                        snap = msg.get("snapshot") or {}
+                        if isinstance(snap.get("telemetry"), dict):
+                            # Keep only the newest digest; the ping loop
+                            # stamps it onto each heartbeat.
+                            self._telemetry_digest = snap["telemetry"]
+                        await self._push_metrics("worker", snap)
                     elif msg.get("kind") == "degrade_fallback":
                         # The engine judged the in-place multihost reroute
                         # infeasible after all — pay for the respawn.

@@ -42,6 +42,7 @@ from oobleck_tpu.elastic.message import (
     DEFAULT_PING_INTERVAL,
     EPOCH_KEY,
     JOINED_KEY,
+    TELEMETRY_KEY,
     DistributionInfo,
     RequestType,
     ResponseType,
@@ -49,10 +50,13 @@ from oobleck_tpu.elastic.message import (
     recv_msg,
     send_response,
 )
+from oobleck_tpu.obs import fleet as obs_fleet
 from oobleck_tpu.obs import spans
+from oobleck_tpu.obs import telemetry as obs_telemetry
 from oobleck_tpu.policy import PolicyEngine
-from oobleck_tpu.policy.engine import DECISION_KEY, MECH_REINSTANTIATE, \
-    MECH_REROUTE, MECH_RESTORE
+from oobleck_tpu.policy.engine import DECISION_KEY, MECH_DRAIN, \
+    MECH_OBSERVE, MECH_QUARANTINE, MECH_REINSTANTIATE, MECH_REROUTE, \
+    MECH_RESTORE
 from oobleck_tpu.utils import metrics, recovery
 from oobleck_tpu.utils.chaos import chaos
 
@@ -208,6 +212,11 @@ class OobleckMasterDaemon:
         # restore per incident from live signals (oobleck_tpu/policy).
         self.policy = PolicyEngine(
             multihost=os.environ.get("OOBLECK_MULTIHOST") == "1")
+        # Fleet-health plane (obs/fleet.py): per-host telemetry rows fed
+        # by heartbeat digests; a persistently slow-but-alive host raises
+        # a SLOWDOWN incident through the same classify -> policy chain
+        # failures use.
+        self.fleet = obs_fleet.FleetTracker()
         # Durable control-plane journal (OOBLECK_MASTER_STATE_DIR): the
         # master's own survival plane. None = journaling off (the pre-PR
         # in-memory-only behavior); epoch 0 means "no fence" to agents.
@@ -245,6 +254,10 @@ class OobleckMasterDaemon:
         self._m_journal_lag = reg.gauge(
             "oobleck_master_journal_lag_entries",
             "Journal entries appended since the last snapshot compaction")
+        self._m_slowdowns = reg.counter(
+            "oobleck_master_slowdown_incidents_total",
+            "SLOWDOWN incidents raised for gray-failing (alive but "
+            "persistently slow) hosts")
 
     # ------------------------------------------------------------------ #
 
@@ -437,6 +450,19 @@ class OobleckMasterDaemon:
                         v = int(s.get("value", -1))
                         if last_durable is None or v > last_durable:
                             last_durable = v
+        # Fleet health: the tracker's per-host z/ratio rows plus the
+        # goodput ledger view from the most-advanced worker snapshot and
+        # the cluster's best MFU estimate.
+        goodput = None
+        best_step = -1
+        for snap in worker_snaps.values():
+            g = snap.get("goodput")
+            if isinstance(g, dict) and snap.get("step", 0) >= best_step:
+                best_step = snap.get("step", 0)
+                goodput = g
+        fleet_health = dict(self.fleet.snapshot())
+        fleet_health["goodput"] = goodput
+        fleet_health["mfu"] = self._worker_gauge_max("oobleck_engine_mfu")
         return {
             "job": self.job.model.model_name if self.job else None,
             "agents": agents,
@@ -448,6 +474,7 @@ class OobleckMasterDaemon:
                 r for r in recoveries if r.get("resolved_at") is None
             ],
             "incidents": incidents,
+            "fleet_health": fleet_health,
             # Bounded like the incident digest: quarantine set, per-host
             # MTBF estimates, and the last MAX_DECISIONS policy decisions.
             "policy": self.policy.status(),
@@ -671,6 +698,9 @@ class OobleckMasterDaemon:
         self.agents[ip] = info
         self._m_registrations.inc()
         self._journal(journal_mod.EV_REGISTER, ip=ip)
+        # A re-registering host starts a fresh fleet-health life: stale
+        # rows (and latched straggler flags) must not follow it in.
+        self.fleet.clear(ip)
         if self.policy.health.consume_lift(ip):
             # A host whose flap quarantine lifted (hysteresis satisfied) is
             # re-registering: accepted like any other, but the handshake is
@@ -753,6 +783,7 @@ class OobleckMasterDaemon:
         self.agents[ip] = info
         self._m_registrations.inc()
         self._journal(journal_mod.EV_REGISTER, ip=ip)
+        self.fleet.clear(ip)
         # Expected-lifetime hint for the policy's amortization horizon: the
         # joiner may advertise one (spot instances know their own market),
         # else a chaos spot_lifetime directive supplies it for drills.
@@ -1031,6 +1062,18 @@ class OobleckMasterDaemon:
             kind = msg.get("kind")
             if kind == RequestType.PING.value:
                 metrics.flight_recorder().record("heartbeat", ip=agent.ip)
+                d = msg.get(TELEMETRY_KEY)
+                if obs_telemetry.digest_ok(d):
+                    # Piggybacked fleet-health digest (legacy agents send
+                    # none — they simply contribute no row). The epoch
+                    # stamp fences out samples describing a dead master
+                    # incarnation's steps.
+                    self.fleet.ingest(
+                        agent.ip, d, epoch=d.get("epoch"),
+                        min_epoch=self.master_epoch or None)
+                    slow_ip = self.fleet.consume_straggler()
+                    if slow_ip is not None:
+                        await self._on_slowdown_detected(slow_ip)
                 await send_response(agent.writer, ResponseType.PONG)
             elif kind == RequestType.METRICS.value:
                 # Fire-and-forget: no response, never back-pressures pings.
@@ -1081,6 +1124,8 @@ class OobleckMasterDaemon:
         # Feed the online MTBF/flap estimator — the failure log IS the
         # policy plane's churn signal.
         self.policy.observe_failure(lost_ip, cause)
+        # Its fleet-health row describes a host that no longer exists.
+        self.fleet.clear(lost_ip)
         self._journal(journal_mod.EV_FAILURE, ip=lost_ip, cause=cause)
         if self.policy.is_quarantined(lost_ip):
             self._journal(journal_mod.EV_QUARANTINE, ip=lost_ip,
@@ -1101,6 +1146,62 @@ class OobleckMasterDaemon:
         fr = metrics.flight_recorder()
         fr.record("detect", ip=lost_ip, cause=cause, trace_id=trace_id)
         fr.dump(f"failure_detected:{lost_ip}")
+
+    async def _on_slowdown_detected(self, ip: str) -> None:
+        """Gray failure: the fleet tracker flagged `ip` as alive but
+        persistently slow. Open a SLOWDOWN incident through the same
+        classify -> policy chain real failures use — the host is NOT dead,
+        so there is no observe_failure/EV_FAILURE, but the incident gets a
+        trace_id, a /status recovery entry, and a scored decision. An
+        active arm (drain / quarantine) reuses the preemption machinery:
+        broadcast to everyone INCLUDING the victim, whose worker flushes a
+        checkpoint and exits cleanly (JOB_DONE, zero respawns)."""
+        self._m_slowdowns.inc()
+        ratio = self.fleet.ratio(ip) or self.fleet.ratio_threshold
+        trace_id = spans.new_trace_id()
+        self._journal(journal_mod.EV_INCIDENT_OPEN, trace_id=trace_id,
+                      lost_ip=ip, cause="slowdown")
+        detected_at = time.time()
+        entry = {
+            "lost_ip": ip, "cause": "slowdown", "trace_id": trace_id,
+            "detected_at": detected_at, "broadcast_at": None,
+            "resolved_at": None, "slowdown_ratio": ratio,
+        }
+        with self._snap_lock:
+            self._recoveries.append(entry)
+        spans.span_recorder().record(
+            "incident.detect", detected_at, detected_at, trace_id=trace_id,
+            lost_ip=ip, cause="slowdown", ratio=ratio)
+        fr = metrics.flight_recorder()
+        fr.record("slowdown_detected", ip=ip, ratio=ratio,
+                  trace_id=trace_id)
+        fr.dump(f"slowdown_detected:{ip}")
+        n = len(self.agents)
+        decision = self.policy.decide_slowdown(
+            ip, slowdown_ratio=ratio,
+            survivor_frac=(n - 1) / n if n else 1.0)
+        logger.warning(
+            "slowdown incident for %s (ratio %.2f): %s (%s)", ip, ratio,
+            decision.mechanism, decision.reason)
+        if decision.mechanism == MECH_OBSERVE:
+            # Passive arm: keep the host, keep watching. The incident
+            # closes immediately — nothing was broadcast, so the usual
+            # first-worker-snapshot close would never fire.
+            with self._snap_lock:
+                entry["mechanism"] = MECH_OBSERVE
+                entry["resolved_at"] = detected_at
+            self._journal(journal_mod.EV_INCIDENT_CLOSE, trace_id=trace_id)
+            return
+        victim = self.agents.get(ip)
+        if victim is not None:
+            # The drained worker's departure is a clean JOB_DONE exit,
+            # not a second incident.
+            victim.clean_exit = True
+        await self._broadcast_recovery(ip, decision,
+                                       include=list(self.agents.values()))
+        # The drained host's telemetry row describes a life that just
+        # ended; its next registration starts a fresh one.
+        self.fleet.clear(ip)
 
     async def _handle_preemption(self, agent: AgentInfo, msg: dict) -> None:
         """Spot-preemption advance notice: the host will die in ~deadline_s.
@@ -1152,6 +1253,11 @@ class OobleckMasterDaemon:
             MECH_REROUTE: ResponseType.DEGRADE,
             MECH_REINSTANTIATE: ResponseType.RECONFIGURATION,
             MECH_RESTORE: ResponseType.RESTORE,
+            # Slowdown arms ride the DEGRADE verb: survivors take the
+            # in-place reroute path, the victim (included in the
+            # broadcast, preemption-style) drains and exits cleanly.
+            MECH_DRAIN: ResponseType.DEGRADE,
+            MECH_QUARANTINE: ResponseType.DEGRADE,
         }[mechanism]
 
     async def _broadcast_recovery(self, ip: str, decision,

@@ -128,6 +128,16 @@ class ResponseType(str, Enum):
 # payloads to the core key set).
 JOINED_KEY = "joined_ips"
 
+# PING-payload key carrying the agent's compact telemetry digest
+# (obs/telemetry.py): the digest piggybacks on the heartbeat the agent
+# already sends, so fleet-health telemetry costs zero extra messages.
+# Legacy masters ignore the key; new masters tolerate its absence (a v1
+# agent simply contributes no fleet-health row) — the TRACE_KEY/
+# DECISION_KEY legacy-tolerance pattern. The digest is epoch-stamped
+# ("epoch" inside the digest dict) so a master restarted under the
+# split-brain fence can drop samples describing a dead incarnation.
+TELEMETRY_KEY = "telemetry"
+
 # Broadcast-payload key carrying the master's monotonic epoch (split-brain
 # fence): every broadcast from an epoch-aware master is stamped with it,
 # and agents REJECT verbs whose epoch is lower than the highest they have

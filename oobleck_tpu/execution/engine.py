@@ -58,8 +58,10 @@ from oobleck_tpu.execution.reconfigure import (
     reconfigure_hosts,
 )
 from oobleck_tpu.models import build_model
+from oobleck_tpu.obs import goodput as obs_goodput
 from oobleck_tpu.obs import incident as obs_incident
 from oobleck_tpu.obs import spans as obs_spans
+from oobleck_tpu.obs import telemetry as obs_telemetry
 from oobleck_tpu.parallel.train import make_optimizer
 from oobleck_tpu.planning.instantiator import HeterogeneousPlan, PipelineInstantiator
 from oobleck_tpu.planning.profiler import load_profile, profile
@@ -725,6 +727,19 @@ class OobleckEngine:
         # EWMA of wall seconds per step: the policy scorer's unit for
         # converting checkpoint staleness into lost work.
         self._step_s_ewma: float | None = None
+        # Fleet-health planes (obs/telemetry.py, obs/goodput.py): one
+        # per-step host sample into the process-global ring (the digest
+        # rides the agent's heartbeats), and the wall-clock ledger this
+        # worker's time is partitioned into. Live-bytes is static leaf
+        # metadata cached per plan adoption — summing nbytes every step
+        # is wasted host work; ckpt stalls are consumed by cursor so
+        # each flush is telemetered exactly once.
+        self._ledger = obs_goodput.GoodputLedger()
+        self._live_bytes = 0
+        self._live_bytes_stale = True
+        self._ckpt_stall_seen = 0
+        self._data_wait_s = 0.0
+        self._last_mfu: float | None = None
 
         # Training-quality metrics (utils/metrics.py): per-step gauges the
         # master aggregates cluster-wide via the METRICS push.
@@ -765,6 +780,10 @@ class OobleckEngine:
         self._m_template = reg.gauge(
             "oobleck_engine_pipeline_template_info",
             "Current pipeline layout (labels); value = step when adopted")
+        self._m_goodput = reg.gauge(
+            "oobleck_goodput_fraction",
+            "Fraction of this worker's wall-clock spent in productive "
+            "training steps (obs/goodput.py ledger)")
         # (flops_per_token, peak_flops_per_chip|None, n_chips), resolved
         # lazily on the first step; None when the model defies estimation.
         self._flops_cache: Any = _UNSET
@@ -1558,6 +1577,7 @@ class OobleckEngine:
         if isinstance(dl, DeviceStager):
             batch, placed = dl.next_placed()
             self._m_input_wait.observe(dl.last_wait_s)
+            self._data_wait_s += dl.last_wait_s
             return batch, placed
         return dl.next_batch(), None
 
@@ -1667,6 +1687,9 @@ class OobleckEngine:
         elif self.fused is not None:
             self._m_template.set(
                 self.step, path="fused", hosts=str(len(self.host_ips)))
+        # Plan adoption changed what lives on-device: refresh the
+        # live-bytes telemetry estimate at the next step sample.
+        self._live_bytes_stale = True
 
     def _flops_info(self):
         """(flops_per_token, peak_flops_per_chip|None, n_chips) for the MFU
@@ -1763,11 +1786,65 @@ class OobleckEngine:
             self._m_tokens_per_sec.set(tps)
             info = self._flops_info()
             if info is not None:
+                from oobleck_tpu.parallel.train import mfu_estimate
+
                 fpt, peak, n_chips = info
-                if peak and n_chips:
-                    self._m_mfu.set(fpt * tps / n_chips / peak)
-        for kind, frac in self._bubble_fractions(step_s).items():
+                mfu = mfu_estimate(tps, fpt, n_chips, peak)
+                if mfu is not None:
+                    self._m_mfu.set(mfu)
+                    self._last_mfu = mfu
+        fracs = self._bubble_fractions(step_s)
+        for kind, frac in fracs.items():
             self._m_bubble.set(frac, kind=kind)
+        self._record_telemetry(step_s, fracs.get("measured", 0.0))
+
+    def _record_telemetry(self, step_s: float,
+                          bubble_frac: float) -> None:
+        """Feed the fleet-health planes one step's worth of wall-clock:
+        a per-host sample into the telemetry ring (the compact digest
+        rides the agent's next heartbeat to the master's FleetTracker)
+        and the matching split into the goodput ledger. Everything here
+        is host arithmetic over already-host values — no device syncs
+        (obs/telemetry.py is under the OBL002 fence)."""
+        if self._live_bytes_stale:
+            self._live_bytes_stale = False
+            self._live_bytes = self._estimate_live_bytes()
+        compute_s = comm_s = 0.0
+        for pipe in self.pipelines:
+            c, m = pipe.op_time_split()
+            compute_s += c
+            comm_s += m
+        # Checkpoint flushes land outside step_s (step-boundary stalls),
+        # so they are a separate ledger bucket, not a step subdivision.
+        ckpt_s = sum(self.ckpt_stall_s[self._ckpt_stall_seen:])
+        self._ckpt_stall_seen = len(self.ckpt_stall_s)
+        obs_telemetry.telemetry().record_step(
+            self.step, step_s, compute_s=compute_s, comm_s=comm_s,
+            data_wait_s=self._data_wait_s, ckpt_s=ckpt_s,
+            live_bytes=self._live_bytes)
+        self._ledger.account_step(step_s, bubble_frac=bubble_frac,
+                                  data_wait_s=self._data_wait_s)
+        if ckpt_s > 0:
+            self._ledger.account("checkpoint", ckpt_s)
+        self._m_goodput.set(self._ledger.goodput_fraction())
+
+    def _estimate_live_bytes(self) -> int:
+        """Σ nbytes over this process's live params + optimizer leaves.
+        Array.nbytes is shape/dtype metadata, not a device readback."""
+        try:
+            if self.fused is not None:
+                st = self.fused.state
+                leaves = (jax.tree.leaves(st.params)
+                          + jax.tree.leaves(st.opt_state))
+            else:
+                leaves = []
+                for pipe in self.pipelines:
+                    leaves += jax.tree.leaves(pipe.params)
+                    leaves += jax.tree.leaves(
+                        self.opt_states.get(pipe.pipeline_id, {}))
+            return sum(int(getattr(x, "nbytes", 0)) for x in leaves)
+        except Exception:  # mid-reconfigure topology: skip this sample
+            return 0
 
     def _drain_pending_losses(self, max_steps: int | None = None) -> None:
         """Resolve every deferred loss (one readback per step, but off the
@@ -1811,6 +1888,15 @@ class OobleckEngine:
         obs_spans.span_recorder().record(
             "incident.first_step", t, t, trace_id=inc.trace_id,
             step=self.step)
+        # Goodput attribution: the detect -> first_step window is wall-
+        # clock this worker did not train. Charge it to the incident's
+        # trace so the ledger, /status, and the committed record all
+        # agree on what the incident cost.
+        lost_s = inc.phase_breakdown().get("total_s", 0.0)
+        if lost_s > 0:
+            self._ledger.attribute(inc.trace_id, lost_s,
+                                   cause=inc.cause or "")
+        inc.goodput_cost = self._ledger.incident_cost(inc.trace_id)
         path = inc.commit()
         digest = {"trace_id": inc.trace_id, "lost_ip": inc.lost_ip,
                   "cause": inc.cause, "marks": dict(inc.marks),
@@ -1841,6 +1927,13 @@ class OobleckEngine:
         master's /metrics) and append it to the JSONL sink."""
         snap = metrics.registry().snapshot()
         snap["step"] = self.step
+        d = obs_telemetry.telemetry().digest()
+        if d is not None:
+            # The agent keeps the latest digest and epoch-stamps it onto
+            # every heartbeat (TELEMETRY_KEY) — fleet health costs zero
+            # extra control-plane messages.
+            snap["telemetry"] = d
+        snap["goodput"] = self._ledger.snapshot(mfu=self._last_mfu)
         if self._incident_record is not None:
             # One-shot piggyback, consumed only once the relay succeeds:
             # the master dedups by trace_id, so resending after a pipe
@@ -1904,10 +1997,19 @@ class OobleckEngine:
                 # not lock contention (the wait is flight-recorded
                 # separately as background_work_wait).
                 self._wait_staged_inputs()
+                self._data_wait_s = 0.0
                 with background.device_work("train_step"):
                     t0 = time.perf_counter()
                     loss = self._train_step()
                     step_s = time.perf_counter() - t0
+                factor = chaos().slow_factor(self.agent_ip)
+                if factor is not None:
+                    # Gray-failure injection: stretch this host's step by
+                    # sleeping host-side (no device sync involved), so the
+                    # telemetry sample reports the same wall time a
+                    # genuinely degraded host would.
+                    time.sleep((factor - 1.0) * step_s)
+                    step_s *= factor
                 self._step_s_ewma = (
                     step_s if self._step_s_ewma is None
                     else 0.8 * self._step_s_ewma + 0.2 * step_s)

@@ -522,6 +522,23 @@ class PipelineInstance:
     def owns_layer(self, layer_idx: int) -> bool:
         return layer_idx in self.params
 
+    def op_time_split(self) -> tuple[float, float]:
+        """(compute_s, comm_s) of the last step from ``last_op_times``:
+        compute is the summed "f"/"b" durations (recorded every step —
+        async enqueue times in normal mode, true durations under
+        ``sync_op_timing``); comm is the summed "cf"/"cb" transfer
+        durations, which only exist under sync_op_timing — in async mode
+        the split degrades honestly to (dispatch-observed compute, 0)
+        rather than fabricating a comm estimate. Feeds the per-step
+        telemetry sample (obs/telemetry.py)."""
+        compute = comm = 0.0
+        for (_stage, _chunk, kind), (total, _n) in self.last_op_times.items():
+            if kind in ("f", "b"):
+                compute += total
+            elif kind in ("cf", "cb"):
+                comm += total
+        return compute, comm
+
     # ------------------------------------------------------------------ #
 
     def _stage_apply(self, st: StageRuntime, layers: tuple[int, ...]):

@@ -1,0 +1,154 @@
+"""Attributed goodput ledger: where every second of wall-clock went.
+
+Oobleck's pitch is throughput *under* failures, so the honest scoreboard
+is not tokens/sec in a quiet window — it is the fraction of total
+wall-clock that produced training progress, with every lost second
+attributed to a bucket and (when one caused it) an incident id:
+
+    step        productive compute inside training steps
+    bubble      pipeline-schedule bubbles inside those steps
+    data_wait   input pipeline stalls (host-side staging waits)
+    checkpoint  synchronous checkpoint flush time
+    recovery    reconfigure/restore windows (attributed to incidents)
+    masterless  control-plane outage riding (agent-reported)
+    other       wall-clock the buckets above do not explain (startup,
+                shutdown, anything unattributed — reported, never hidden)
+
+The ledger is fed exclusively with host-side floats the engine already
+measured (step wall time, bubble fraction, ``dl.last_wait_s``, the
+checkpoint plane's stall return, recovery phase totals) — it performs no
+measurement of its own and no host syncs. ``goodput_fraction`` =
+step / wall; the MFU estimate rides next to it from the planner's FLOPs
+model (parallel/train.py) so "as fast as the hardware allows" is one
+measured, attributed number.
+
+Incident attribution: ``attribute(trace_id, seconds, bucket)`` charges
+lost time to the incident that caused it. ``incident_cost(trace_id)``
+returns the charge — the ``goodput_cost`` section the PR-8 incident
+files carry.
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+
+BUCKETS = ("step", "bubble", "data_wait", "checkpoint", "recovery",
+           "masterless", "other")
+
+
+class GoodputLedger:
+    """Wall-clock partition + per-incident attribution for one worker.
+
+    Thread-safe: the train loop accounts steps while the checkpoint/
+    recovery paths attribute from other call sites."""
+
+    def __init__(self, clock=time.monotonic):
+        self._clock = clock
+        self._lock = threading.Lock()
+        self._started_at = clock()
+        self._buckets = dict.fromkeys(BUCKETS, 0.0)
+        self._incidents: dict[str, dict] = {}
+        self._steps = 0
+
+    # -- feeds -------------------------------------------------------------- #
+
+    def account_step(self, step_s: float, *, bubble_frac: float = 0.0,
+                     data_wait_s: float = 0.0) -> None:
+        """One training step: ``step_s`` of wall-clock, of which
+        ``bubble_frac`` was pipeline bubble; ``data_wait_s`` is the input
+        stall paid before the step (outside ``step_s``)."""
+        frac = min(max(bubble_frac, 0.0), 1.0)
+        with self._lock:
+            self._steps += 1
+            self._buckets["step"] += step_s * (1.0 - frac)
+            self._buckets["bubble"] += step_s * frac
+            if data_wait_s > 0:
+                self._buckets["data_wait"] += data_wait_s
+
+    def account(self, bucket: str, seconds: float) -> None:
+        """Charge unattributed seconds to a named bucket."""
+        if bucket not in BUCKETS:
+            raise ValueError(f"unknown goodput bucket {bucket!r}: "
+                             f"want one of {BUCKETS}")
+        if seconds <= 0:
+            return
+        with self._lock:
+            self._buckets[bucket] += seconds
+
+    def attribute(self, trace_id: str, seconds: float, *,
+                  bucket: str = "recovery", cause: str = "") -> None:
+        """Charge ``seconds`` to ``bucket`` AND to the incident that
+        caused them, so the incident file and /status agree on what the
+        failure cost."""
+        if bucket not in BUCKETS:
+            raise ValueError(f"unknown goodput bucket {bucket!r}: "
+                             f"want one of {BUCKETS}")
+        if seconds <= 0 or not trace_id:
+            return
+        with self._lock:
+            self._buckets[bucket] += seconds
+            inc = self._incidents.setdefault(
+                trace_id, {"lost_s": 0.0, "buckets": {}, "cause": cause})
+            inc["lost_s"] += seconds
+            inc["buckets"][bucket] = inc["buckets"].get(bucket, 0.0) \
+                + seconds
+            if cause:
+                inc["cause"] = cause
+
+    # -- reads -------------------------------------------------------------- #
+
+    def wall_s(self) -> float:
+        return max(self._clock() - self._started_at, 0.0)
+
+    def goodput_fraction(self) -> float:
+        """Productive-step seconds over total wall-clock (0 before the
+        first step)."""
+        wall = self.wall_s()
+        if wall <= 0:
+            return 0.0
+        with self._lock:
+            return min(self._buckets["step"] / wall, 1.0)
+
+    def incident_cost(self, trace_id: str) -> dict | None:
+        """The ``goodput_cost`` section for one incident file, or None
+        when nothing was attributed to that trace."""
+        with self._lock:
+            inc = self._incidents.get(trace_id)
+            if inc is None:
+                return None
+            return {
+                "lost_s": round(inc["lost_s"], 6),
+                "buckets": {b: round(v, 6)
+                            for b, v in inc["buckets"].items()},
+                "cause": inc["cause"],
+            }
+
+    def snapshot(self, *, mfu: float | None = None) -> dict:
+        """The ledger view that ships in the worker's metrics snapshot
+        and lands in master /status.fleet_health. ``other`` is computed
+        here as the unexplained remainder, so the buckets always sum to
+        the wall-clock they claim to partition."""
+        wall = self.wall_s()
+        with self._lock:
+            buckets = dict(self._buckets)
+            explained = sum(buckets.values()) - buckets["other"]
+            buckets["other"] = round(max(wall - explained, 0.0), 6)
+            out = {
+                "wall_s": round(wall, 6),
+                "steps": self._steps,
+                "buckets": {b: round(v, 6) for b, v in buckets.items()},
+                "goodput_fraction": round(
+                    min(buckets["step"] / wall, 1.0) if wall > 0 else 0.0,
+                    6),
+                "incidents": {
+                    t: {"lost_s": round(i["lost_s"], 6),
+                        "buckets": {b: round(v, 6)
+                                    for b, v in i["buckets"].items()},
+                        "cause": i["cause"]}
+                    for t, i in self._incidents.items()
+                },
+            }
+        if mfu is not None:
+            out["mfu"] = round(mfu, 6)
+        return out

@@ -67,6 +67,10 @@ class IncidentBuilder:
         self.cause = cause
         self.attrs = dict(attrs)
         self.marks: dict[str, float] = {}
+        # Goodput attribution (obs/goodput.py ``incident_cost``): what
+        # this incident cost in attributed wall-clock — a first-class
+        # section of the committed record, set just before commit.
+        self.goodput_cost: dict | None = None
 
     def mark(self, name: str, t: float | None = None) -> float:
         t = time.time() if t is None else float(t)
@@ -119,6 +123,8 @@ class IncidentBuilder:
             "flight": flight,
             "metrics": frozen,
         }
+        if self.goodput_cost:
+            rec["goodput_cost"] = self.goodput_cost
         if self.attrs:
             rec["attrs"] = self.attrs
         return rec

@@ -155,6 +155,22 @@ def peak_flops(device_kind: str) -> float | None:
     return None
 
 
+def mfu_estimate(tokens_per_sec: float, flops_per_token: float,
+                 n_chips: int, peak_flops_per_chip: float | None
+                 ) -> float | None:
+    """Model FLOPs utilization from the planner's FLOPs model: achieved
+    training FLOP/s over the fleet's peak. One definition shared by the
+    engine's per-step gauge, the goodput ledger, and bench.py — so the
+    MFU in /status.fleet_health and the MFU in a bench record can never
+    be computed two different ways. None when peak is unknown (CPU) or
+    the inputs are degenerate."""
+    if (peak_flops_per_chip is None or peak_flops_per_chip <= 0
+            or n_chips <= 0 or tokens_per_sec <= 0):
+        return None
+    return (flops_per_token * tokens_per_sec) / (
+        n_chips * peak_flops_per_chip)
+
+
 def _overlap_loss_and_grads(model, mesh, specs, ctx: ShardCtx, cfg,
                             *, num_mb: int, remat: bool):
     """Overlap-mode core: ONE check_rep=False shard_map over every mesh axis

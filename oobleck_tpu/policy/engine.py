@@ -27,6 +27,7 @@ from oobleck_tpu.policy.scorer import cheapest_feasible, score_arms
 from oobleck_tpu.policy.signals import (
     build_arms,
     build_grow_arms,
+    build_slowdown_arms,
     priors_provenance,
 )
 from oobleck_tpu.utils import metrics
@@ -42,14 +43,19 @@ MECH_RESTORE = "restore"
 MECH_ABSORB = "absorb_spare"
 MECH_GROW_DP = "grow_dp"
 MECH_GROW_RESHAPE = "grow_reshape"
+# Slowdown-direction arms (SLOWDOWN incidents — gray failures, PR 17).
+MECH_OBSERVE = "observe"
+MECH_DRAIN = "drain"
+MECH_QUARANTINE = "quarantine"
 MODE_ADAPTIVE = "adaptive"
 GROW_MODES = (MECH_ABSORB, MECH_GROW_DP, MECH_GROW_RESHAPE)
+SLOWDOWN_MODES = (MECH_OBSERVE, MECH_DRAIN, MECH_QUARANTINE)
 # A forced mode only pins decisions in ITS direction: OOBLECK_POLICY=
 # grow_reshape forces grow incidents but leaves loss incidents adaptive
 # (and vice versa) — a cross-direction forced arm is not an error, it is
 # simply out of scope for that incident.
 MODES = (MODE_ADAPTIVE, MECH_REROUTE, MECH_REINSTANTIATE,
-         MECH_RESTORE) + GROW_MODES
+         MECH_RESTORE) + GROW_MODES + SLOWDOWN_MODES
 
 # Payload key the recovery broadcast carries the decision under (legacy
 # receivers ignore unknown keys, like spans.TRACE_KEY).
@@ -384,6 +390,86 @@ class PolicyEngine:
         logger.info(
             "policy: %s for join of %s (reason=%s cost=%.3fs lifetime=%s)",
             decision.mechanism, joined_ips, reason, chosen.cost_s,
+            f"{mtbf_s:.1f}s" if mtbf_s is not None else "n/a")
+        self._decisions.append(decision)
+        decision.record()
+        return decision
+
+    def decide_slowdown(self, slow_ip: str, *,
+                        slowdown_ratio: float,
+                        survivor_frac: float = 1.0,
+                        cause: str = "slowdown") -> PolicyDecision:
+        """Score the SLOWDOWN arms for one gray-failure incident and pick.
+
+        ``slowdown_ratio`` is the straggler's step time over the fleet
+        median (the fleet tracker's judgment); ``survivor_frac`` what the
+        fleet keeps after draining the host. The risk horizon is the SICK
+        host's own MTBF when it has one — a host that has been failing is
+        priced as about to fail again, which is what drains it before it
+        dies. The chosen drain/quarantine decision is marked proactive +
+        inplace: the victim's worker is still ALIVE and flushes a clean
+        checkpoint on the way out (the preemption-notice drain path),
+        while multihost survivors reroute in place with zero respawns."""
+        with spans.span("policy.decide_slowdown", lost_ips=slow_ip,
+                        cause=cause) as ctx:
+            host_mtbf = self.health.mtbf(slow_ip)
+            arms = build_slowdown_arms(
+                slowdown_ratio=slowdown_ratio,
+                survivor_frac=survivor_frac,
+                host_mtbf_s=host_mtbf,
+                host_failures=self.health.failure_count(slow_ip),
+                latency_overrides=self._ewma,
+                registry=self._registry,
+                priors_path=self._priors_path,
+            )
+            mtbf_s = host_mtbf if host_mtbf is not None \
+                else self.health.fleet_mtbf()
+            scored = score_arms(arms, mtbf_s=mtbf_s)
+
+            # A forced loss/grow arm is out of scope for a slowdown (see
+            # MODES); an infeasible forced slowdown arm falls back to
+            # observe — the direction's always-available mechanism.
+            forced = self.mode if self.mode in scored else MODE_ADAPTIVE
+            if forced != MODE_ADAPTIVE:
+                if scored[forced].feasible:
+                    chosen, reason = scored[forced], f"forced:{forced}"
+                else:
+                    chosen = scored[MECH_OBSERVE]
+                    reason = (f"forced:{forced}:infeasible:"
+                              f"{scored[forced].reason}")
+            else:
+                chosen = cheapest_feasible(scored)
+                reason = "cheapest"
+                if chosen is None:  # cannot happen: observe is
+                    chosen = scored[MECH_OBSERVE]  # always feasible
+                    reason = "fallback"
+
+            if chosen.mechanism == MECH_QUARANTINE:
+                self.health.quarantine(slow_ip, cause=cause)
+
+            active = chosen.mechanism in (MECH_DRAIN, MECH_QUARANTINE)
+            decision = PolicyDecision(
+                mechanism=chosen.mechanism,
+                lost_ips=[slow_ip],
+                reason=reason,
+                projected_cost_s=chosen.cost_s,
+                costs={m: a.cost_s for m, a in scored.items()},
+                infeasible={m: a.reason for m, a in scored.items()
+                            if not a.feasible},
+                arms={m: dict(arms[m].as_record(),
+                              **scored[m].as_record())
+                      for m in arms},
+                mtbf_s=mtbf_s,
+                quarantined=self.health.quarantined(),
+                proactive=active,
+                inplace=active and self.multihost,
+                trace_id=ctx["trace_id"],
+            )
+        logger.info(
+            "policy: %s for slowdown of %s (ratio=%.2f reason=%s "
+            "cost=%.3fs mtbf=%s)",
+            decision.mechanism, slow_ip, slowdown_ratio, reason,
+            chosen.cost_s,
             f"{mtbf_s:.1f}s" if mtbf_s is not None else "n/a")
         self._decisions.append(decision)
         decision.record()

@@ -65,6 +65,21 @@ class HostHealthTracker:
     def failure_count(self, ip: str) -> int:
         return len(self._failures.get(ip, ()))
 
+    def quarantine(self, ip: str, cause: str = "") -> None:
+        """Force a host into quarantine NOW (the policy plane's explicit
+        quarantine arm — a gray-failing host barred from readmission
+        without waiting for the two-failures-in-window rule). The event
+        counts as an observed health incident, so the usual hysteresis
+        lift (quiet for hysteresis_factor * window) applies from here."""
+        now = self._clock()
+        log = self._failures.setdefault(ip, [])
+        log.append(now)
+        del log[:-MAX_EVENTS_PER_HOST]
+        self._quarantined_at[ip] = now
+        self._lifted.discard(ip)
+        if cause:
+            self._causes[ip] = cause
+
     # -- MTBF --------------------------------------------------------------- #
 
     def mtbf(self, ip: str) -> float | None:

@@ -45,10 +45,26 @@ PRIOR_LATENCY_S = {
     "absorb_spare": 0.05,
     "grow_dp": 1.0,
     "grow_reshape": 26.0,
+    # Slowdown-direction arms (SLOWDOWN incidents — a host alive but
+    # persistently slow, PR 17). observe changes nothing (the cost is the
+    # throughput the straggler keeps gating); drain/quarantine are a
+    # proactive checkpoint-flush + reroute around a host that is still
+    # able to flush cleanly — priced like a preemption drain, not like
+    # recovering from a corpse.
+    "observe": 0.0,
+    "drain": 2.0,
+    "quarantine": 2.0,
 }
 # Step-time prior when no measured step seconds are available yet (only
 # used to price checkpoint staleness in lost-work seconds).
 PRIOR_STEP_S = 1.0
+
+# A drained straggler is readmitted once healthy; when its own MTBF is
+# shorter than this horizon, the readmission is expected to cost another
+# drain within it — the hazard that prices quarantine ahead of drain for
+# a host that keeps failing (mirrors scorer.RISK_HORIZON_S, duplicated
+# here because the scorer imports this module).
+READMIT_HORIZON_S = 60.0
 
 # Histogram families that hold measured recovery latencies by mechanism.
 _LATENCY_HISTOGRAMS = (
@@ -307,3 +323,64 @@ def build_grow_arms(*,
             step_seconds if step_seconds else PRIOR_STEP_S)
     return {"absorb_spare": absorb, "grow_dp": grow_dp,
             "grow_reshape": reshape}
+
+
+def build_slowdown_arms(*,
+                        slowdown_ratio: float,
+                        survivor_frac: float,
+                        host_mtbf_s: float | None = None,
+                        host_failures: int = 0,
+                        latency_overrides: dict[str, float] | None = None,
+                        registry=None,
+                        priors_path: str | None = None
+                        ) -> dict[str, ArmSignals]:
+    """Assemble the three SLOWDOWN arms for one gray-failure incident.
+
+    A straggler gates the whole synchronous fleet, so *observe* retains
+    ``1/slowdown_ratio`` of throughput — and keeps live state on a host
+    whose degradation usually precedes death (``in_memory=True``: the
+    scorer's churn term prices exactly that hazard, rising with the sick
+    host's worsening MTBF — the drain-before-it-dies signal). *drain*
+    flushes a checkpoint on the way out (``in_memory=False``: nothing is
+    left at risk) and runs the survivors at full speed, paying
+    ``survivor_frac`` retention for the lost capacity; a drained host
+    with a short MTBF is expected to be readmitted and drained again
+    within READMIT_HORIZON_S, priced as ``lost_work_s``. *quarantine* is
+    drain plus barring readmission — feasible only for a host with
+    observed failure history (quarantining a first-time straggler on
+    telemetry alone would be acting on one signal)."""
+    ratio = max(float(slowdown_ratio), 1.0)
+
+    observe = ArmSignals(
+        mechanism="observe",
+        latency_s=0.0, latency_source="",
+        retention=1.0 / ratio,
+    )
+    observe.latency_s, observe.latency_source, observe.prior_source = \
+        _latency("observe", "observe", latency_overrides, registry,
+                 priors_path)
+
+    drain = ArmSignals(
+        mechanism="drain",
+        latency_s=0.0, latency_source="",
+        retention=survivor_frac,
+        in_memory=False,
+    )
+    drain.latency_s, drain.latency_source, drain.prior_source = _latency(
+        "drain", "drain", latency_overrides, registry, priors_path)
+    if host_mtbf_s is not None and host_mtbf_s <= READMIT_HORIZON_S:
+        drain.lost_work_s = drain.latency_s
+
+    quarantine = ArmSignals(
+        mechanism="quarantine",
+        latency_s=0.0, latency_source="",
+        retention=survivor_frac,
+        in_memory=False,
+    )
+    quarantine.latency_s, quarantine.latency_source, \
+        quarantine.prior_source = _latency(
+            "quarantine", "quarantine", latency_overrides, registry,
+            priors_path)
+    if host_failures < 1:
+        quarantine.feasible, quarantine.reason = False, "no_failure_history"
+    return {"observe": observe, "drain": drain, "quarantine": quarantine}

@@ -33,6 +33,7 @@ from dataclasses import dataclass, field
 from oobleck_tpu.degrade.classify import classify_failure
 from oobleck_tpu.degrade.planner import PipelineSpec, plan_reroute
 from oobleck_tpu.execution.schedule import replay_schedule
+from oobleck_tpu.obs.fleet import FleetTracker
 from oobleck_tpu.policy.engine import PolicyEngine
 from oobleck_tpu.policy.signals import priors_provenance
 from oobleck_tpu.sim.scenarios import Scenario
@@ -42,6 +43,12 @@ from oobleck_tpu.utils import metrics
 # (deterministic: drawn from the run's explicit PRNG). Wide enough that
 # the measured-EWMA feedback loop sees non-constant samples.
 JITTER_LO, JITTER_HI = 0.85, 1.15
+
+# Simulated heartbeat-digest cadence: how often each live host's step
+# time reaches the fleet tracker. Scheduled only when the scenario
+# scripts "slow" events, so the other scenarios' event streams (and
+# their byte-identical renders) are untouched.
+TELEMETRY_TICK_S = 5.0
 
 
 @dataclass
@@ -111,6 +118,17 @@ class SimCluster:
                 microbatches=config.microbatches_per_pipeline))
         self._total_microbatches = n_pipes * config.microbatches_per_pipeline
         self._makespan_cache: dict[tuple, float] = {}
+        # Gray-failure state: per-host step-time factors (> 1 = actively
+        # slow), when each slowdown began (detect-latency accounting),
+        # and the REAL straggler detector fed by simulated heartbeat
+        # digests — explicit thresholds, never the env, so the run stays
+        # hermetic. Initialized before _base_rate: _rate() reads it.
+        self._slow: dict[int, float] = {}
+        self._slow_since: dict[int, float] = {}
+        self._slow_cause: dict[int, str] = {}
+        self.fleet = FleetTracker(clock=lambda: self.now,
+                                  ratio=1.5, z=3.0, persist=3)
+        self.detect_to_drain_s: list[float] = []
         self._base_rate = self._rate()
         self._recovery_until = 0.0
         # Control-plane outage window: while now < _master_down_until,
@@ -144,12 +162,19 @@ class SimCluster:
             virtual_stages=self.config.virtual_stages,
             op_times=self.config.op_times)
 
+    def _pipe_factor(self, p: "_Pipeline") -> float:
+        """A pipeline runs at the pace of its slowest host (gray failure:
+        the straggler's stage gates every microbatch through it)."""
+        return max([self._slow.get(h, 1.0) for h in p.hosts] + [1.0])
+
     def _rate(self) -> float:
         """Microbatches per second at the current layout (replicas run
-        concurrently: the step time is the max replica makespan)."""
+        concurrently: the step time is the max replica makespan — a
+        slowed replica gates the global step, the allreduce barrier)."""
         if not self.pipelines:
             return 0.0
-        makespan = max(self._makespan(p.microbatches) for p in self.pipelines)
+        makespan = max(self._makespan(p.microbatches) * self._pipe_factor(p)
+                       for p in self.pipelines)
         if makespan <= 0:
             return 0.0
         return sum(p.microbatches for p in self.pipelines) / makespan
@@ -162,7 +187,8 @@ class SimCluster:
     def _step_seconds(self) -> float:
         if not self.pipelines:
             return self._makespan(self.config.microbatches_per_pipeline)
-        return max(self._makespan(p.microbatches) for p in self.pipelines)
+        return max(self._makespan(p.microbatches) * self._pipe_factor(p)
+                   for p in self.pipelines)
 
     # -- bookkeeping --------------------------------------------------------- #
 
@@ -476,6 +502,107 @@ class SimCluster:
             "pipelines": len(self.pipelines),
         })
 
+    # -- gray failures (straggler scenario) ---------------------------------- #
+
+    def _host_of(self, ip: str) -> int:
+        a, b, c = (int(x) for x in ip.split(".")[1:])
+        return (a << 16) | (b << 8) | c
+
+    def _set_slow(self, ev) -> None:
+        """Apply one scripted "slow" event: the host's step-time factor
+        changes (1.0 = recovered). The rate breakpoint lands via the
+        _advance() already done for this event's timestamp."""
+        if ev.host not in self.live:
+            return
+        if ev.factor > 1.0:
+            self._slow[ev.host] = ev.factor
+            self._slow_since.setdefault(ev.host, self.now)
+            self._slow_cause[ev.host] = ev.cause or "slowdown"
+        else:
+            self._slow.pop(ev.host, None)
+            self._slow_since.pop(ev.host, None)
+
+    def _telemetry_tick(self) -> None:
+        """One simulated heartbeat round: every assigned live host
+        reports its OWN step time (pipeline makespan x its factor) to
+        the REAL FleetTracker; a consumed flag runs the REAL
+        decide_slowdown chain. The detector, thresholds, persistence
+        gate and one-incident dedup are the production code — the sim
+        only supplies the digests."""
+        for p in self.pipelines:
+            span = self._makespan(p.microbatches)
+            for h in p.hosts:
+                if h in self.live:
+                    self.fleet.ingest(self._ip(h), {
+                        "v": 1, "step": 0,
+                        "step_s": span * self._slow.get(h, 1.0)})
+        slow_ip = self.fleet.consume_straggler()
+        if slow_ip is not None:
+            self._handle_slowdown(slow_ip)
+
+    def _handle_slowdown(self, ip: str) -> None:
+        host = self._host_of(ip)
+        ratio = self.fleet.ratio(ip) or 1.0
+        cause = self._slow_cause.get(host, "slowdown")
+        n = len(self.live)
+        decision = self.engine.decide_slowdown(
+            ip, slowdown_ratio=ratio,
+            survivor_frac=(n - 1) / n if n else 1.0,
+            cause=cause)
+        detect_s = (round(self.now - self._slow_since[host], 6)
+                    if host in self._slow_since else None)
+        rate_before = self._rate()
+        realized = 0.0
+        active = decision.mechanism in ("drain", "quarantine")
+        if active:
+            # Proactive drain: the sick host checkpoints and leaves; the
+            # survivors re-instantiate without it. No host died — the
+            # drain cost is the only stall.
+            self.live.discard(host)
+            self._slow.pop(host, None)
+            self.fleet.clear(ip)
+            dead_idx = [i for i, p in enumerate(self.pipelines)
+                        if host in p.hosts]
+            for i in reversed(dead_idx):
+                self.pipelines.pop(i)
+            self._rebuild()
+            realized = (decision.arms[decision.mechanism]["latency_s"]
+                        * self.rng.uniform(JITTER_LO, JITTER_HI))
+            self.engine.observe_measured(decision.mechanism, realized)
+            self._recovery_until = max(self._recovery_until,
+                                       self.now + realized)
+            self._push(self._recovery_until, "recovered", None)
+            if detect_s is not None:
+                self.detect_to_drain_s.append(detect_s)
+        reg = self.registry
+        if active:
+            reg.histogram(
+                "oobleck_sim_recovery_seconds",
+                "Simulated realized recovery latency by mechanism",
+            ).observe(realized, mechanism=decision.mechanism)
+        reg.counter(
+            "oobleck_sim_incidents_total",
+            "Simulated incidents by mechanism and cause",
+        ).inc(mechanism=decision.mechanism, cause=cause)
+        self.incidents.append({
+            "t": round(self.now, 6),
+            "lost_hosts": 1 if active else 0,
+            "cause": cause,
+            "correlated": False,
+            "proactive": active,
+            "slowdown_ratio": round(ratio, 6),
+            "detect_s": detect_s,
+            "mechanism": decision.mechanism,
+            "reason": decision.reason,
+            "projected_cost_s": round(decision.projected_cost_s, 6),
+            "realized_recovery_s": round(realized, 6),
+            "arms": decision.arms,
+            "rate_before": round(rate_before, 6),
+            "rate_after": round(self._rate(), 6),
+            "live_hosts": len(self.live),
+            "pipelines": len(self.pipelines),
+        })
+
     # -- the run ------------------------------------------------------------- #
 
     def _push(self, t: float, kind: str, payload) -> None:
@@ -490,6 +617,14 @@ class SimCluster:
         for ev in self.scenario.events:
             self._push(ev.t, "scenario", ev)
         duration = self.scenario.duration_s
+        if any(ev.kind == "slow" for ev in self.scenario.events):
+            # Heartbeat-digest cadence for the fleet-health plane; only
+            # scheduled when gray failures are scripted, so every other
+            # scenario's event stream stays byte-identical.
+            t = TELEMETRY_TICK_S
+            while t < duration:
+                self._push(round(t, 6), "telemetry", None)
+                t += TELEMETRY_TICK_S
         while self._heap:
             t, _, kind, payload = heapq.heappop(self._heap)
             if t > duration:
@@ -542,6 +677,8 @@ class SimCluster:
                             self._push(t + ev.repair_delay_s, "expire",
                                        ev.host)
                     self._handle_join(batch)
+                elif payload.kind == "slow":
+                    self._set_slow(payload)
                 elif payload.kind == "master_down":
                     # The control plane dies; training does not. Extend
                     # (never shorten) on overlapping outages.
@@ -549,6 +686,9 @@ class SimCluster:
                     if up_at > self._master_down_until:
                         self._master_down_until = up_at
                         self._push(up_at, "master_up", None)
+            elif kind == "telemetry":
+                if t >= self._master_down_until:
+                    self._telemetry_tick()
             elif kind == "master_up":
                 if t >= self._master_down_until:
                     self._reconcile_outage()
@@ -593,6 +733,7 @@ class SimCluster:
             "incidents": self.incidents,
             "goodput_ratio": round(goodput, 6),
             "lost_work_s": round(self.lost_work_s, 6),
+            "detect_to_drain_s": list(self.detect_to_drain_s),
             "final": {
                 "live_hosts": len(self.live),
                 "pipelines": len(self.pipelines),

@@ -30,10 +30,11 @@ class ScenarioEvent:
     repair_delay_s), "preempt" (spot notice: proactive drain, then the
     host dies), "join" (fresh capacity arrives mid-run; repair_delay_s
     doubles as the advertised spot lifetime, 0 = on-demand), "traffic"
-    (demand factor changes), or "master_down" (the control plane itself
+    (demand factor changes), "master_down" (the control plane itself
     dies for repair_delay_s; the fleet keeps training masterless and
     losses inside the window wait for the restarted master's
-    reconcile)."""
+    reconcile), or "slow" (gray failure: the host keeps training but its
+    steps stretch by ``factor``; factor 1.0 = recovered)."""
 
     t: float
     kind: str
@@ -42,6 +43,7 @@ class ScenarioEvent:
     cause: str = ""
     repair_delay_s: float = 0.0    # "join": advertised spot lifetime
     demand: float = 1.0            # "traffic" only
+    factor: float = 1.0            # "slow" only: step-time multiplier
 
 
 @dataclass
@@ -227,6 +229,61 @@ def master_outage(rng: random.Random, hosts: int, duration_s: float, *,
     return events
 
 
+def straggler(rng: random.Random, hosts: int, duration_s: float, *,
+              ramp_steps: int = 6, ramp_interval_s: float = 8.0,
+              peak_factor: float = 3.0, sudden_factor: float = 2.5,
+              blip_factor: float = 4.0, blip_s: float = 6.0,
+              mean_interarrival_s: float = 120.0,
+              mean_repair_s: float = 120.0) -> list[ScenarioEvent]:
+    """Gray failures: hosts that degrade instead of dying. Three shapes
+    under light background churn —
+
+    * a **gradual** straggler ramping to peak_factor over ramp_steps
+      stages (a failing NIC / thermal throttle: the detector must catch
+      it from relative statistics before it becomes an outage);
+    * a **sudden** straggler jumping straight to sudden_factor and
+      staying there;
+    * a **red-herring blip**: a short severe slowdown that recovers to
+      1.0 within blip_s — the persistence gate must NOT raise an
+      incident for it.
+
+    Incident ids live in the 3_000_000 band (never collide with churn /
+    join / outage ids)."""
+    events = churn_storm(rng, hosts, duration_s,
+                         mean_interarrival_s=mean_interarrival_s,
+                         mean_repair_s=mean_repair_s)
+    incident = 3_000_000
+    victims = rng.sample(range(hosts), min(3, hosts))
+    # Gradual ramp: factor climbs linearly to the peak, then persists.
+    t = round(rng.uniform(0.1, duration_s * 0.3), 6)
+    for i in range(ramp_steps):
+        frac = (i + 1) / ramp_steps
+        events.append(ScenarioEvent(
+            t=round(t + i * ramp_interval_s, 6), kind="slow",
+            host=victims[0], incident_id=incident, cause="gray_gradual",
+            factor=round(1.0 + (peak_factor - 1.0) * frac, 6)))
+    incident += 1
+    # Sudden jump, no recovery.
+    if len(victims) > 1:
+        events.append(ScenarioEvent(
+            t=round(rng.uniform(0.1, duration_s * 0.5), 6), kind="slow",
+            host=victims[1], incident_id=incident, cause="gray_sudden",
+            factor=round(sudden_factor, 6)))
+    incident += 1
+    # Red-herring blip: severe but short; back to 1.0 before the
+    # persistence gate can fill.
+    if len(victims) > 2:
+        t_blip = round(rng.uniform(0.1, duration_s * 0.7), 6)
+        events.append(ScenarioEvent(
+            t=t_blip, kind="slow", host=victims[2],
+            incident_id=incident, cause="gray_blip",
+            factor=round(blip_factor, 6)))
+        events.append(ScenarioEvent(
+            t=round(t_blip + blip_s, 6), kind="slow", host=victims[2],
+            incident_id=incident, cause="gray_blip", factor=1.0))
+    return events
+
+
 GENERATORS = {
     "churn_storm": churn_storm,
     "master_outage": master_outage,
@@ -235,6 +292,7 @@ GENERATORS = {
     "spot_preemption_wave": spot_preemption_wave,
     "flap_sequence": flap_sequence,
     "diurnal_traffic": diurnal_traffic,
+    "straggler": straggler,
 }
 
 

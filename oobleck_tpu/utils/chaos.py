@@ -79,6 +79,17 @@ directives; each directive is ``action=arg[:qual][@ip]``:
                                 masterless and REATTACHes when the
                                 partition heals (stale-membership
                                 reconcile, not a restart)
+    slow_host=10.0.0.1:2.5      gray failure: host 10.0.0.1 runs every
+                                step 2.5x slower (its worker sleeps the
+                                extra wall time after each step) but
+                                stays alive and heartbeating — the
+                                straggler the fleet-health detector must
+                                flag from telemetry, since no liveness
+                                signal ever fires. Like join_host, the
+                                ``@`` segment is a step-boundary delay:
+                                ``slow_host=10.0.0.1:2.5@3`` starts
+                                slowing on the 4th step poll (a healthy
+                                baseline first, then degradation)
 
 Barriers are explicit calls (``chaos().barrier("step_end", ip=...)``)
 placed at recovery-relevant points: worker start, step start/end, and
@@ -107,7 +118,8 @@ ENV_VAR = "OOBLECK_CHAOS"
 _KNOWN_ACTIONS = ("delay_send", "drop_send", "stall_heartbeat", "kill_at",
                   "delay_at", "kill_stage", "flap_host", "kill_hosts",
                   "preempt_notice", "join_host", "join_hosts",
-                  "spot_lifetime", "kill_master", "partition_master")
+                  "spot_lifetime", "kill_master", "partition_master",
+                  "slow_host")
 
 
 @dataclass
@@ -198,6 +210,14 @@ def parse_spec(spec: str) -> list[Rule]:
             if float(rule.qual or 0) <= 0:
                 raise ValueError(
                     f"partition_master needs positive seconds: {directive!r}")
+        elif action == "slow_host":
+            if not rule.arg:        # slow_host=<ip>:<factor>[@<step>]
+                raise ValueError(
+                    f"slow_host needs a victim ip: {directive!r}")
+            if float(rule.qual or 0) <= 1.0:
+                raise ValueError(
+                    f"slow_host needs a factor > 1.0: {directive!r}")
+            int(rule.ip or 0)       # @segment = step-boundary delay
         elif rule.qual is not None:
             int(rule.qual)
         rules.append(rule)
@@ -435,6 +455,38 @@ class Chaos:
             if self._counts.get(i, 0):
                 continue
             self._counts[i] = 1
+            return float(r.qual or 0)
+        return None
+
+    # -- gray failure (straggler fault) ------------------------------------- #
+
+    def slow_factor(self, ip: str | None) -> float | None:
+        """Per-step slowdown factor for host `ip` once its slow_host rule
+        has activated, else None. The engine polls once per step; a rule
+        with ``@<step>`` activates on poll number step+1 (deterministic,
+        like join_targets). NON-consuming after activation — a gray-
+        failing host stays slow until something drains it; the activation
+        is flight-recorded once."""
+        for r in self.rules:
+            if r.action != "slow_host" or r.arg != ip:
+                continue
+            i = self.rules.index(r)
+            n = self._counts.get(i, 0)
+            if n >= 0:
+                delay = int(r.ip or 0)
+                if n < delay:
+                    self._counts[i] = n + 1
+                    return None
+                self._counts[i] = -1  # active from here on
+                factor = float(r.qual or 0)
+                logger.warning(
+                    "chaos: host %s now runs %.2fx slow (gray failure)",
+                    ip, factor)
+                from oobleck_tpu.utils import metrics
+
+                metrics.flight_recorder().record(
+                    "chaos_injection", action="slow_host", ip=ip,
+                    factor=factor)
             return float(r.qual or 0)
         return None
 

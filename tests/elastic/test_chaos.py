@@ -105,6 +105,10 @@ def test_parse_spec_outage_directives():
     "partition_master=:8",    # no agent ip
     "partition_master=10.0.0.1",      # no partition length
     "partition_master=10.0.0.1:0",    # non-positive length
+    "slow_host=:2.5",         # no victim ip
+    "slow_host=10.0.0.1",     # no factor
+    "slow_host=10.0.0.1:1.0",         # factor must exceed 1.0
+    "slow_host=10.0.0.1:2.5@soon",    # non-integer step delay
 ])
 def test_parse_spec_rejects_typos_eagerly(bad):
     # A typo'd injection spec must fail the run at parse time, not
@@ -202,6 +206,39 @@ def test_spot_lifetime_is_non_consuming():
     assert c.spot_lifetime("10.0.0.5") == pytest.approx(30.0)
     assert c.spot_lifetime("10.0.0.5") == pytest.approx(30.0)
     assert c.spot_lifetime("10.0.0.9") is None
+
+
+def test_parse_spec_gray_failure_directive():
+    """slow_host=<ip>:<factor>[@<step>] — the @ segment is a step-boundary
+    activation delay (like join_host: the poll count is the clock)."""
+    rules = parse_spec("slow_host=10.0.0.1:2.5, slow_host=10.0.0.2:3@4")
+    assert [(r.action, r.arg, r.qual, r.ip) for r in rules] == [
+        ("slow_host", "10.0.0.1", "2.5", None),
+        ("slow_host", "10.0.0.2", "3", "4"),
+    ]
+
+
+def test_slow_factor_activation_and_persistence():
+    """The engine polls slow_factor once per step: a rule with @<step>
+    matures on poll step+1, and once active it is NON-consuming — a gray-
+    failing host stays slow until something drains it. Activation lands
+    exactly one chaos_injection flight event."""
+    from oobleck_tpu.utils import metrics
+
+    c = Chaos("slow_host=10.0.0.1:2.5@2")
+    assert c.slow_factor("10.0.0.9") is None          # wrong victim, always
+    assert c.slow_factor("10.0.0.1") is None          # poll 1: maturing
+    assert c.slow_factor("10.0.0.1") is None          # poll 2: maturing
+    assert c.slow_factor("10.0.0.1") == pytest.approx(2.5)
+    assert c.slow_factor("10.0.0.1") == pytest.approx(2.5)  # persists
+    injected = [e for e in metrics.flight_recorder().events()
+                if e["event"] == "chaos_injection"
+                and e.get("action") == "slow_host"]
+    assert len(injected) == 1
+    assert injected[0]["ip"] == "10.0.0.1"
+    # No delay segment: slow from the first poll.
+    now = Chaos("slow_host=10.0.0.2:4")
+    assert now.slow_factor("10.0.0.2") == pytest.approx(4.0)
 
 
 def test_inactive_chaos_is_a_noop():

@@ -1,0 +1,215 @@
+"""Fleet-health wire plane over real localhost TCP: heartbeat-piggybacked
+telemetry digests in both compatibility directions (legacy pings with no
+digest, future-versioned digests, epoch-stale digests — all tolerated,
+never errors), plus the control-plane half of the straggler story end to
+end: slow digests -> exactly ONE SLOWDOWN incident with every arm priced
+-> proactive DEGRADE drain broadcast to the whole fleet including the
+victim -> zero respawns, and the victim's clean exit raises no second
+incident."""
+
+import asyncio
+
+import pytest
+
+from oobleck_tpu.elastic import journal as journal_mod
+from oobleck_tpu.elastic.message import (
+    TELEMETRY_KEY,
+    RequestType,
+    ResponseType,
+    recv_msg,
+    send_request,
+)
+from oobleck_tpu.obs.telemetry import DIGEST_VERSION
+from oobleck_tpu.policy.engine import MECH_DRAIN
+from oobleck_tpu.utils import metrics
+
+from tests.elastic.test_control_plane import (
+    job_args,  # noqa: F401 — fixture re-export
+    launch_job,
+    register_agent,
+    start_master,
+)
+
+
+@pytest.fixture(autouse=True)
+def _fresh_flight(monkeypatch):
+    monkeypatch.setattr(metrics, "_flight", metrics.FlightRecorder())
+
+
+@pytest.fixture(autouse=True)
+def _fresh_registry(monkeypatch):
+    monkeypatch.setattr(metrics, "_registry", metrics.Registry())
+
+
+def _digest(step_s: float, *, epoch: int | None = None,
+            version: int = DIGEST_VERSION) -> dict:
+    d = {"v": version, "n": 8, "step": 40, "step_s": step_s,
+         "step_p50_s": step_s, "step_max_s": step_s,
+         "compute_s": step_s * 0.8, "comm_s": step_s * 0.1,
+         "data_wait_s": 0.0, "ckpt_s": 0.0, "live_bytes": 1 << 30}
+    if epoch is not None:
+        d["epoch"] = epoch
+    return d
+
+
+async def ping(r, w, ip, digest=None):
+    """One heartbeat round-trip. The master broadcasts recovery verbs
+    BEFORE answering the ping that triggered them, so anything that
+    arrives ahead of the PONG is collected and returned."""
+    payload = {"ip": ip}
+    if digest is not None:
+        payload[TELEMETRY_KEY] = digest
+    await send_request(w, RequestType.PING, payload)
+    before = []
+    while True:
+        msg = await recv_msg(r, timeout=5)
+        if msg["kind"] == ResponseType.PONG.value:
+            return before
+        before.append(msg)
+
+
+# --------------------------------------------------------------------- #
+# wire compatibility
+
+
+@pytest.mark.asyncio
+async def test_legacy_ping_without_digest_still_pongs(job_args):  # noqa: F811
+    # Old agents send bare pings: the new master PONGs and they simply
+    # contribute no fleet-health row.
+    daemon, _, task = await start_master()
+    await launch_job(daemon, job_args)
+    r, w, _ = await register_agent(daemon, "10.0.0.1")
+    for _ in range(3):
+        await ping(r, w, "10.0.0.1")
+    assert daemon.fleet.snapshot()["hosts"] == {}
+    task.cancel()
+
+
+@pytest.mark.asyncio
+async def test_digest_ping_populates_fleet_rows(job_args):  # noqa: F811
+    daemon, _, task = await start_master()
+    await launch_job(daemon, job_args)
+    r, w, _ = await register_agent(daemon, "10.0.0.1")
+    await ping(r, w, "10.0.0.1", _digest(1.25, epoch=0))
+    row = daemon.fleet.snapshot()["hosts"]["10.0.0.1"]
+    assert row["step_s"] == pytest.approx(1.25)
+    assert row["step"] == 40
+    task.cancel()
+
+
+@pytest.mark.asyncio
+async def test_unknown_digest_version_is_skipped(job_args):  # noqa: F811
+    # A future agent against this master: the unversioned-understanding
+    # gate drops the digest, the heartbeat itself still counts.
+    daemon, _, task = await start_master()
+    await launch_job(daemon, job_args)
+    r, w, _ = await register_agent(daemon, "10.0.0.1")
+    await ping(r, w, "10.0.0.1", _digest(1.0, version=DIGEST_VERSION + 1))
+    await ping(r, w, "10.0.0.1", {"v": "bogus"})
+    assert daemon.fleet.snapshot()["hosts"] == {}
+    task.cancel()
+
+
+@pytest.mark.asyncio
+async def test_stale_epoch_digest_is_fenced(job_args,  # noqa: F811
+                                            tmp_path, monkeypatch):
+    # With the journal on, the master has a real epoch: digests stamped
+    # by an agent that has not yet seen the fenced restart are dropped.
+    monkeypatch.setenv(journal_mod.ENV_STATE_DIR, str(tmp_path))
+    daemon, _, task = await start_master()
+    assert daemon.master_epoch == 1
+    await launch_job(daemon, job_args)
+    r, w, _ = await register_agent(daemon, "10.0.0.1")
+    await ping(r, w, "10.0.0.1", _digest(9.0, epoch=0))
+    snap = daemon.fleet.snapshot()
+    assert snap["hosts"] == {}
+    assert snap["stale_digests"] == 1
+    await ping(r, w, "10.0.0.1", _digest(9.0, epoch=1))
+    assert "10.0.0.1" in daemon.fleet.snapshot()["hosts"]
+    task.cancel()
+
+
+# --------------------------------------------------------------------- #
+# straggler end to end (control-plane half)
+
+
+@pytest.mark.asyncio
+async def test_straggler_digests_raise_one_incident_and_drain(
+        job_args, monkeypatch):  # noqa: F811
+    monkeypatch.setenv("OOBLECK_MULTIHOST", "1")
+    job_args.dist.node_ips = [f"10.0.0.{i}" for i in range(1, 5)]
+    daemon, launcher, task = await start_master()
+    await launch_job(daemon, job_args)
+    socks = {ip: await register_agent(daemon, ip)
+             for ip in job_args.dist.node_ips}
+    spawned_at_launch = list(launcher.launched)
+
+    # Three rounds of heartbeats: 10.0.0.3 reports 4x the fleet's step
+    # time (persist=3 fills on the third round and the flag fires).
+    verbs: dict[str, list] = {ip: [] for ip in socks}
+    for _ in range(3):
+        for ip, (r, w, _) in socks.items():
+            step_s = 4.0 if ip == "10.0.0.3" else 1.0
+            verbs[ip] += await ping(r, w, ip, _digest(step_s, epoch=0))
+
+    # Exactly ONE SLOWDOWN incident, with every arm's pricing recorded.
+    slow = [e for e in daemon._recoveries if e.get("cause") == "slowdown"]
+    assert len(slow) == 1
+    assert slow[0]["lost_ip"] == "10.0.0.3"
+    assert slow[0]["slowdown_ratio"] == pytest.approx(4.0)
+    decision = daemon.policy._decisions[-1]
+    assert decision.mechanism == MECH_DRAIN
+    assert decision.proactive and decision.inplace
+    assert set(decision.arms) == {"observe", "drain", "quarantine"}
+    for arm in decision.arms.values():
+        assert {"feasible", "latency_s", "lost_work_s",
+                "retention"} <= set(arm)
+
+    # The proactive drain went to the WHOLE fleet, victim included (the
+    # preemption pattern: its worker flushes a checkpoint on the way
+    # out). Some sockets saw the verb interleaved before a PONG; the
+    # rest have it pending.
+    for ip, (r, w, _) in socks.items():
+        msg = verbs[ip][0] if verbs[ip] else await recv_msg(r, timeout=5)
+        assert msg["kind"] == ResponseType.DEGRADE.value
+        assert msg["lost_ip"] == "10.0.0.3"
+    # Zero respawns: the launcher never ran again.
+    assert launcher.launched == spawned_at_launch
+
+    # One SLOWDOWN counter tick, one flight event, flagged row cleared.
+    assert [e for e in metrics.flight_recorder().events()
+            if e["event"] == "slowdown_detected"]
+    assert daemon.fleet.flagged() == []
+
+    # /status carries the fleet_health block the dashboards read.
+    status = daemon._status()
+    fh = status["fleet_health"]
+    assert set(fh["hosts"]) == {"10.0.0.1", "10.0.0.2", "10.0.0.4"}
+    assert fh["thresholds"]["persist"] >= 1
+
+    # The victim departs cleanly after the drain: no second incident.
+    _, w3, _ = socks["10.0.0.3"]
+    w3.close()
+    await asyncio.sleep(0.1)
+    assert [e for e in daemon._recoveries
+            if e["lost_ip"] == "10.0.0.3"] == slow
+    task.cancel()
+
+
+@pytest.mark.asyncio
+async def test_transient_blip_raises_no_incident(job_args):  # noqa: F811
+    # One severe round bracketed by healthy rounds: the persistence gate
+    # must swallow it — a GC pause is not a gray failure.
+    job_args.dist.node_ips = [f"10.0.0.{i}" for i in range(1, 5)]
+    daemon, _, task = await start_master()
+    await launch_job(daemon, job_args)
+    socks = {ip: await register_agent(daemon, ip)
+             for ip in job_args.dist.node_ips}
+    for round_slow in (False, True, False, False):
+        for ip, (r, w, _) in socks.items():
+            step_s = 6.0 if (round_slow and ip == "10.0.0.3") else 1.0
+            await ping(r, w, ip, _digest(step_s, epoch=0))
+    assert [e for e in daemon._recoveries
+            if e.get("cause") == "slowdown"] == []
+    assert daemon.fleet.flagged() == []
+    task.cancel()

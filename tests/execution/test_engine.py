@@ -39,7 +39,7 @@ def cache_env(tmp_path_factory):
 
 
 def make_engine(num_hosts=4, steps=3, devices=None, microbatch=2, global_mb=16,
-                model_name="gpt2-tiny"):
+                model_name="gpt2-tiny", agent_ip=None):
     args = OobleckArguments(
         dist=DistributedArguments(
             node_ips=[f"10.0.0.{i}" for i in range(num_hosts)]
@@ -54,7 +54,7 @@ def make_engine(num_hosts=4, steps=3, devices=None, microbatch=2, global_mb=16,
         model=ModelArguments(model_name=model_name, dataset_path="synthetic"),
     )
     devices = devices or jax.devices()[:8]
-    return OobleckEngine(args, devices=devices)
+    return OobleckEngine(args, agent_ip=agent_ip, devices=devices)
 
 
 @pytest.fixture(scope="module")

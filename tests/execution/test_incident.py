@@ -88,6 +88,7 @@ def test_incident_digest_restaged_on_pipe_failure(monkeypatch):
     from types import SimpleNamespace
 
     from oobleck_tpu.execution.engine import OobleckEngine
+    from oobleck_tpu.obs.goodput import GoodputLedger
 
     monkeypatch.delenv(metrics.ENV_METRICS_DIR, raising=False)
     sent = []
@@ -102,7 +103,8 @@ def test_incident_digest_restaged_on_pipe_failure(monkeypatch):
 
     digest = {"trace_id": "t1", "lost_ip": "10.0.0.1"}
     eng = SimpleNamespace(step=5, _incident_record=dict(digest),
-                          agent_pipe=FlakyPipe())
+                          agent_pipe=FlakyPipe(),
+                          _ledger=GoodputLedger(), _last_mfu=None)
     OobleckEngine._publish_metrics(eng)
     assert eng._incident_record == digest  # re-staged, not dropped
     eng.agent_pipe.fail = False
@@ -111,7 +113,8 @@ def test_incident_digest_restaged_on_pipe_failure(monkeypatch):
     assert sent[-1]["snapshot"]["incident"] == digest
     # no pipe at all: consumed in one push (the JSONL sink owns it)
     eng2 = SimpleNamespace(step=0, _incident_record=dict(digest),
-                           agent_pipe=None)
+                           agent_pipe=None,
+                           _ledger=GoodputLedger(), _last_mfu=None)
     OobleckEngine._publish_metrics(eng2)
     assert eng2._incident_record is None
 

@@ -1,0 +1,248 @@
+"""Fleet-health plane unit tests: the telemetry ring + its wire-digest
+gate, the robust straggler detector (blip immunity, persistence, one-shot
+flags, epoch fence), and the attributed goodput ledger. Everything runs
+on injectable clocks — no sleeping, no jax."""
+
+from __future__ import annotations
+
+import pytest
+
+from oobleck_tpu.obs import telemetry as telemetry_mod
+from oobleck_tpu.obs.fleet import FleetTracker
+from oobleck_tpu.obs.goodput import BUCKETS, GoodputLedger
+from oobleck_tpu.obs.incident import IncidentBuilder
+from oobleck_tpu.obs.telemetry import DIGEST_VERSION, TelemetryRing, digest_ok
+
+
+class FakeClock:
+    def __init__(self, t: float = 1000.0):
+        self.t = t
+
+    def __call__(self) -> float:
+        return self.t
+
+    def advance(self, s: float) -> None:
+        self.t += s
+
+
+# --------------------------------------------------------------------- #
+# telemetry ring
+
+
+def test_ring_digest_summarizes_window():
+    ring = TelemetryRing(capacity=64, window=4)
+    assert ring.digest() is None  # nothing recorded yet
+    for i in range(10):
+        ring.record_step(i, 1.0 + i, compute_s=0.8, comm_s=0.1,
+                         data_wait_s=0.05, ckpt_s=0.5, live_bytes=100 + i)
+    d = ring.digest()
+    # Window = last 4 samples (steps 6..9): step_s mean 8.5, max 10.0.
+    assert d["v"] == DIGEST_VERSION
+    assert d["n"] == 4
+    assert d["step"] == 9
+    assert d["step_s"] == pytest.approx(8.5)
+    assert d["step_max_s"] == pytest.approx(10.0)
+    assert d["compute_s"] == pytest.approx(0.8)
+    assert d["comm_s"] == pytest.approx(0.1)
+    # ckpt time is a SUM (stalls are rare spikes a mean would bury).
+    assert d["ckpt_s"] == pytest.approx(2.0)
+    assert d["live_bytes"] == 109
+    assert digest_ok(d)
+
+
+def test_ring_capacity_bounds_memory():
+    ring = TelemetryRing(capacity=8, window=32)
+    for i in range(100):
+        ring.record_step(i, 1.0)
+    assert len(ring) == 8
+    assert ring.digest()["n"] == 8  # window clamps to what survived
+
+
+def test_ring_disable_knob(monkeypatch):
+    monkeypatch.setenv(telemetry_mod.ENV_TELEMETRY, "0")
+    ring = TelemetryRing(capacity=8, window=4)
+    ring.record_step(0, 1.0)
+    assert len(ring) == 0 and ring.digest() is None
+
+
+def test_ring_env_sizing_and_reset(monkeypatch):
+    monkeypatch.setenv(telemetry_mod.ENV_CAPACITY, "16")
+    monkeypatch.setenv(telemetry_mod.ENV_WINDOW, "2")
+    ring = telemetry_mod.reset()
+    assert ring is telemetry_mod.telemetry()
+    for i in range(3):
+        ring.record_step(i, float(i + 1))
+    assert ring.digest()["n"] == 2
+    telemetry_mod.reset(capacity=4, window=1)  # explicit args win over env
+    assert telemetry_mod.telemetry().window == 1
+
+
+def test_digest_ok_is_the_legacy_tolerance_gate():
+    # Absent key (old agent), future version, malformed payloads: all
+    # skipped, never an error.
+    assert not digest_ok(None)
+    assert not digest_ok("not a dict")
+    assert not digest_ok({"v": DIGEST_VERSION + 1, "step_s": 1.0})
+    assert not digest_ok({"v": DIGEST_VERSION, "step_s": "fast"})
+    assert digest_ok({"v": DIGEST_VERSION, "step_s": 1.0, "extra": "ok"})
+
+
+# --------------------------------------------------------------------- #
+# fleet tracker
+
+
+def _tracker(**kw):
+    kw.setdefault("clock", FakeClock())
+    kw.setdefault("ratio", 1.5)
+    kw.setdefault("z", 3.0)
+    kw.setdefault("persist", 3)
+    return FleetTracker(**kw)
+
+
+def _feed(tracker, slow_ip=None, slow_s=2.5, hosts=8, rounds=1):
+    for _ in range(rounds):
+        for h in range(hosts):
+            ip = f"10.0.0.{h}"
+            step_s = slow_s if ip == slow_ip else 1.0
+            tracker.ingest(ip, {"v": 1, "step": 0, "step_s": step_s})
+
+
+def test_straggler_flagged_after_persistence():
+    t = _tracker()
+    _feed(t, slow_ip="10.0.0.3", rounds=2)
+    assert t.flagged() == []  # 2 breaches < persist=3
+    _feed(t, slow_ip="10.0.0.3")
+    assert t.flagged() == ["10.0.0.3"]
+    assert t.ratio("10.0.0.3") == pytest.approx(2.5)
+    # One-shot handout: exactly one SLOWDOWN incident per degradation.
+    assert t.consume_straggler() == "10.0.0.3"
+    assert t.consume_straggler() is None
+    _feed(t, slow_ip="10.0.0.3")  # still slow: flag stays latched
+    assert t.consume_straggler() is None
+
+
+def test_blip_resets_persistence_and_never_flags():
+    t = _tracker()
+    _feed(t, slow_ip="10.0.0.3", slow_s=4.0, rounds=2)  # severe blip
+    _feed(t)  # healthy digest: counter dies here
+    _feed(t, slow_ip="10.0.0.3", slow_s=4.0, rounds=2)
+    assert t.flagged() == []
+    assert t.consume_straggler() is None
+
+
+def test_clear_unlatches_for_a_new_life():
+    t = _tracker()
+    _feed(t, slow_ip="10.0.0.3", rounds=3)
+    assert t.consume_straggler() == "10.0.0.3"
+    t.clear("10.0.0.3")  # drained / re-registered
+    assert t.flagged() == []
+    # The next life breaches afresh and CAN be flagged again.
+    _feed(t, slow_ip="10.0.0.3", rounds=3)
+    assert t.consume_straggler() == "10.0.0.3"
+
+
+def test_small_fleet_uses_ratio_gate_alone():
+    # 2 hosts: MAD is degenerate, the z-gate must not block detection.
+    # (The straggler itself drags a 2-host median to the midpoint, so a
+    # 4x host sits at ratio 1.6 — the gate still needs a real gap.)
+    t = _tracker()
+    _feed(t, slow_ip="10.0.0.1", slow_s=4.0, hosts=2, rounds=3)
+    assert t.flagged() == ["10.0.0.1"]
+
+
+def test_fleet_of_one_never_flags():
+    t = _tracker()
+    _feed(t, slow_ip="10.0.0.0", hosts=1, rounds=10)
+    assert t.flagged() == []
+
+
+def test_epoch_fence_drops_stale_digests():
+    t = _tracker()
+    for _ in range(5):
+        t.ingest("10.0.0.1", {"v": 1, "step": 0, "step_s": 9.0},
+                 epoch=1, min_epoch=2)
+    assert t.snapshot()["hosts"] == {}
+    assert t.snapshot()["stale_digests"] == 5
+    # Same digest at the current epoch lands normally.
+    t.ingest("10.0.0.1", {"v": 1, "step": 0, "step_s": 9.0},
+             epoch=2, min_epoch=2)
+    assert "10.0.0.1" in t.snapshot()["hosts"]
+
+
+def test_snapshot_shape_for_status():
+    t = _tracker()
+    _feed(t, slow_ip="10.0.0.3", rounds=3)
+    snap = t.snapshot()
+    assert snap["flagged"] == ["10.0.0.3"]
+    assert snap["thresholds"] == {"ratio": 1.5, "z": 3.0, "persist": 3}
+    row = snap["hosts"]["10.0.0.3"]
+    assert row["flagged"] and row["ratio"] == pytest.approx(2.5)
+    assert snap["hosts"]["10.0.0.1"]["breaches"] == 0
+
+
+# --------------------------------------------------------------------- #
+# goodput ledger
+
+
+def test_ledger_partitions_wall_clock():
+    clk = FakeClock()
+    led = GoodputLedger(clock=clk)
+    clk.advance(10.0)
+    for _ in range(4):
+        led.account_step(2.0, bubble_frac=0.25, data_wait_s=0.1)
+    led.account("checkpoint", 0.6)
+    snap = led.snapshot()
+    b = snap["buckets"]
+    assert set(b) == set(BUCKETS)
+    assert b["step"] == pytest.approx(6.0)      # 4 * 2.0 * 0.75
+    assert b["bubble"] == pytest.approx(2.0)    # 4 * 2.0 * 0.25
+    assert b["data_wait"] == pytest.approx(0.4)
+    assert b["checkpoint"] == pytest.approx(0.6)
+    # `other` is the unexplained remainder: buckets sum to the wall.
+    assert b["other"] == pytest.approx(10.0 - 9.0)
+    assert sum(b.values()) == pytest.approx(snap["wall_s"])
+    assert snap["goodput_fraction"] == pytest.approx(0.6)
+    assert led.goodput_fraction() == pytest.approx(0.6)
+    assert snap["steps"] == 4
+
+
+def test_ledger_attributes_lost_time_to_incidents():
+    clk = FakeClock()
+    led = GoodputLedger(clock=clk)
+    clk.advance(100.0)
+    led.attribute("trace-1", 12.0, cause="slowdown")
+    led.attribute("trace-1", 3.0, bucket="checkpoint")
+    cost = led.incident_cost("trace-1")
+    assert cost == {"lost_s": 15.0,
+                    "buckets": {"recovery": 12.0, "checkpoint": 3.0},
+                    "cause": "slowdown"}
+    assert led.incident_cost("trace-2") is None
+    assert led.snapshot()["incidents"]["trace-1"]["lost_s"] == 15.0
+    # The bucket side of the double entry landed too.
+    assert led.snapshot()["buckets"]["recovery"] == pytest.approx(12.0)
+
+
+def test_ledger_rejects_unknown_bucket():
+    led = GoodputLedger(clock=FakeClock())
+    with pytest.raises(ValueError, match="unknown goodput bucket"):
+        led.account("coffee", 1.0)
+    with pytest.raises(ValueError, match="unknown goodput bucket"):
+        led.attribute("t", 1.0, bucket="coffee")
+
+
+def test_ledger_mfu_rides_the_snapshot():
+    led = GoodputLedger(clock=FakeClock())
+    assert "mfu" not in led.snapshot()
+    assert led.snapshot(mfu=0.42)["mfu"] == pytest.approx(0.42)
+
+
+def test_incident_record_carries_goodput_cost(tmp_path):
+    # The acceptance-criteria shape: an incident file's goodput_cost
+    # section is exactly the ledger's incident_cost for its trace.
+    led = GoodputLedger(clock=FakeClock())
+    inc = IncidentBuilder("10.0.0.3", cause="slowdown")
+    led.attribute(inc.trace_id, 7.5, cause="slowdown")
+    inc.goodput_cost = led.incident_cost(inc.trace_id)
+    rec = inc.build()
+    assert rec["goodput_cost"]["lost_s"] == 7.5
+    assert rec["goodput_cost"]["buckets"] == {"recovery": 7.5}

@@ -1,0 +1,170 @@
+"""SLOWDOWN-direction policy tests: the gray-failure arms (observe /
+proactive drain / quarantine), their feasibility gates, the forced-mode
+fallback, and the drain-before-it-dies pricing. Same harness as
+test_policy.py: injectable clock, fresh registry, no sleeping."""
+
+from __future__ import annotations
+
+import pytest
+
+from oobleck_tpu.policy.engine import (
+    MECH_DRAIN,
+    MECH_OBSERVE,
+    MECH_QUARANTINE,
+    MODE_ADAPTIVE,
+    SLOWDOWN_MODES,
+    PolicyEngine,
+)
+from oobleck_tpu.policy.scorer import score_arms
+from oobleck_tpu.policy.signals import READMIT_HORIZON_S, build_slowdown_arms
+from oobleck_tpu.utils import metrics
+
+
+class FakeClock:
+    def __init__(self, t: float = 1000.0):
+        self.t = t
+
+    def __call__(self) -> float:
+        return self.t
+
+    def advance(self, s: float) -> None:
+        self.t += s
+
+
+@pytest.fixture(autouse=True)
+def _fresh_registry(monkeypatch):
+    monkeypatch.setattr(metrics, "_registry", metrics.Registry())
+
+
+def _engine(mode=MODE_ADAPTIVE, **kw):
+    return PolicyEngine(mode=mode, clock=FakeClock(), **kw)
+
+
+# --------------------------------------------------------------------- #
+# arms
+
+
+def test_slowdown_arm_shapes():
+    arms = build_slowdown_arms(slowdown_ratio=2.0, survivor_frac=0.75)
+    assert set(arms) == set(SLOWDOWN_MODES)
+    # A straggler gates the synchronous fleet: observing retains 1/ratio.
+    assert arms[MECH_OBSERVE].retention == pytest.approx(0.5)
+    assert arms[MECH_OBSERVE].in_memory  # live state stays at risk
+    # Draining pays the lost host's capacity but runs at full speed.
+    assert arms[MECH_DRAIN].retention == pytest.approx(0.75)
+    assert not arms[MECH_DRAIN].in_memory  # checkpoint flushed on exit
+    # Ratio below 1 is clamped: "faster than the median" is not a hazard.
+    calm = build_slowdown_arms(slowdown_ratio=0.5, survivor_frac=1.0)
+    assert calm[MECH_OBSERVE].retention == pytest.approx(1.0)
+
+
+def test_quarantine_needs_failure_history():
+    # Quarantining a first-time straggler on telemetry alone would be
+    # acting on one signal.
+    arms = build_slowdown_arms(slowdown_ratio=3.0, survivor_frac=0.9)
+    assert not arms[MECH_QUARANTINE].feasible
+    assert arms[MECH_QUARANTINE].reason == "no_failure_history"
+    armed = build_slowdown_arms(slowdown_ratio=3.0, survivor_frac=0.9,
+                                host_failures=2)
+    assert armed[MECH_QUARANTINE].feasible
+
+
+def test_short_mtbf_prices_drain_readmission_churn():
+    # A drained host with a short MTBF will be readmitted and drained
+    # again inside the horizon: that churn is lost work on the drain arm.
+    sick = build_slowdown_arms(slowdown_ratio=2.0, survivor_frac=0.9,
+                               host_mtbf_s=READMIT_HORIZON_S / 2)
+    assert sick[MECH_DRAIN].lost_work_s == pytest.approx(
+        sick[MECH_DRAIN].latency_s)
+    stable = build_slowdown_arms(slowdown_ratio=2.0, survivor_frac=0.9,
+                                 host_mtbf_s=READMIT_HORIZON_S * 10)
+    assert stable[MECH_DRAIN].lost_work_s == 0.0
+
+
+def test_severity_flips_observe_to_drain():
+    # Mild slowdown on a tiny fleet: keeping the host is cheaper than
+    # paying its capacity. Severe slowdown: the whole fleet is gated and
+    # draining wins.
+    mild = score_arms(build_slowdown_arms(slowdown_ratio=1.1,
+                                          survivor_frac=0.5), mtbf_s=None)
+    assert (mild[MECH_OBSERVE].cost_s < mild[MECH_DRAIN].cost_s)
+    severe = score_arms(build_slowdown_arms(slowdown_ratio=4.0,
+                                            survivor_frac=0.95),
+                        mtbf_s=None)
+    assert (severe[MECH_DRAIN].cost_s < severe[MECH_OBSERVE].cost_s)
+
+
+# --------------------------------------------------------------------- #
+# decide_slowdown
+
+
+def test_decide_slowdown_severe_straggler_drains():
+    eng = _engine(multihost=True)
+    d = eng.decide_slowdown("10.0.0.3", slowdown_ratio=4.0,
+                            survivor_frac=15 / 16)
+    assert d.mechanism == MECH_DRAIN
+    assert d.reason == "cheapest"
+    assert d.lost_ips == ["10.0.0.3"]
+    # The victim's worker is alive: proactive preemption-style drain,
+    # survivors reroute in place with zero respawns.
+    assert d.proactive and d.inplace
+    # Every arm's full pricing is in the record (the incident file's
+    # "what else could we have done" section).
+    assert set(d.arms) == set(SLOWDOWN_MODES)
+    for arm in d.arms.values():
+        assert {"feasible", "latency_s", "lost_work_s",
+                "retention"} <= set(arm)
+    assert d.infeasible == {MECH_QUARANTINE: "no_failure_history"}
+
+
+def test_decide_slowdown_mild_straggler_observes():
+    eng = _engine(multihost=True)
+    d = eng.decide_slowdown("10.0.0.3", slowdown_ratio=1.05,
+                            survivor_frac=0.5)
+    assert d.mechanism == MECH_OBSERVE
+    assert not d.proactive and not d.inplace
+
+
+def test_forced_quarantine_falls_back_to_observe_without_history():
+    eng = _engine(mode=MECH_QUARANTINE, multihost=True)
+    d = eng.decide_slowdown("10.0.0.3", slowdown_ratio=4.0)
+    assert d.mechanism == MECH_OBSERVE
+    assert d.reason == "forced:quarantine:infeasible:no_failure_history"
+    assert "10.0.0.3" not in d.quarantined
+
+
+def test_forced_quarantine_with_history_bars_readmission():
+    eng = _engine(mode=MECH_QUARANTINE, multihost=True)
+    eng.observe_failure("10.0.0.3", cause="flap")
+    eng.health._clock.advance(5.0)
+    d = eng.decide_slowdown("10.0.0.3", slowdown_ratio=4.0,
+                            survivor_frac=0.9)
+    assert d.mechanism == MECH_QUARANTINE
+    assert d.reason == "forced:quarantine"
+    assert "10.0.0.3" in d.quarantined
+    assert eng.is_quarantined("10.0.0.3")
+
+
+def test_forced_loss_mode_is_out_of_scope_for_slowdowns():
+    # OOBLECK_POLICY=restore forces the LOSS direction only; a slowdown
+    # decision under it stays adaptive (restore is not a slowdown arm).
+    eng = _engine(mode="restore", multihost=True)
+    d = eng.decide_slowdown("10.0.0.3", slowdown_ratio=4.0,
+                            survivor_frac=15 / 16)
+    assert d.mechanism in SLOWDOWN_MODES
+    assert d.reason == "cheapest"
+
+
+def test_sick_host_mtbf_is_the_risk_horizon():
+    # A host that has been failing is priced as about to fail again: its
+    # own MTBF (not the fleet's) sets the churn hedge, which is what
+    # drains a degrading host BEFORE it dies.
+    eng = _engine(multihost=True)
+    for _ in range(3):
+        eng.observe_failure("10.0.0.3", cause="flap")
+        eng.health._clock.advance(5.0)
+    d = eng.decide_slowdown("10.0.0.3", slowdown_ratio=2.0,
+                            survivor_frac=15 / 16)
+    assert d.mtbf_s == pytest.approx(5.0)
+    assert d.mechanism in (MECH_DRAIN, MECH_QUARANTINE)
+    assert d.proactive

@@ -1,0 +1,87 @@
+"""Straggler scenario through the simulator's REAL detector + policy
+chain: the gradual and sudden gray failures must each raise exactly one
+SLOWDOWN incident and get drained; the red-herring blip must raise none;
+and the whole run must stay byte-identical under the determinism gate."""
+
+from __future__ import annotations
+
+import pytest
+
+from oobleck_tpu.sim import slo
+from oobleck_tpu.sim.cluster import SimCluster, SimConfig
+from oobleck_tpu.sim.scenarios import make_scenario
+
+SEED, HOSTS, DURATION = 1117, 16, 300.0
+
+
+@pytest.fixture(scope="module")
+def run():
+    scenario = make_scenario("straggler", seed=SEED, hosts=HOSTS,
+                             duration_s=DURATION)
+    return SimCluster(SimConfig(hosts=HOSTS), scenario).run()
+
+
+def _slowdowns(run):
+    return [i for i in run["incidents"] if "slowdown_ratio" in i]
+
+
+def test_scenario_has_all_three_gray_shapes():
+    events = make_scenario("straggler", seed=SEED, hosts=HOSTS,
+                           duration_s=DURATION).events
+    causes = {e.cause for e in events if e.kind == "slow"}
+    assert causes == {"gray_gradual", "gray_sudden", "gray_blip"}
+    # The blip recovers: its second event restores factor 1.0.
+    blip = [e for e in events if e.cause == "gray_blip"]
+    assert len(blip) == 2 and blip[-1].factor == 1.0
+
+
+def test_exactly_one_incident_per_sustained_straggler(run):
+    # Two sustained gray failures (gradual + sudden), two incidents —
+    # the blip contributes NONE (persistence gate) and a latched flag
+    # never re-raises for the same degradation.
+    slow = _slowdowns(run)
+    assert len(slow) == 2
+    assert {i["cause"] for i in slow} == {"gray_gradual", "gray_sudden"}
+    for inc in slow:
+        assert inc["slowdown_ratio"] >= 1.5
+        assert inc["mechanism"] in ("drain", "quarantine", "observe")
+        # Every arm's pricing is recorded on the incident.
+        assert set(inc["arms"]) == {"observe", "drain", "quarantine"}
+
+
+def test_sustained_stragglers_get_drained(run):
+    # The cost model drains both: a severe straggler gates the whole
+    # synchronous fleet, so paying one host's capacity wins.
+    drained = [i for i in _slowdowns(run)
+               if i["mechanism"] in ("drain", "quarantine")]
+    assert len(drained) == 2
+    for inc in drained:
+        assert inc["proactive"]
+        assert inc["lost_hosts"] == 1
+        assert inc["detect_s"] > 0
+    assert len(run["detect_to_drain_s"]) == 2
+    # Detection is bounded by the ramp + persistence hysteresis, not by a
+    # heartbeat deadline that never fires for an alive host.
+    assert all(0 < d < 60.0 for d in run["detect_to_drain_s"])
+
+
+def test_goodput_reflects_the_gray_failures(run):
+    # Slow hosts gated the fleet until drained: goodput lands below a
+    # clean run but the drains keep it off the floor.
+    assert 0.5 < run["goodput_ratio"] < 1.0
+
+
+def test_slo_report_consumes_slowdown_incidents(run):
+    report = slo.slo_report(run)
+    assert report["incidents"] >= 2
+    assert report["recovery"]["p99_s"] is not None
+
+
+def test_straggler_run_is_deterministic():
+    def render():
+        scenario = make_scenario("straggler", seed=SEED, hosts=HOSTS,
+                                 duration_s=DURATION)
+        return slo.render(slo.slo_report(
+            SimCluster(SimConfig(hosts=HOSTS), scenario).run()))
+
+    assert render() == render()
