@@ -2,7 +2,7 @@
 
 PY ?= python
 
-.PHONY: lint analyze gen-registry test test-slow tier1 bench bench-diff trace-report ckpt-bench serve-bench pipeline-bench degrade-bench policy-bench sim-bench grow-bench overlap-bench master-bench goodput-bench
+.PHONY: lint analyze gen-registry test test-slow tier1 bench bench-diff trace-report ckpt-bench serve-bench pipeline-bench degrade-bench policy-bench sim-bench grow-bench overlap-bench master-bench goodput-bench pool-bench
 
 # Lint = the project-native analyzer (always available, stdlib-only)
 # plus ruff (config in pyproject.toml). Ruff degrades to a skip when not
@@ -138,3 +138,13 @@ goodput-bench:
 master-bench:
 	JAX_PLATFORMS=cpu OOBLECK_METRICS_DIR= \
 		$(PY) -m oobleck_tpu.elastic.master_bench
+
+# Shared chip pool: one full borrow/return cycle under a traffic_wave
+# chaos peak — serve pressure prices the peak as SLO debt, the arbiter
+# grants a lease off the training fleet (proactive drain, zero
+# respawns), and the chips ride the grow path home off-peak. Real
+# sockets + a real serve plane on a tiny model (also under bench.py's
+# "pool" key, diffed by bench --diff).
+pool-bench:
+	JAX_PLATFORMS=cpu OOBLECK_METRICS_DIR= \
+		$(PY) -m oobleck_tpu.pool.bench

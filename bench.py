@@ -782,6 +782,45 @@ def _goodput_summary() -> dict:
         return {"error": f"unparseable goodput bench output: {exc}"}
 
 
+POOL_BENCH_TIMEOUT_S = 240
+
+
+def _pool_summary() -> dict:
+    """Shared chip-pool cycle (oobleck_tpu/pool/bench.py) in a throwaway
+    CPU subprocess: a traffic_wave chaos peak pressures a real serve
+    plane, the arbiter leases a training chip (borrow latency, grant
+    broadcast), the victim drains with zero respawns, and release rides
+    the grow path home. Real sockets + a tiny model."""
+    env = dict(os.environ)
+    env.update({"JAX_PLATFORMS": "cpu", "PALLAS_AXON_POOL_IPS": "",
+                "OOBLECK_METRICS_DIR": ""})
+    env.pop(_INNER_ENV, None)
+    env.pop(_PIPELINE_ENV, None)
+    # The bench owns its pool knobs, journal dir, and wave directive; an
+    # ambient operator config must not leak into the measurement.
+    for knob in ("OOBLECK_MASTER_STATE_DIR", "OOBLECK_CHAOS",
+                 "OOBLECK_POOL", "OOBLECK_POOL_POLICY",
+                 "OOBLECK_POOL_LEASE_TTL_S", "OOBLECK_POOL_MIN_TRAIN_HOSTS",
+                 "OOBLECK_POOL_SWEEP_S", "OOBLECK_POOL_QUEUE_HIGH",
+                 "OOBLECK_POOL_TTFT_SLO_S", "OOBLECK_POOL_HYST"):
+        env.pop(knob, None)
+    proc = subprocess.Popen(
+        [sys.executable, "-m", "oobleck_tpu.pool.bench"],
+        stdout=subprocess.PIPE, stderr=subprocess.PIPE, text=True, env=env)
+    try:
+        out, err = proc.communicate(timeout=POOL_BENCH_TIMEOUT_S)
+    except subprocess.TimeoutExpired:
+        proc.kill()
+        return {"error": f"pool bench hung >{POOL_BENCH_TIMEOUT_S}s"}
+    if proc.returncode != 0:
+        tail = (err or "").strip().splitlines()[-1:] or ["no stderr"]
+        return {"error": f"pool bench exit {proc.returncode}: {tail[0][:160]}"}
+    try:
+        return json.loads(out.strip().splitlines()[-1])
+    except Exception as exc:  # noqa: BLE001
+        return {"error": f"unparseable pool bench output: {exc}"}
+
+
 def _analysis_summary() -> dict:
     """One oobleck-lint run over the tree: rule inventory plus finding
     counts, so the bench line records the static-analysis posture the
@@ -876,6 +915,13 @@ def _emit(result: dict) -> None:
         result["goodput"] = _goodput_summary()
     except Exception as exc:  # noqa: BLE001 — emit must never fail
         result["goodput"] = {"error": f"{type(exc).__name__}: {exc}"}
+    # Shared chip pool (borrow latency, peak serve attainment, training
+    # goodput retention through a lease cycle): CPU subprocess, real
+    # sockets, bounded, best-effort — see _pool_summary.
+    try:
+        result["pool"] = _pool_summary()
+    except Exception as exc:  # noqa: BLE001 — emit must never fail
+        result["pool"] = {"error": f"{type(exc).__name__}: {exc}"}
     # Static-analysis posture (oobleck_tpu/analysis): in-process, cheap.
     # `findings` counts NEW findings — anything nonzero means the tree
     # regressed against the lint gate, so the diff treats it lower-is-
@@ -923,7 +969,7 @@ DIFF_THRESHOLD = 0.05
 # throughput keys, so unit suffixes are matched as suffixes only.
 _HIGHER_BETTER = ("per_sec", "per_second", "speedup", "retention",
                   "throughput", "goodput", "agreement", "sustained",
-                  "hit_rate", "hidden_fraction")
+                  "hit_rate", "hidden_fraction", "attainment")
 _LOWER_BETTER = ("latency", "seconds", "ttft", "pause", "bubble", "stall",
                  "p50", "p90", "p99", "findings", "parse_errors", "regret",
                  "bytes_per_token", "abs_diff", "overhead")

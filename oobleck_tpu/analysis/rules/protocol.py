@@ -48,6 +48,10 @@ VERB_TO_ENGINE_KIND = {
     "DEGRADE": "degrade",
     "RESTORE": "restore",
     "GROW": "grow",
+    # Pool-plane lease verbs reuse the proven drain/grow engine paths:
+    # a grant is a proactive-drain-shaped DEGRADE, a reclaim is a GROW.
+    "LEASE_GRANT": "degrade",
+    "LEASE_RECLAIM": "grow",
 }
 # Verbs the worker/engine never sees (absorbed by the agent/master).
 CONTROL_PLANE_ONLY = {"SUCCESS", "FAILURE", "PONG", "FORWARD_COORDINATOR"}

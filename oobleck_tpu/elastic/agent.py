@@ -531,6 +531,21 @@ class OobleckAgent:
                 await self.on_grow(list(msg.get(JOINED_KEY) or ()),
                                    trace=spans.extract(msg),
                                    decision=msg.get(DECISION_KEY))
+            elif kind == ResponseType.LEASE_GRANT.value:
+                # Pool plane: one of our hosts is leased to another
+                # tenant. Same path as a proactive drain — the decision
+                # rides flagged proactive+inplace, so the victim drains
+                # (checkpoint flush, clean exit) and survivors reroute
+                # in place, zero respawns.
+                await self.on_reconfiguration(msg["lost_ip"], degrade=True,
+                                              trace=spans.extract(msg),
+                                              decision=msg.get(DECISION_KEY))
+            elif kind == ResponseType.LEASE_RECLAIM.value:
+                # Pool plane: leased chips flowing back — membership
+                # extends through the same grow path a JOIN batch rides.
+                await self.on_grow(list(msg.get(JOINED_KEY) or ()),
+                                   trace=spans.extract(msg),
+                                   decision=msg.get(DECISION_KEY))
             elif kind == ResponseType.FORWARD_COORDINATOR.value:
                 payload = {"kind": "coordinator", "address": msg["address"]}
                 if msg.get("world") is not None:

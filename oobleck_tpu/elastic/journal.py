@@ -61,6 +61,12 @@ EV_INCIDENT_OPEN = "incident_open"
 EV_INCIDENT_CLOSE = "incident_close"
 EV_JOB = "job"                    # job launched (args ride the entry)
 EV_JOB_DONE = "job_done"
+EV_LEASE = "lease"                # chip-lease transition (pool plane)
+
+# Tenant id stamped on entries from messages that predate TENANT_KEY.
+# EV_JOB/EV_JOB_DONE entries are keyed by tenant so replaying N jobs no
+# longer folds them last-writer-wins into one.
+DEFAULT_TENANT = "default"
 
 
 def state_dir() -> str | None:
@@ -174,7 +180,10 @@ class MasterJournal:
         ip = entry.get("ip")
         if kind == EV_REGISTER:
             if ip:
-                s["agents"][ip] = {"registered_at": entry.get("ts")}
+                s["agents"][ip] = {
+                    "registered_at": entry.get("ts"),
+                    "tenant": entry.get("tenant") or DEFAULT_TENANT,
+                }
         elif kind == EV_DEPART:
             s["agents"].pop(ip, None)
         elif kind == EV_FAILURE:
@@ -199,9 +208,27 @@ class MasterJournal:
         elif kind == EV_INCIDENT_CLOSE:
             s["open_incidents"].pop(entry.get("trace_id"), None)
         elif kind == EV_JOB:
-            s["job"] = entry.get("args")
+            # Keyed by tenant so N concurrent jobs replay as N jobs, not
+            # one last-writer-wins survivor. s["job"] stays a live mirror
+            # of the default tenant's entry for pre-pool readers.
+            tenant = entry.get("tenant") or DEFAULT_TENANT
+            s["jobs"][tenant] = entry.get("args")
+            if tenant == DEFAULT_TENANT:
+                s["job"] = entry.get("args")
         elif kind == EV_JOB_DONE:
-            s["job"] = None
+            tenant = entry.get("tenant") or DEFAULT_TENANT
+            s["jobs"].pop(tenant, None)
+            if tenant == DEFAULT_TENANT:
+                s["job"] = None
+        elif kind == EV_LEASE:
+            lease_id = entry.get("lease_id")
+            if lease_id:
+                if entry.get("state") == "active":
+                    s["leases"][lease_id] = {
+                        k: entry.get(k) for k in
+                        ("tenant", "lender", "hosts", "expires_at", "ts")}
+                else:  # returned / reclaimed / expired end the lease
+                    s["leases"].pop(lease_id, None)
 
     # -- compaction -------------------------------------------------------- #
 
@@ -259,7 +286,9 @@ def _empty_state() -> dict:
         "quarantined": {},     # ip -> entered ts
         "ewma": {},            # mechanism -> seconds
         "open_incidents": {},  # trace_id -> digest
-        "job": None,           # job args dict while one is running
+        "job": None,           # default tenant's job args (legacy mirror)
+        "jobs": {},            # tenant -> job args dict while running
+        "leases": {},          # lease_id -> {tenant, hosts, expires_at}
     }
 
 
@@ -270,4 +299,8 @@ def _merge_state(loaded: dict) -> dict:
     for k in s:
         if k in loaded and loaded[k] is not None:
             s[k] = loaded[k]
+    # Pre-multi-job snapshots carry only the single "job" slot: lift it
+    # into the tenant-keyed map so new readers see one default-tenant job.
+    if s["job"] is not None and not s["jobs"]:
+        s["jobs"] = {DEFAULT_TENANT: s["job"]}
     return s

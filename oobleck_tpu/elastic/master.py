@@ -42,7 +42,9 @@ from oobleck_tpu.elastic.message import (
     DEFAULT_PING_INTERVAL,
     EPOCH_KEY,
     JOINED_KEY,
+    LEASE_KEY,
     TELEMETRY_KEY,
+    TENANT_KEY,
     DistributionInfo,
     RequestType,
     ResponseType,
@@ -57,6 +59,9 @@ from oobleck_tpu.policy import PolicyEngine
 from oobleck_tpu.policy.engine import DECISION_KEY, MECH_DRAIN, \
     MECH_OBSERVE, MECH_QUARANTINE, MECH_REINSTANTIATE, MECH_REROUTE, \
     MECH_RESTORE
+from oobleck_tpu.pool import arbiter as pool_arbiter
+from oobleck_tpu.pool.leases import ST_EXPIRED, ST_RETURNED
+from oobleck_tpu.pool.tenants import KIND_SERVE, KIND_TRAIN, TenantSpec
 from oobleck_tpu.utils import metrics, recovery
 from oobleck_tpu.utils.chaos import chaos
 
@@ -217,6 +222,19 @@ class OobleckMasterDaemon:
         # a SLOWDOWN incident through the same classify -> policy chain
         # failures use.
         self.fleet = obs_fleet.FleetTracker()
+        # Shared chip-pool plane (oobleck_tpu/pool): serve<->train chip
+        # borrowing through leases, arbitrated by the same cost scorer
+        # the recovery planes use. Inert unless OOBLECK_POOL=1 — a
+        # single-job cluster keeps its exact pre-pool behavior.
+        self._train_tenant = (
+            os.environ.get(pool_arbiter.ENV_POOL_TENANT, "").strip()
+            or journal_mod.DEFAULT_TENANT)
+        self.pool: pool_arbiter.PoolArbiter | None = None
+        if pool_arbiter.pool_enabled():
+            self.pool = pool_arbiter.PoolArbiter()
+            self.pool.tenants.register(
+                TenantSpec(name=self._train_tenant, kind=KIND_TRAIN))
+        self._lease_sweep_task: asyncio.Task | None = None
         # Durable control-plane journal (OOBLECK_MASTER_STATE_DIR): the
         # master's own survival plane. None = journaling off (the pre-PR
         # in-memory-only behavior); epoch 0 means "no fence" to agents.
@@ -258,6 +276,9 @@ class OobleckMasterDaemon:
             "oobleck_master_slowdown_incidents_total",
             "SLOWDOWN incidents raised for gray-failing (alive but "
             "persistently slow) hosts")
+        self._m_lease_broadcasts = reg.counter(
+            "oobleck_master_lease_broadcasts_total",
+            "LEASE_GRANT / LEASE_RECLAIM broadcasts (pool plane)")
 
     # ------------------------------------------------------------------ #
 
@@ -270,6 +291,9 @@ class OobleckMasterDaemon:
         self.port = self._server.sockets[0].getsockname()[1]
         logger.info("master listening on :%d", self.port)
         self._start_metrics_endpoint()
+        if self.pool is not None:
+            self._lease_sweep_task = asyncio.ensure_future(
+                self._lease_sweep_loop())
         if self._expected_reattach:
             # A restarted master with a replayed fleet: give masterless
             # agents one reattach window before journal-vs-reality
@@ -317,6 +341,13 @@ class OobleckMasterDaemon:
                 self.job = None  # not brick the restart
         if self.job is not None:
             self._expected_reattach = set(state["agents"])
+        if self.pool is not None and state.get("leases"):
+            # Who holds whose chips survives the master: the lease book
+            # rehydrates from the replayed EV_LEASE entries and the sweep
+            # resumes exactly where the dead incarnation left off.
+            self.pool.leases.restore(state["leases"])
+            logger.warning("pool: %d active lease(s) restored from journal",
+                           len(self.pool.leases.active()))
         if restart:
             # The outage is itself an incident: one trace stitches the
             # restart → replay → reattached → reconciled phase marks (the
@@ -384,6 +415,9 @@ class OobleckMasterDaemon:
         if self._reconcile_task is not None:
             self._reconcile_task.cancel()
             self._reconcile_task = None
+        if self._lease_sweep_task is not None:
+            self._lease_sweep_task.cancel()
+            self._lease_sweep_task = None
         if self.journal is not None:
             self.journal.close()
 
@@ -479,6 +513,10 @@ class OobleckMasterDaemon:
             # MTBF estimates, and the last MAX_DECISIONS policy decisions.
             "policy": self.policy.status(),
             "control_plane": self._control_plane_status(),
+            # Always present so dashboards need no key probe; the full
+            # tenant/lease/decision block only when the plane is on.
+            "pool": (self.pool.status() if self.pool is not None
+                     else {"enabled": False}),
         }
 
     def _control_plane_status(self) -> dict:
@@ -628,6 +666,8 @@ class OobleckMasterDaemon:
             await self._handle_join(msg, reader, writer)
         elif kind == RequestType.REATTACH.value:
             await self._handle_reattach(msg, reader, writer)
+        elif kind == RequestType.POOL_BORROW.value:
+            await self._handle_pool_borrow(msg, writer)
         else:
             await send_response(writer, ResponseType.FAILURE,
                                 {"error": f"unexpected first message {kind}"})
@@ -656,7 +696,9 @@ class OobleckMasterDaemon:
             return
         self.job = args
         self._pending_ips = list(args.dist.node_ips)
-        self._journal(journal_mod.EV_JOB, args=args.to_dict())
+        # Tenant-keyed: N jobs replay as N jobs (journal.py EV_JOB).
+        self._journal(journal_mod.EV_JOB, args=args.to_dict(),
+                      tenant=self._train_tenant)
         await send_response(writer, ResponseType.SUCCESS)
         if self.launcher is not None and hasattr(self.launcher, "start_job"):
             self.launcher.start_job(args)
@@ -697,7 +739,8 @@ class OobleckMasterDaemon:
         )
         self.agents[ip] = info
         self._m_registrations.inc()
-        self._journal(journal_mod.EV_REGISTER, ip=ip)
+        self._journal(journal_mod.EV_REGISTER, ip=ip,
+                      tenant=self._train_tenant)
         # A re-registering host starts a fresh fleet-health life: stale
         # rows (and latched straggler flags) must not follow it in.
         self.fleet.clear(ip)
@@ -782,7 +825,8 @@ class OobleckMasterDaemon:
         )
         self.agents[ip] = info
         self._m_registrations.inc()
-        self._journal(journal_mod.EV_REGISTER, ip=ip)
+        self._journal(journal_mod.EV_REGISTER, ip=ip,
+                      tenant=self._train_tenant)
         self.fleet.clear(ip)
         # Expected-lifetime hint for the policy's amortization horizon: the
         # joiner may advertise one (spot instances know their own market),
@@ -857,6 +901,220 @@ class OobleckMasterDaemon:
         await self._broadcast_grow(joined, decision,
                                    include=list(self.agents.values()))
 
+    # -- shared chip pool (oobleck_tpu/pool) --------------------------- #
+
+    async def _handle_pool_borrow(self, msg, writer) -> None:
+        """POOL_BORROW: a serve replica group under traffic pressure asks
+        to borrow training chips — or returns a lease it holds (the one
+        verb covers both directions; the ``release`` key picks the
+        reclaim path). The request is an INCIDENT: it flows through the
+        arbiter's classify -> score -> broadcast chain exactly like a
+        host loss, and a granted borrow reuses the proven proactive-drain
+        path — the victim's worker flushes and exits cleanly (JOB_DONE,
+        zero respawns) while survivors reroute in place."""
+        if self.pool is None:
+            await send_response(
+                writer, ResponseType.FAILURE,
+                {"error": "pool plane disabled "
+                          f"(set {pool_arbiter.ENV_POOL}=1)"})
+            writer.close()
+            return
+        tenant = str(msg.get(TENANT_KEY) or "serve")
+        self.pool.tenants.register(TenantSpec(
+            name=tenant, kind=KIND_SERVE, slo=dict(msg.get("slo") or {})))
+        # Pressure is priced SERVE-SIDE (pool/pressure.py) and rides the
+        # request: the master never needs serve-plane scrape access.
+        pressure = msg.get("pressure") or {}
+        try:
+            slo_debt = max(float(pressure.get("slo_debt_s") or 0.0), 0.0)
+        except (TypeError, ValueError):
+            slo_debt = 0.0
+        try:
+            if msg.get("release"):
+                await self._pool_release(msg, writer, slo_debt)
+            else:
+                await self._pool_grant(msg, writer, tenant, slo_debt)
+        finally:
+            writer.close()
+
+    async def _pool_grant(self, msg, writer, tenant: str,
+                          slo_debt: float) -> None:
+        if self.job is None:
+            await send_response(writer, ResponseType.FAILURE,
+                                {"error": "no job configured"})
+            return
+        chips = max(int(msg.get("chips") or 1), 1)
+        leased = self.pool.leases.leased_hosts()
+        train_hosts = len([ip for ip in self.agents if ip not in leased])
+        ttl: float | None = None
+        if msg.get("lease_ttl_s") is not None:
+            try:
+                ttl = float(msg["lease_ttl_s"]) or None
+            except (TypeError, ValueError):
+                ttl = None
+        # The live master keeps no standing spare pool — every registered
+        # host is training — so drain-vs-deny is the live decision;
+        # deployments with spares score them in the sim.
+        decision = self.pool.decide_borrow(
+            tenant, chips,
+            train_hosts=train_hosts,
+            spare_hosts=0,
+            slo_debt_s=slo_debt,
+            lease_ttl_s=ttl,
+            lender=self._train_tenant,
+            cause=str(msg.get("cause") or "pressure"),
+        )
+        if decision.mechanism != pool_arbiter.MECH_BORROW_DRAIN:
+            # deny, or a forced spare arm that is infeasible live.
+            await send_response(writer, ResponseType.FAILURE, {
+                "error": f"borrow denied ({decision.reason})",
+                DECISION_KEY: decision.as_payload()})
+            return
+        victims = self._pick_lease_hosts(chips)
+        if len(victims) < chips:
+            await send_response(writer, ResponseType.FAILURE, {
+                "error": f"not enough leasable hosts "
+                         f"({len(victims)}/{chips})",
+                DECISION_KEY: decision.as_payload()})
+            return
+        ttl = ttl if ttl is not None else self.pool.lease_ttl_s
+        lease = self.pool.leases.grant(
+            tenant, victims, ttl, lender=self._train_tenant,
+            trace_id=decision.trace_id or "")
+        decision.hosts = list(victims)
+        decision.lease_id = lease.lease_id
+        # WAL before the fleet learns anything: a master that dies past
+        # this line restarts knowing who holds whose chips.
+        self._journal(journal_mod.EV_LEASE, lease_id=lease.lease_id,
+                      state="active", tenant=tenant,
+                      lender=self._train_tenant, hosts=list(victims),
+                      expires_at=lease.expires_at)
+        self._journal(journal_mod.EV_INCIDENT_OPEN,
+                      trace_id=decision.trace_id,
+                      lost_ip=",".join(victims), cause="pool_borrow")
+        with self._snap_lock:
+            self._recoveries.append({
+                "lost_ip": ",".join(victims), "cause": "pool_borrow",
+                "trace_id": decision.trace_id,
+                "detected_at": decision.decided_at,
+                "broadcast_at": None, "resolved_at": None,
+            })
+        fr = metrics.flight_recorder()
+        fr.record("lease_granted", lease_id=lease.lease_id, tenant=tenant,
+                  hosts=",".join(victims), ttl_s=ttl,
+                  trace_id=decision.trace_id)
+        fr.dump(f"lease_granted:{lease.lease_id}")
+        # Cross-tenant attribution: the LENDER pays the projected
+        # degraded-training seconds, charged under the arbiter's
+        # incident trace so the incident file can total the bill.
+        self.pool.tenants.attribute(
+            decision.trace_id or "",
+            {self._train_tenant: decision.projected_cost_s or 0.0},
+            cause="pool_borrow")
+        for ip in victims:
+            victim = self.agents.get(ip)
+            if victim is not None:
+                # The drained worker's departure is a clean JOB_DONE
+                # exit, not a second incident.
+                victim.clean_exit = True
+            await self._broadcast_lease_grant(ip, lease, decision)
+            # Its telemetry row describes a training life that just
+            # ended; returning via JOIN starts a fresh one.
+            self.fleet.clear(ip)
+        await send_response(writer, ResponseType.SUCCESS,
+                            {LEASE_KEY: lease.as_record(),
+                             DECISION_KEY: decision.as_payload()})
+
+    async def _pool_release(self, msg, writer, slo_debt: float) -> None:
+        """Early return: the borrower's peak passed. The arbiter still
+        scores hold-vs-reclaim (a forced ``hold`` baseline extends the
+        lease instead), and a reclaim flows the hosts back through the
+        grow path."""
+        lease_id = str(msg.get("release"))
+        lease = self.pool.leases.get(lease_id)
+        if lease is None:
+            await send_response(writer, ResponseType.FAILURE,
+                                {"error": f"unknown lease {lease_id}"})
+            return
+        leased = self.pool.leases.leased_hosts()
+        train_hosts = len([ip for ip in self.agents if ip not in leased])
+        decision = self.pool.decide_reclaim(
+            lease, train_hosts=train_hosts, slo_debt_s=slo_debt,
+            cause="release")
+        if decision.mechanism == pool_arbiter.MECH_HOLD:
+            extended = self.pool.leases.extend(lease_id,
+                                               self.pool.lease_ttl_s)
+            self._journal(journal_mod.EV_LEASE, lease_id=lease_id,
+                          state="active", tenant=lease.tenant,
+                          lender=lease.lender, hosts=list(lease.hosts),
+                          expires_at=extended.expires_at)
+            await send_response(writer, ResponseType.SUCCESS,
+                                {LEASE_KEY: extended.as_record(),
+                                 DECISION_KEY: decision.as_payload()})
+            return
+        ended = self.pool.leases.end(lease_id, ST_RETURNED)
+        self._journal(journal_mod.EV_LEASE, lease_id=lease_id,
+                      state=ST_RETURNED, tenant=ended.tenant)
+        # Cross-tenant bill under ONE trace: the borrower pays whatever
+        # pressure it still carries (re-exposure), the lender pays the
+        # projected grow-absorption cost of taking the chips back.
+        self.pool.tenants.attribute(
+            decision.trace_id or "",
+            {ended.tenant: slo_debt,
+             ended.lender: decision.projected_cost_s or 0.0},
+            cause="pool_release")
+        metrics.flight_recorder().record(
+            "lease_released", lease_id=lease_id, tenant=ended.tenant,
+            hosts=",".join(ended.hosts), trace_id=decision.trace_id)
+        await self._broadcast_lease_reclaim(ended, decision)
+        await send_response(writer, ResponseType.SUCCESS,
+                            {LEASE_KEY: ended.as_record(),
+                             DECISION_KEY: decision.as_payload()})
+
+    def _pick_lease_hosts(self, chips: int) -> list[str]:
+        """Lease victims: most recently registered first (least pipeline
+        seniority), never the coordinator host, never a host already out
+        on a lease."""
+        coord_ip = (self.coordinator or "").rsplit(":", 1)[0]
+        leased = self.pool.leases.leased_hosts()
+        return [ip for ip in reversed(list(self.agents))
+                if ip not in leased and ip != coord_ip][:chips]
+
+    async def _lease_sweep_loop(self) -> None:
+        """Lease expiry is an incident, not a timer: every sweep feeds
+        due leases to the arbiter, which scores hold-vs-reclaim with the
+        same cost model. Pressure only ever rides POOL_BORROW requests,
+        so no renewal arriving before expiry IS the off-peak signal: a
+        due lease carries zero debt and its chips flow back through the
+        grow path."""
+        period = pool_arbiter.sweep_period_s()
+        while True:
+            await asyncio.sleep(period)
+            for lease in self.pool.leases.due():
+                leased = self.pool.leases.leased_hosts()
+                train_hosts = len(
+                    [ip for ip in self.agents if ip not in leased])
+                decision = self.pool.decide_reclaim(
+                    lease, train_hosts=train_hosts, cause="expiry")
+                if decision.mechanism == pool_arbiter.MECH_HOLD:
+                    # Unreachable under adaptive scoring (an expired
+                    # lease makes hold infeasible) but a future forced
+                    # baseline must extend, not leak the lease.
+                    self.pool.leases.extend(lease.lease_id,
+                                            self.pool.lease_ttl_s)
+                    continue
+                ended = self.pool.leases.end(lease.lease_id, ST_EXPIRED)
+                if ended is None:
+                    continue
+                self._journal(journal_mod.EV_LEASE,
+                              lease_id=ended.lease_id, state=ST_EXPIRED,
+                              tenant=ended.tenant)
+                self.pool.tenants.attribute(
+                    decision.trace_id or "",
+                    {ended.lender: decision.projected_cost_s or 0.0},
+                    cause="pool_expiry")
+                await self._broadcast_lease_reclaim(ended, decision)
+
     async def _handle_reattach(self, msg, reader, writer) -> None:
         """Post-outage re-attachment: an agent that rode out a master
         outage in masterless mode re-dials the restarted master. Its
@@ -903,7 +1161,10 @@ class OobleckMasterDaemon:
         self._m_reattaches.inc()
         self._reattached.add(ip)
         self._reattached_total += 1
-        self._journal(journal_mod.EV_REGISTER, ip=ip)
+        # Tenant-stamped like every registration: the reconciled fleet
+        # must replay into the same tenant the job entry is keyed by.
+        self._journal(journal_mod.EV_REGISTER, ip=ip,
+                      tenant=self._train_tenant)
         worker_alive = bool(msg.get("worker_alive", True))
         metrics.flight_recorder().record(
             "reattach", ip=ip, last_epoch=last_epoch,
@@ -1234,11 +1495,14 @@ class OobleckMasterDaemon:
             agent.writer.close()
             self._journal(journal_mod.EV_DEPART, ip=ip)
         if agent is not None and agent.clean_exit:
-            if not self.agents:
+            if not self.agents and not (
+                    self.pool is not None and self.pool.leases.active()):
                 # The last agent's clean exit closes the job in the
                 # journal: a later master restart must not wait for a
-                # completed fleet to reattach.
-                self._journal(journal_mod.EV_JOB_DONE)
+                # completed fleet to reattach. A lease-drained fleet is
+                # NOT a completed job — chips out on loan come back.
+                self._journal(journal_mod.EV_JOB_DONE,
+                              tenant=self._train_tenant)
             return
         # Adaptive policy (oobleck_tpu/policy): score reroute /
         # reinstantiate / restore from live signals and broadcast the
@@ -1362,6 +1626,96 @@ class OobleckMasterDaemon:
         fr.record("grow_broadcast", joined_ips=",".join(joined_ips),
                   agents=len(self.agents), mechanism=decision.mechanism)
         fr.dump(f"grow_broadcast:{'+'.join(joined_ips)}")
+
+    async def _broadcast_lease_grant(self, ip: str, lease,
+                                     decision) -> None:
+        """LEASE_GRANT rides the proactive-drain DEGRADE shape: the verb
+        carries the arbiter decision flagged proactive (the victim
+        drains — checkpoint flush, clean exit) and inplace (survivors
+        reroute at a step boundary, zero respawns), plus the lease
+        record under LEASE_KEY. Legacy agents fall back to
+        RECONFIGURATION semantics (message.py), so a mixed fleet still
+        converges."""
+        broadcast_at = time.time()
+        with self._snap_lock:
+            for r in self._recoveries:
+                if (r.get("trace_id") == decision.trace_id
+                        and r["broadcast_at"] is None):
+                    r["broadcast_at"] = broadcast_at
+                    r["mechanism"] = decision.mechanism
+        wire_decision = dict(decision.as_payload(),
+                             proactive=True, inplace=True)
+        payload: dict = {"lost_ip": ip, DECISION_KEY: wire_decision}
+        payload[LEASE_KEY] = lease.as_record()
+        if self.master_epoch:
+            payload[EPOCH_KEY] = self.master_epoch
+        if decision.trace_id:
+            payload[spans.TRACE_KEY] = {
+                "trace_id": decision.trace_id,
+                "detected_at": decision.decided_at,
+                "broadcast_at": broadcast_at,
+                "cause": "pool_borrow",
+            }
+            spans.span_recorder().record(
+                "incident.broadcast", broadcast_at, broadcast_at,
+                trace_id=decision.trace_id, lost_ip=ip,
+                verb=ResponseType.LEASE_GRANT.value,
+                mechanism=decision.mechanism, survivors=len(self.agents))
+        for other in list(self.agents.values()):
+            try:
+                await send_response(other.writer,
+                                    ResponseType.LEASE_GRANT, payload)
+            except ConnectionError:
+                pass
+        self._m_lease_broadcasts.inc(verb=ResponseType.LEASE_GRANT.value)
+        fr = metrics.flight_recorder()
+        fr.record("lease_grant_broadcast", lost_ip=ip,
+                  lease_id=lease.lease_id, tenant=lease.tenant,
+                  mechanism=decision.mechanism)
+        fr.dump(f"lease_grant_broadcast:{ip}")
+        recovery.mark(recovery.BROADCAST, lost_ip=ip,
+                      survivors=len(self.agents))
+
+    async def _broadcast_lease_reclaim(self, lease, decision) -> None:
+        """LEASE_RECLAIM rides the GROW shape: the returning hosts
+        travel under JOINED_KEY so agents extend membership through
+        on_grow, while the host processes themselves re-enter through
+        the normal JOIN/grow machinery (relaunching the returned host's
+        agent is the deployer's job, exactly as for any grown host). The
+        empty lost_ip satisfies the shared broadcast core-key
+        contract."""
+        broadcast_at = time.time()
+        payload: dict = {"lost_ip": "", DECISION_KEY: decision.as_payload()}
+        payload[JOINED_KEY] = list(lease.hosts)
+        payload[LEASE_KEY] = lease.as_record()
+        if self.master_epoch:
+            payload[EPOCH_KEY] = self.master_epoch
+        if decision.trace_id:
+            payload[spans.TRACE_KEY] = {
+                "trace_id": decision.trace_id,
+                "detected_at": decision.decided_at,
+                "broadcast_at": broadcast_at,
+                "cause": f"pool_{lease.state}",
+            }
+            spans.span_recorder().record(
+                "incident.broadcast", broadcast_at, broadcast_at,
+                trace_id=decision.trace_id,
+                joined_ips=",".join(lease.hosts),
+                verb=ResponseType.LEASE_RECLAIM.value,
+                mechanism=decision.mechanism, agents=len(self.agents))
+        for other in list(self.agents.values()):
+            try:
+                await send_response(other.writer,
+                                    ResponseType.LEASE_RECLAIM, payload)
+            except ConnectionError:
+                pass
+        self._m_lease_broadcasts.inc(
+            verb=ResponseType.LEASE_RECLAIM.value)
+        fr = metrics.flight_recorder()
+        fr.record("lease_reclaim_broadcast", lease_id=lease.lease_id,
+                  hosts=",".join(lease.hosts), state=lease.state,
+                  mechanism=decision.mechanism)
+        fr.dump(f"lease_reclaim_broadcast:{lease.lease_id}")
 
 
 async def _amain(port: int, launcher: str, username: str | None,

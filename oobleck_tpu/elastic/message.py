@@ -89,6 +89,17 @@ class RequestType(str, Enum):
     # to plain REGISTER_AGENT (which the old master treats as a fresh
     # bring-up — slower, never wrong).
     REATTACH = "reattach"
+    # Chip-pool borrow/release ({"tenant", "chips", optional "reason",
+    # "pressure", "lease_ttl_s"} or {"tenant", "release": lease_id}): a
+    # serve replica group under traffic pressure asks the pool arbiter
+    # for leased training chips, or returns a lease early once the peak
+    # passes. First message on a fresh connection (like LAUNCH_JOB), one
+    # SUCCESS/FAILURE answer carrying the lease (LEASE_KEY) or the
+    # arbiter's denial reason. Masters that predate the verb (or run with
+    # the pool plane disabled) answer FAILURE — the requester backs off
+    # and sheds load through its own admission queue, which is exactly
+    # the pre-pool behavior.
+    POOL_BORROW = "pool_borrow"
 
 
 class ResponseType(str, Enum):
@@ -120,6 +131,24 @@ class ResponseType(str, Enum):
     # old survivor simply keeps training at the old size, which is safe —
     # capacity absorption degrades to a no-op, never to an outage.
     GROW = "grow"
+    # Lease-grant verb: the pool arbiter leased a training host's chips to
+    # another tenant (a serve replica group at a traffic peak). Payload is
+    # the preemption-pattern DEGRADE shape — "lost_ip" names the leased
+    # host, the policy decision rides DECISION_KEY with proactive=True so
+    # the victim drains through a checkpoint flush (zero respawns) while
+    # survivors reroute in place — plus LEASE_KEY describing the lease.
+    # Receivers that predate the verb funnel it to the same recovery entry
+    # point as DEGRADE (the engine tries reroute first anyway), which is
+    # correct: to a pre-pool agent a leased-away host is just a proactive
+    # departure.
+    LEASE_GRANT = "lease_grant"
+    # Lease-reclaim verb: a lease ended (returned early, expired, or
+    # reclaimed off-peak) and the chips come back to training through the
+    # grow path. Payload is the GROW shape — "lost_ip": "", JOINED_KEY
+    # lists the returning hosts — plus LEASE_KEY naming the closed lease.
+    # Receivers that predate the verb IGNORE it, same safe degradation as
+    # GROW: the fleet keeps training at the smaller size.
+    LEASE_RECLAIM = "lease_reclaim"
     FORWARD_COORDINATOR = "forward_coordinator"
 
 
@@ -145,6 +174,20 @@ TELEMETRY_KEY = "telemetry"
 # receivers ignore the key (untagged trust, the pre-fence behavior); a
 # named constant per the TRACE_KEY/DECISION_KEY legacy-tolerance pattern.
 EPOCH_KEY = "master_epoch"
+
+# Payload key carrying a chip lease record (pool/leases.py as_record():
+# lease_id, tenant, hosts, granted_at, expires_at, state) on the
+# POOL_BORROW answer and the LEASE_GRANT / LEASE_RECLAIM broadcasts.
+# Legacy receivers ignore the key — the broadcasts are self-sufficient
+# DEGRADE/GROW shapes without it; a named constant per the TRACE_KEY/
+# DECISION_KEY legacy-tolerance pattern.
+LEASE_KEY = "lease"
+
+# Payload key naming the tenant a message acts for: stamped on
+# POOL_BORROW requests and on the journal's per-tenant EV_JOB entries so
+# replay can keep N jobs apart instead of folding them last-writer-wins.
+# Absent means the single-job default tenant — every pre-pool message.
+TENANT_KEY = "tenant"
 
 
 @dataclass

@@ -75,9 +75,15 @@ def score_arms(arms: dict[str, ArmSignals], *,
         lost_work = arm.lost_work_s
         degraded = (1.0 - min(arm.retention, 1.0)) * t_amort
         churn = risk * restore_total if arm.in_memory else 0.0
+        # Cross-tenant terms (zero on single-tenant arms): SLO debt the
+        # pressured tenant keeps paying under arms that don't relieve it,
+        # and the preemption cost charged to a tenant whose running
+        # capacity an arm takes away (pool/arbiter.py).
+        slo_debt = max(arm.slo_debt_s, 0.0)
+        preempt = max(arm.preempt_cost_s, 0.0)
         scored[name] = ScoredArm(
             mechanism=name,
-            cost_s=latency + lost_work + degraded + churn,
+            cost_s=latency + lost_work + degraded + churn + slo_debt + preempt,
             feasible=arm.feasible,
             reason=arm.reason,
             breakdown={
@@ -85,6 +91,8 @@ def score_arms(arms: dict[str, ArmSignals], *,
                 "lost_work_s": lost_work,
                 "degraded_s": degraded,
                 "churn_risk_s": churn,
+                "slo_debt_s": slo_debt,
+                "preempt_cost_s": preempt,
                 "t_amort_s": t_amort,
                 "risk": risk,
             },

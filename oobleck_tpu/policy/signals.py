@@ -54,6 +54,18 @@ PRIOR_LATENCY_S = {
     "observe": 0.0,
     "drain": 2.0,
     "quarantine": 2.0,
+    # Pool-arbitration arms (cross-tenant borrow/reclaim incidents,
+    # pool/arbiter.py). deny/hold change nothing (their cost is the SLO
+    # debt the pressured tenant keeps paying); borrow_spare hands over
+    # parked capacity (bookkeeping); borrow_drain preempts a training
+    # host through the proactive drain + checkpoint flush (priced like
+    # the slowdown drain plus serve-side attach); reclaim_grow returns
+    # leased chips to training through the JOIN/grow path.
+    "deny": 0.0,
+    "borrow_spare": 0.1,
+    "borrow_drain": 2.5,
+    "hold": 0.0,
+    "reclaim_grow": 1.2,
 }
 # Step-time prior when no measured step seconds are available yet (only
 # used to price checkpoint staleness in lost-work seconds).
@@ -142,6 +154,12 @@ class ArmSignals:
     feasible: bool = True
     reason: str = ""               # why infeasible ("" when feasible)
     prior_source: str = ""         # "hardcoded" | "learned:<path>" | ""
+    # Cross-tenant terms (pool arbitration; zero on single-tenant arms).
+    # slo_debt_s rides arms that leave a pressured tenant's SLO unrelieved
+    # (deny a borrow, reclaim under live pressure); preempt_cost_s rides
+    # arms that take running capacity away from a tenant (borrow_drain).
+    slo_debt_s: float = 0.0
+    preempt_cost_s: float = 0.0
 
     def as_record(self) -> dict:
         return {
@@ -150,6 +168,8 @@ class ArmSignals:
             "prior_source": self.prior_source,
             "retention": round(self.retention, 6),
             "lost_work_s": round(self.lost_work_s, 6),
+            "slo_debt_s": round(self.slo_debt_s, 6),
+            "preempt_cost_s": round(self.preempt_cost_s, 6),
             "feasible": self.feasible,
             "reason": self.reason,
         }
@@ -384,3 +404,119 @@ def build_slowdown_arms(*,
     if host_failures < 1:
         quarantine.feasible, quarantine.reason = False, "no_failure_history"
     return {"observe": observe, "drain": drain, "quarantine": quarantine}
+
+
+def build_borrow_arms(*,
+                      chips: int,
+                      train_hosts: int,
+                      spare_hosts: int = 0,
+                      min_train_hosts: int = 1,
+                      slo_debt_s: float = 0.0,
+                      drain_cost_s: float | None = None,
+                      latency_overrides: dict[str, float] | None = None,
+                      registry=None,
+                      priors_path: str | None = None
+                      ) -> dict[str, ArmSignals]:
+    """Assemble the three BORROW arms for one cross-tenant pressure incident
+    (a serve replica group asking the pool arbiter for `chips` hosts).
+
+    The cross-tenant asymmetry lives in two terms: *deny* leaves training
+    whole (retention 1.0) but the pressured tenant keeps paying its SLO
+    debt — ``slo_debt_s`` is the requester's projected seconds of
+    deadline-missed work over the amortization window, charged to every
+    arm that does NOT relieve the pressure. *borrow_spare* relieves it
+    from parked capacity (nobody pays); *borrow_drain* relieves it by
+    preempting training hosts through the proven proactive-drain path —
+    the training tenant pays ``preempt_cost_s`` (the drain + checkpoint
+    flush, measured when history exists) plus degraded retention for the
+    lease's remaining lifetime (the caller passes that lifetime as the
+    scorer's ``mtbf_s`` so the amortization window IS the lease). deny is
+    always feasible: the arbiter can always say no, and the requester
+    sheds load through its own admission queue."""
+    n, k = max(int(train_hosts), 0), max(int(chips), 1)
+    survivor_frac = ((n - k) / n) if n else 0.0
+
+    deny = ArmSignals(
+        mechanism="deny",
+        latency_s=0.0, latency_source="",
+        retention=1.0,
+        in_memory=False,
+        slo_debt_s=max(float(slo_debt_s), 0.0),
+    )
+    deny.latency_s, deny.latency_source, deny.prior_source = _latency(
+        "deny", "deny", latency_overrides, registry, priors_path)
+
+    spare = ArmSignals(
+        mechanism="borrow_spare",
+        latency_s=0.0, latency_source="",
+        retention=1.0,
+        in_memory=False,
+    )
+    spare.latency_s, spare.latency_source, spare.prior_source = _latency(
+        "borrow_spare", "borrow_spare", latency_overrides, registry,
+        priors_path)
+    if int(spare_hosts) < k:
+        spare.feasible, spare.reason = False, "no_spare_capacity"
+
+    drain = ArmSignals(
+        mechanism="borrow_drain",
+        latency_s=0.0, latency_source="",
+        retention=survivor_frac,
+        in_memory=False,
+    )
+    drain.latency_s, drain.latency_source, drain.prior_source = _latency(
+        "borrow_drain", "borrow_drain", latency_overrides, registry,
+        priors_path)
+    drain.preempt_cost_s = (float(drain_cost_s) if drain_cost_s is not None
+                            else drain.latency_s)
+    if n - k < max(int(min_train_hosts), 0):
+        drain.feasible, drain.reason = False, "train_floor"
+    return {"deny": deny, "borrow_spare": spare, "borrow_drain": drain}
+
+
+def build_reclaim_arms(*,
+                       leased_hosts: int,
+                       train_hosts: int,
+                       slo_debt_s: float = 0.0,
+                       lease_expired: bool = False,
+                       latency_overrides: dict[str, float] | None = None,
+                       registry=None,
+                       priors_path: str | None = None
+                       ) -> dict[str, ArmSignals]:
+    """Assemble the two RECLAIM arms for one lease-end decision (off-peak
+    sweep, early release, or expiry).
+
+    *hold* keeps the lease with the borrower: training stays degraded
+    (retention = its shrunken fraction, amortized over the remaining
+    lease passed as ``mtbf_s``) but a borrower still under pressure pays
+    nothing — infeasible once the lease has expired, since a lease that
+    never ends is an allocation. *reclaim_grow* returns the chips to
+    training through the JOIN/grow path; if the borrower's pressure has
+    NOT passed, its ``slo_debt_s`` rides this arm (reclaiming re-exposes
+    the borrower to the peak), which is what makes the arbiter hold
+    through the peak and reclaim off-peak."""
+    n, k = max(int(train_hosts), 0), max(int(leased_hosts), 1)
+    degraded_frac = (n / (n + k)) if (n + k) else 1.0
+
+    hold = ArmSignals(
+        mechanism="hold",
+        latency_s=0.0, latency_source="",
+        retention=degraded_frac,
+        in_memory=False,
+    )
+    hold.latency_s, hold.latency_source, hold.prior_source = _latency(
+        "hold", "hold", latency_overrides, registry, priors_path)
+    if lease_expired:
+        hold.feasible, hold.reason = False, "lease_expired"
+
+    reclaim = ArmSignals(
+        mechanism="reclaim_grow",
+        latency_s=0.0, latency_source="",
+        retention=1.0,
+        in_memory=False,
+        slo_debt_s=max(float(slo_debt_s), 0.0),
+    )
+    reclaim.latency_s, reclaim.latency_source, reclaim.prior_source = \
+        _latency("reclaim_grow", "reclaim_grow", latency_overrides,
+                 registry, priors_path)
+    return {"hold": hold, "reclaim_grow": reclaim}

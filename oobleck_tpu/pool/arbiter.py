@@ -1,0 +1,357 @@
+"""PoolArbiter: one auditable decision per cross-tenant incident.
+
+The pool's decision engine is deliberately the policy plane's shape
+(policy/engine.py) pointed across tenants: build arms, score them with
+the SAME churn-aware cost model — extended with the cross-tenant
+SLO-debt and preemption-cost terms — pick the cheapest feasible, record
+the roads not taken. A serve traffic peak is an *incident* exactly like
+a host loss or a JOIN: classify (borrow vs reclaim direction), score,
+broadcast — so the forensics, forced-mode baselines, and the
+projected-vs-measured feedback loop all come for free.
+
+Two deliberate asymmetries with the single-tenant engine:
+
+* The amortization horizon is the LEASE, not the MTBF: a borrow's
+  degraded-training term runs until the lease ends (the chips come
+  back), so a short lease makes borrowing cheap and a long one makes
+  the arbiter think twice — ``score_arms(mtbf_s=lease_ttl)``.
+* ``deny`` (borrow) and ``reclaim_grow`` (reclaim) are the directions'
+  always-feasible fallbacks: the arbiter can always say no, and an
+  ended lease can always flow back through the grow path.
+
+``OOBLECK_POOL_POLICY=deny|borrow_spare|borrow_drain|hold|reclaim_grow``
+forces a fixed arm (benchmark baselines, same contract as
+``OOBLECK_POLICY``); a forced arm pins only its own direction.
+"""
+
+from __future__ import annotations
+
+import collections
+import logging
+import os
+import time
+from dataclasses import dataclass, field
+
+from oobleck_tpu.obs import spans
+from oobleck_tpu.policy.scorer import cheapest_feasible, score_arms
+from oobleck_tpu.policy.signals import build_borrow_arms, build_reclaim_arms
+from oobleck_tpu.pool.leases import ChipLease, LeaseBook
+from oobleck_tpu.pool.tenants import TenantRegistry
+from oobleck_tpu.utils import metrics
+
+logger = logging.getLogger("oobleck.pool")
+
+ENV_POOL = "OOBLECK_POOL"                      # "1" enables the plane
+ENV_POOL_POLICY = "OOBLECK_POOL_POLICY"        # forced arm
+ENV_POOL_TENANT = "OOBLECK_POOL_TENANT"        # training tenant's name
+ENV_LEASE_TTL = "OOBLECK_POOL_LEASE_TTL_S"
+ENV_MIN_TRAIN_HOSTS = "OOBLECK_POOL_MIN_TRAIN_HOSTS"
+ENV_SWEEP = "OOBLECK_POOL_SWEEP_S"             # lease-sweep cadence
+
+DEFAULT_LEASE_TTL_S = 60.0
+DEFAULT_MIN_TRAIN_HOSTS = 1
+DEFAULT_SWEEP_S = 5.0
+
+# Borrow-direction arms.
+MECH_DENY = "deny"
+MECH_BORROW_SPARE = "borrow_spare"
+MECH_BORROW_DRAIN = "borrow_drain"
+# Reclaim-direction arms.
+MECH_HOLD = "hold"
+MECH_RECLAIM_GROW = "reclaim_grow"
+MODE_ADAPTIVE = "adaptive"
+BORROW_MODES = (MECH_DENY, MECH_BORROW_SPARE, MECH_BORROW_DRAIN)
+RECLAIM_MODES = (MECH_HOLD, MECH_RECLAIM_GROW)
+POOL_MODES = (MODE_ADAPTIVE,) + BORROW_MODES + RECLAIM_MODES
+
+# Decisions kept for /status (bounded like the policy engine's log).
+MAX_DECISIONS = 16
+EWMA_ALPHA = 0.5
+
+
+def pool_enabled() -> bool:
+    """Whether the pool plane is on (``OOBLECK_POOL=1``). Inert default:
+    a single-job cluster keeps its exact pre-pool behavior."""
+    return os.environ.get(ENV_POOL, "").strip() == "1"
+
+
+def _env_float(name: str, default: float) -> float:
+    raw = os.environ.get(name, "")
+    try:
+        return float(raw) if raw else default
+    except ValueError:
+        return default
+
+
+def sweep_period_s() -> float:
+    """Lease-sweep cadence for the master's reclaim loop (floored so a
+    zero/garbage knob cannot spin the event loop)."""
+    return max(_env_float(ENV_SWEEP, DEFAULT_SWEEP_S), 0.05)
+
+
+@dataclass
+class PoolDecision:
+    """What the arbiter chose for one cross-tenant incident."""
+
+    direction: str                  # "borrow" | "reclaim"
+    mechanism: str
+    tenant: str                     # the requesting / borrowing tenant
+    lender: str = "default"
+    chips: int = 0
+    hosts: list = field(default_factory=list)   # filled when granted
+    lease_id: str = ""
+    reason: str = "cheapest"
+    projected_cost_s: float | None = None
+    measured_s: float | None = None
+    costs: dict = field(default_factory=dict)
+    infeasible: dict = field(default_factory=dict)
+    arms: dict = field(default_factory=dict)
+    slo_debt_s: float = 0.0
+    horizon_s: float | None = None  # lease lifetime the scoring amortized
+    trace_id: str | None = None
+    decided_at: float = field(default_factory=time.time)
+
+    def as_payload(self) -> dict:
+        """Compact dict for the POOL_BORROW answer and /status log."""
+        return {
+            "direction": self.direction,
+            "mechanism": self.mechanism,
+            "tenant": self.tenant,
+            "lender": self.lender,
+            "chips": self.chips,
+            "hosts": list(self.hosts),
+            "lease_id": self.lease_id,
+            "reason": self.reason,
+            "projected_cost_s": self.projected_cost_s,
+            "measured_s": self.measured_s,
+            "costs": {m: round(c, 6) for m, c in self.costs.items()},
+            "infeasible": dict(self.infeasible),
+            "slo_debt_s": round(self.slo_debt_s, 6),
+            "horizon_s": self.horizon_s,
+            "trace_id": self.trace_id,
+            "decided_at": self.decided_at,
+        }
+
+    def as_record(self) -> dict:
+        rec = self.as_payload()
+        rec["arms"] = dict(self.arms)
+        return rec
+
+    def record(self) -> None:
+        """Flight-record the decision and bump the oobleck_pool_* family
+        in one call, so the two views cannot disagree."""
+        metrics.flight_recorder().record("pool_decision", **self.as_record())
+        reg = metrics.registry()
+        reg.counter(
+            "oobleck_pool_decisions_total",
+            "Pool-arbiter decisions by direction, mechanism and reason",
+        ).inc(direction=self.direction, mechanism=self.mechanism,
+              reason=self.reason)
+        if self.projected_cost_s is not None:
+            reg.gauge(
+                "oobleck_pool_projected_cost_seconds",
+                "Projected cost of the last pool-arbiter decision",
+            ).set(self.projected_cost_s, mechanism=self.mechanism)
+
+
+class PoolArbiter:
+    """Cross-tenant decision engine + the tenant/lease state it arbitrates.
+
+    Owns a TenantRegistry and a LeaseBook (both injectable — the sim
+    passes its own clock so runs are hermetic); the master owns the
+    wire, the journal entries, and the broadcasts."""
+
+    def __init__(self, *, tenants: TenantRegistry | None = None,
+                 leases: LeaseBook | None = None,
+                 registry=None, clock=time.time,
+                 mode: str | None = None,
+                 lease_ttl_s: float | None = None,
+                 min_train_hosts: int | None = None,
+                 priors_path: str | None = None):
+        if mode is None:
+            mode = os.environ.get(ENV_POOL_POLICY, "").strip().lower()
+        self.mode = mode or MODE_ADAPTIVE
+        if self.mode not in POOL_MODES:
+            raise ValueError(
+                f"bad {ENV_POOL_POLICY}={self.mode!r}: "
+                f"want one of {POOL_MODES}")
+        self.clock = clock
+        self.tenants = tenants if tenants is not None else TenantRegistry()
+        self.leases = leases if leases is not None else LeaseBook(clock=clock)
+        self.lease_ttl_s = (float(lease_ttl_s) if lease_ttl_s is not None
+                            else _env_float(ENV_LEASE_TTL,
+                                            DEFAULT_LEASE_TTL_S))
+        self.min_train_hosts = (int(min_train_hosts)
+                                if min_train_hosts is not None
+                                else int(_env_float(
+                                    ENV_MIN_TRAIN_HOSTS,
+                                    DEFAULT_MIN_TRAIN_HOSTS)))
+        self._registry = registry
+        self._priors_path = priors_path
+        self._ewma: dict[str, float] = {}
+        self._decisions: collections.deque = collections.deque(
+            maxlen=MAX_DECISIONS)
+
+    # -- feedback ------------------------------------------------------------ #
+
+    def observe_measured(self, mechanism: str, seconds: float) -> None:
+        """Feed one measured borrow/reclaim latency: updates the EWMA the
+        next decision scores with and closes the projected-vs-measured
+        loop on the latest matching decision."""
+        prev = self._ewma.get(mechanism)
+        self._ewma[mechanism] = (seconds if prev is None else
+                                 (1 - EWMA_ALPHA) * prev
+                                 + EWMA_ALPHA * seconds)
+        reg = self._registry or metrics.registry()
+        reg.histogram(
+            "oobleck_pool_measured_seconds",
+            "Measured borrow/reclaim latency by mechanism (pool feedback)",
+        ).observe(seconds, mechanism=mechanism)
+        for d in reversed(self._decisions):
+            if d.mechanism == mechanism and d.measured_s is None:
+                d.measured_s = seconds
+                break
+
+    # -- decisions ----------------------------------------------------------- #
+
+    def decide_borrow(self, tenant: str, chips: int, *,
+                      train_hosts: int,
+                      spare_hosts: int = 0,
+                      slo_debt_s: float = 0.0,
+                      lease_ttl_s: float | None = None,
+                      drain_cost_s: float | None = None,
+                      lender: str = "default",
+                      cause: str = "pressure") -> PoolDecision:
+        """Score the BORROW arms for one pressure incident and pick.
+
+        ``slo_debt_s`` is the requester's priced pressure (it rides the
+        arms that leave it unrelieved); ``lease_ttl_s`` is the horizon
+        the degraded-training term amortizes over — the lease IS the
+        amortization window, because the chips come back when it ends."""
+        ttl = float(lease_ttl_s) if lease_ttl_s is not None \
+            else self.lease_ttl_s
+        with spans.span("pool.decide_borrow", tenant=tenant,
+                        chips=str(chips), cause=cause) as ctx:
+            arms = build_borrow_arms(
+                chips=chips,
+                train_hosts=train_hosts,
+                spare_hosts=spare_hosts,
+                min_train_hosts=self.min_train_hosts,
+                slo_debt_s=slo_debt_s,
+                drain_cost_s=drain_cost_s,
+                latency_overrides=self._ewma,
+                registry=self._registry,
+                priors_path=self._priors_path,
+            )
+            scored = score_arms(arms, mtbf_s=ttl)
+            chosen, reason = self._pick(scored, fallback=MECH_DENY)
+            decision = PoolDecision(
+                direction="borrow",
+                mechanism=chosen.mechanism,
+                tenant=tenant,
+                lender=lender,
+                chips=int(chips),
+                reason=reason,
+                projected_cost_s=chosen.cost_s,
+                costs={m: a.cost_s for m, a in scored.items()},
+                infeasible={m: a.reason for m, a in scored.items()
+                            if not a.feasible},
+                arms={m: dict(arms[m].as_record(),
+                              **scored[m].as_record())
+                      for m in arms},
+                slo_debt_s=float(slo_debt_s),
+                horizon_s=ttl,
+                trace_id=ctx["trace_id"],
+            )
+        logger.info(
+            "pool: %s for borrow of %d chip-hosts by %s "
+            "(reason=%s cost=%.3fs debt=%.1fs ttl=%.0fs)",
+            decision.mechanism, chips, tenant, reason, chosen.cost_s,
+            slo_debt_s, ttl)
+        self._decisions.append(decision)
+        decision.record()
+        return decision
+
+    def decide_reclaim(self, lease: ChipLease, *,
+                       train_hosts: int,
+                       slo_debt_s: float = 0.0,
+                       cause: str = "sweep") -> PoolDecision:
+        """Score hold-vs-reclaim for one lease at its sweep/expiry/release
+        point. ``slo_debt_s`` is the borrower's STILL-live pressure: it
+        rides reclaim_grow (taking the chips back re-exposes the borrower
+        to the peak), which is what holds through the peak and reclaims
+        off-peak. An expired lease makes hold infeasible — leases end."""
+        now = self.clock()
+        remaining = lease.remaining_s(now)
+        with spans.span("pool.decide_reclaim", tenant=lease.tenant,
+                        lease_id=lease.lease_id, cause=cause) as ctx:
+            arms = build_reclaim_arms(
+                leased_hosts=len(lease.hosts),
+                train_hosts=train_hosts,
+                slo_debt_s=slo_debt_s,
+                lease_expired=lease.expired(now),
+                latency_overrides=self._ewma,
+                registry=self._registry,
+                priors_path=self._priors_path,
+            )
+            scored = score_arms(
+                arms, mtbf_s=remaining if remaining > 0 else None)
+            chosen, reason = self._pick(scored, fallback=MECH_RECLAIM_GROW)
+            decision = PoolDecision(
+                direction="reclaim",
+                mechanism=chosen.mechanism,
+                tenant=lease.tenant,
+                lender=lease.lender,
+                chips=len(lease.hosts),
+                hosts=list(lease.hosts),
+                lease_id=lease.lease_id,
+                reason=reason,
+                projected_cost_s=chosen.cost_s,
+                costs={m: a.cost_s for m, a in scored.items()},
+                infeasible={m: a.reason for m, a in scored.items()
+                            if not a.feasible},
+                arms={m: dict(arms[m].as_record(),
+                              **scored[m].as_record())
+                      for m in arms},
+                slo_debt_s=float(slo_debt_s),
+                horizon_s=remaining,
+                trace_id=ctx["trace_id"],
+            )
+        logger.info(
+            "pool: %s for lease %s of %s (reason=%s cost=%.3fs "
+            "debt=%.1fs remaining=%.0fs)",
+            decision.mechanism, lease.lease_id, lease.tenant, reason,
+            chosen.cost_s, slo_debt_s, remaining)
+        self._decisions.append(decision)
+        decision.record()
+        return decision
+
+    def _pick(self, scored, *, fallback: str):
+        """Forced-mode gate + cheapest-feasible, the policy engine's
+        contract: a forced arm pins only its own direction, and an
+        infeasible forced arm falls back to the direction's
+        always-available mechanism with an honest reason string."""
+        forced = self.mode if self.mode in scored else MODE_ADAPTIVE
+        if forced != MODE_ADAPTIVE:
+            if scored[forced].feasible:
+                return scored[forced], f"forced:{forced}"
+            return scored[fallback], (f"forced:{forced}:infeasible:"
+                                      f"{scored[forced].reason}")
+        chosen = cheapest_feasible(scored)
+        if chosen is None:  # cannot happen: deny/reclaim_grow are
+            return scored[fallback], "fallback"  # always feasible
+        return chosen, "cheapest"
+
+    # -- /status ------------------------------------------------------------- #
+
+    def status(self) -> dict:
+        """The /status ``pool`` block: knobs, tenants, leases, decisions."""
+        return {
+            "enabled": True,
+            "mode": self.mode,
+            "lease_ttl_s": self.lease_ttl_s,
+            "min_train_hosts": self.min_train_hosts,
+            "tenants": self.tenants.snapshot(),
+            "leases": self.leases.snapshot(),
+            "decisions": [d.as_payload() for d in self._decisions],
+        }

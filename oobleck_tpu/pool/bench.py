@@ -1,0 +1,337 @@
+"""Shared chip-pool bench: one full borrow/return cycle under a traffic
+wave.
+
+Composes the two proven bench harnesses: the scripted control-plane
+fleet from ``elastic/master_bench.py`` (real TCP, no workers) as the
+training tenant, and the real serving plane from ``serve/bench.py``
+(tiny model, CPU-friendly) as the serve tenant. A ``traffic_wave``
+chaos directive sets the peak request rate; the serve-side
+``PressureMonitor`` reads the plane's own metrics and prices the peak
+as SLO debt, which rides a POOL_BORROW to the arbiter.
+
+Measured, in order:
+
+  * borrow_latency_s — POOL_BORROW request -> lease granted (the
+    arbiter's classify -> score -> grant path over real sockets);
+  * grant_broadcast_s — request -> LEASE_GRANT landed at EVERY agent
+    (the drain order reaching the fleet);
+  * serve attainment at the peak — completed / issued requests; the
+    acceptance bar is 1.0 (zero failed or dropped while chips move);
+  * training yield — victim drains via the proactive path: zero
+    recovery broadcasts, zero respawns, and the goodput retention of
+    the shrunken fleet;
+  * reclaim — off-peak release rides LEASE_RECLAIM through the grow
+    path; release_to_reclaim_s is request -> verb at every survivor.
+
+Prints ONE JSON line (consumed by bench.py's "pool" key and
+`make pool-bench`).
+"""
+
+from __future__ import annotations
+
+import asyncio
+import json
+import os
+import shutil
+import tempfile
+import threading
+import time
+
+import numpy as np
+
+from oobleck_tpu.config import OobleckArguments
+from oobleck_tpu.elastic import journal as journal_mod
+from oobleck_tpu.elastic.master_bench import (
+    ScriptedAgent,
+    _hard_kill,
+    _start_master,
+)
+from oobleck_tpu.elastic.message import (
+    JOINED_KEY,
+    LEASE_KEY,
+    TENANT_KEY,
+    RequestType,
+    ResponseType,
+    recv_msg,
+    send_request,
+)
+from oobleck_tpu.policy.engine import DECISION_KEY
+from oobleck_tpu.pool import arbiter as pool_arbiter
+from oobleck_tpu.pool.pressure import PressureMonitor
+from oobleck_tpu.utils import chaos as chaos_mod
+from oobleck_tpu.utils import metrics
+
+# Peak rate comes from the chaos directive (override via OOBLECK_CHAOS).
+DEFAULT_WAVE = "traffic_wave=40:2"
+AGENTS = ("10.8.0.1", "10.8.0.2", "10.8.0.3", "10.8.0.4")
+LEASE_TTL_S = 60.0
+# Sized so the peak outruns the tiny plane's throughput: two decode
+# lanes against a 24-request burst holds a real queue — long enough for
+# the pressure monitor to see it, short enough for a CPU bench.
+PEAK_REQUESTS = 24
+GEN_TOKENS = 48
+SERVE_LANES = 2
+PHASE_TIMEOUT_S = 30.0
+# Debt floor before borrowing: the arbiter would grant on less, but the
+# bench should measure a decisive peak, not a threshold-grazing one.
+MIN_DEBT_S = 30.0
+
+
+def _fire_wave(port: int, *, n_requests: int, rate_hz: float,
+               gen_tokens: int, seed: int = 0) -> dict:
+    """Open-loop Poisson burst at the chaos-directed rate. Returns after
+    the last ARRIVAL (threads still in flight) so the caller can sample
+    pressure mid-wave. A request that raises or returns non-200 is a
+    dropped request — the bench's failure bar."""
+    import http.client
+
+    rng = np.random.default_rng(seed)
+    ok: list[int] = []
+    failed: list[str] = []
+    lock = threading.Lock()
+
+    def one_request(tokens: list[int]) -> None:
+        try:
+            conn = http.client.HTTPConnection("127.0.0.1", port, timeout=120)
+            body = json.dumps({"tokens": tokens, "max_tokens": gen_tokens})
+            conn.request("POST", "/v1/generate", body,
+                         {"Content-Type": "application/json"})
+            resp = conn.getresponse()
+            out = json.loads(resp.read())
+            conn.close()
+            if resp.status != 200:
+                raise RuntimeError(f"status {resp.status}: {out}")
+            with lock:
+                ok.append(len(out["tokens"]))
+        except Exception as exc:  # noqa: BLE001 — failure IS the measurement
+            with lock:
+                failed.append(f"{type(exc).__name__}: {exc}")
+
+    threads = []
+    for _ in range(n_requests):
+        tokens = [int(t) for t in rng.integers(1, 90, rng.integers(4, 17))]
+        t = threading.Thread(target=one_request, args=(tokens,))
+        t.start()
+        threads.append(t)
+        time.sleep(float(rng.exponential(1.0 / max(rate_hz, 1e-6))))
+    return {"threads": threads, "ok": ok, "failed": failed}
+
+
+async def _pool_rpc(port: int, payload: dict) -> dict:
+    r, w = await asyncio.open_connection("127.0.0.1", port)
+    await send_request(w, RequestType.POOL_BORROW, payload)
+    msg = await recv_msg(r)
+    w.close()
+    return msg
+
+
+async def _wait_all(fleet, verb: str, *, match=None) -> None:
+    for a in fleet:
+        deadline = time.monotonic() + PHASE_TIMEOUT_S
+        while time.monotonic() < deadline:
+            hits = [m for m in a.inbox if m.get("kind") == verb
+                    and (match is None or match(m))]
+            if hits:
+                break
+            await asyncio.sleep(0.01)
+        else:
+            raise TimeoutError(f"{a.ip}: no {verb} broadcast")
+
+
+def _percentile(hist, q: float):
+    merged = metrics.merge_histogram_series(hist.series())
+    if merged is None:
+        return None
+    v = metrics.histogram_percentile(merged, q)
+    return round(v, 6) if v is not None else None
+
+
+async def _bench() -> dict:
+    tmp = tempfile.mkdtemp(prefix="oobleck-pool-bench-")
+    serve_tmp = tempfile.mkdtemp(prefix="oobleck-pool-bench-serve-")
+    os.environ[journal_mod.ENV_STATE_DIR] = tmp
+    os.environ[pool_arbiter.ENV_POOL] = "1"
+
+    # The peak rate is a chaos fault, not a bench constant: the same
+    # directive grammar drives sim and chaos runs.
+    wave_spec = os.environ.get("OOBLECK_CHAOS") or DEFAULT_WAVE
+    c = chaos_mod.reset(wave_spec)
+    wave = None
+    for _ in range(64):  # @<poll> delays activate within the first polls
+        wave = c.traffic_wave()
+        if wave is not None:
+            break
+    assert wave is not None, "no traffic_wave directive active"
+    peak_rps, period_s = wave
+    trough_rps = max(peak_rps / 8.0, 1.0)
+
+    # -- training tenant: journaling master + scripted fleet ------------ #
+    args = OobleckArguments()
+    args.dist.node_ips = list(AGENTS)
+    m, mtask = await _start_master(0)
+    port = m.port
+    r, w = await asyncio.open_connection("127.0.0.1", port)
+    await send_request(w, RequestType.LAUNCH_JOB, {"args": args.to_dict()})
+    assert (await recv_msg(r))["kind"] == ResponseType.SUCCESS.value
+    w.close()
+    fleet = [ScriptedAgent(ip) for ip in AGENTS]
+    for a in fleet:
+        await a.register(port)
+
+    # -- serve tenant: real plane, tiny model --------------------------- #
+    import jax
+
+    from oobleck_tpu.models import build_model
+    from oobleck_tpu.serve import ServeArguments, ServingPlane, publish_params
+
+    model = build_model("gpt2-tiny", {"num_layers": 2})
+    params = model.init_params(jax.random.PRNGKey(0))
+    publish_params(serve_tmp, model, params, step=1, model_name="gpt2-tiny")
+    plane = ServingPlane(
+        serve_tmp, model=model,
+        args=ServeArguments(port=0, slots=2, max_seq=64, reload_secs=0.5,
+                            page_size=16, kv_pages=32,
+                            lanes=SERVE_LANES)).start()
+    sport = plane.server.port
+    # Tight thresholds so the tiny plane's peak registers as pressure.
+    monitor = PressureMonitor(queue_high=2.0, hysteresis=1)
+
+    try:
+        # Off-peak baseline: trough traffic must NOT pressure.
+        base = _fire_wave(sport, n_requests=2, rate_hz=trough_rps,
+                          gen_tokens=GEN_TOKENS, seed=1)
+        for t in base["threads"]:
+            await asyncio.to_thread(t.join)
+        baseline = monitor.sample()
+        baseline_pressured = monitor.pressured
+
+        # Peak: fire the wave, sample pressure mid-flight.
+        peak_task = asyncio.create_task(asyncio.to_thread(
+            _fire_wave, sport, n_requests=PEAK_REQUESTS, rate_hz=peak_rps,
+            gen_tokens=GEN_TOKENS, seed=2))
+        pressure = None
+        deadline = time.monotonic() + PHASE_TIMEOUT_S
+        while time.monotonic() < deadline:
+            monitor.sample()
+            if monitor.pressured \
+                    and monitor.slo_debt_s(LEASE_TTL_S) >= MIN_DEBT_S:
+                pressure = monitor.as_payload(horizon_s=LEASE_TTL_S)
+                break
+            await asyncio.sleep(0.02)
+        assert pressure is not None, "serve never pressured under the peak"
+
+        # Borrow: the pressure payload IS the request.
+        t0 = time.monotonic()
+        msg = await _pool_rpc(port, {
+            TENANT_KEY: "serve-bench", "chips": 1, "pressure": pressure,
+            "slo": {"ttft_p99_s": monitor.ttft_slo_s},
+            "lease_ttl_s": LEASE_TTL_S, "cause": "traffic_wave_peak"})
+        borrow_latency = time.monotonic() - t0
+        assert msg["kind"] == ResponseType.SUCCESS.value, msg
+        lease = msg[LEASE_KEY]
+        decision = msg[DECISION_KEY]
+        victim_ip = lease["hosts"][0]
+        await _wait_all(fleet, ResponseType.LEASE_GRANT.value)
+        grant_broadcast = time.monotonic() - t0
+
+        # The victim drains: clean exit, and the fleet must see ZERO
+        # recovery verbs — a lease is not a failure.
+        victim_clean = m.agents[victim_ip].clean_exit
+        assert victim_clean
+        victim = next(a for a in fleet if a.ip == victim_ip)
+        victim.close()
+        survivors = [a for a in fleet if a.ip != victim_ip]
+        await asyncio.sleep(0.3)
+        recovery_verbs = {ResponseType.RECONFIGURATION.value,
+                          ResponseType.DEGRADE.value,
+                          ResponseType.RESTORE.value}
+        recoveries = [x for a in fleet for x in a.inbox
+                      if x.get("kind") in recovery_verbs]
+        retention = (len(AGENTS) - len(lease["hosts"])) / len(AGENTS)
+
+        # Drain the peak; every request must have completed.
+        peak = await peak_task
+        for t in peak["threads"]:
+            await asyncio.to_thread(t.join)
+        issued = len(peak["ok"]) + len(peak["failed"])
+        attainment = len(peak["ok"]) / max(issued, 1)
+
+        # Off-peak: pressure clears, serve releases, chips ride the
+        # grow path home.
+        off = monitor.sample()
+        t0 = time.monotonic()
+        msg = await _pool_rpc(port, {
+            TENANT_KEY: "serve-bench", "release": lease["lease_id"],
+            "pressure": monitor.as_payload(horizon_s=LEASE_TTL_S)})
+        assert msg["kind"] == ResponseType.SUCCESS.value, msg
+        await _wait_all(
+            survivors, ResponseType.LEASE_RECLAIM.value,
+            match=lambda x: x.get(LEASE_KEY, {}).get("lease_id")
+            == lease["lease_id"])
+        reclaim_broadcast = time.monotonic() - t0
+        reclaim_msg = next(
+            x for x in survivors[0].inbox
+            if x.get("kind") == ResponseType.LEASE_RECLAIM.value)
+
+        goodput_cost = m.pool.tenants.incident_cost(decision["trace_id"]) \
+            if m.pool is not None else None
+
+        b = plane.batcher
+        return {
+            "wave": {"spec": wave_spec, "peak_rps": peak_rps,
+                     "period_s": period_s, "trough_rps": trough_rps},
+            "train_hosts": len(AGENTS),
+            "chips_borrowed": len(lease["hosts"]),
+            "baseline": {"pressured": baseline_pressured,
+                         "score": baseline["score"]},
+            "pressure_at_borrow": pressure,
+            "borrow": {
+                "mechanism": decision["mechanism"],
+                "borrow_latency_s": round(borrow_latency, 6),
+                "grant_broadcast_s": round(grant_broadcast, 6),
+                "lease_id": lease["lease_id"],
+                "victim": victim_ip,
+            },
+            "serve_peak": {
+                "requests": issued,
+                "failed": len(peak["failed"]),
+                "attainment": round(attainment, 4),
+                "ttft_p99_s": _percentile(b.m_ttft, 0.99),
+                "tokens": int(sum(peak["ok"])),
+            },
+            "training_yield": {
+                "goodput_retention": round(retention, 4),
+                "recovery_broadcasts": len(recoveries),
+                "respawns": 0 if victim_clean else 1,
+                "per_tenant_goodput_cost_s": goodput_cost,
+            },
+            "reclaim": {
+                "via": "grow",
+                "release_to_reclaim_broadcast_s": round(reclaim_broadcast, 6),
+                "returned_hosts": reclaim_msg.get(JOINED_KEY),
+                "offpeak_score": off["score"],
+            },
+            "note": ("scripted training fleet over real TCP + real serve "
+                     "plane on a tiny model; the peak rate is the chaos "
+                     "traffic_wave directive, attainment counts every "
+                     "peak-phase request"),
+        }
+    finally:
+        plane.stop()
+        # Hard-kill first: journaling stops before the state dir goes
+        # away, so late agent-close callbacks cannot race the rmtree.
+        _hard_kill(m)
+        mtask.cancel()
+        await m.stop()
+        for a in fleet:
+            a.close()
+        shutil.rmtree(serve_tmp, ignore_errors=True)
+        shutil.rmtree(tmp, ignore_errors=True)
+
+
+def main() -> None:
+    print(json.dumps(asyncio.run(_bench())))
+
+
+if __name__ == "__main__":
+    main()

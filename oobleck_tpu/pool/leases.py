@@ -1,0 +1,175 @@
+"""Chip leases: the unit of cross-tenant chip movement.
+
+A lease names WHO borrowed WHICH hosts from WHOM and until WHEN. Leases
+are deliberately time-bounded — a lease that never ends is an
+allocation, and the pool's whole point is that peaks pass. Expiry does
+not end a lease by itself: the sweep surfaces due leases to the arbiter,
+which scores hold-vs-reclaim (a borrower still under live pressure can
+win an extension; an expired lease makes `hold` infeasible, so the
+chips flow back through the grow path).
+
+Every transition is a journal entry (elastic/journal.py EV_LEASE), so a
+restarted master still knows who holds whose chips — the lease book
+restores from the replayed snapshot and the sweep picks up exactly
+where the dead incarnation left off.
+
+Timestamps are wall-clock (``time.time``): expiry must survive a master
+restart, and monotonic clocks do not.
+"""
+
+from __future__ import annotations
+
+import time
+from dataclasses import dataclass, field
+
+# Lease lifecycle states. "active" is the only state journaled as live;
+# the terminal states record WHY the lease ended in the transition entry.
+ST_ACTIVE = "active"
+ST_RETURNED = "returned"    # borrower released early (peak passed)
+ST_RECLAIMED = "reclaimed"  # arbiter reclaimed (off-peak sweep)
+ST_EXPIRED = "expired"      # TTL ran out with no extension
+
+
+@dataclass
+class ChipLease:
+    """One grant of `hosts` from `lender` to `tenant` until `expires_at`."""
+
+    lease_id: str
+    tenant: str                 # borrower
+    lender: str                 # whose chips these are
+    hosts: list[str]
+    granted_at: float           # wall ts
+    expires_at: float           # wall ts
+    state: str = ST_ACTIVE
+    trace_id: str = ""          # arbiter incident that granted it
+
+    def remaining_s(self, now: float) -> float:
+        return max(self.expires_at - now, 0.0)
+
+    def expired(self, now: float) -> bool:
+        return now >= self.expires_at
+
+    def as_record(self) -> dict:
+        """The dict that rides LEASE_KEY on the wire and /status."""
+        return {
+            "lease_id": self.lease_id,
+            "tenant": self.tenant,
+            "lender": self.lender,
+            "hosts": list(self.hosts),
+            "granted_at": round(self.granted_at, 6),
+            "expires_at": round(self.expires_at, 6),
+            "state": self.state,
+            "trace_id": self.trace_id,
+        }
+
+
+class LeaseBook:
+    """Active leases for one pool, with monotonic ids and journal restore.
+
+    Single-writer like the rest of the master's state: the master's
+    event loop serializes every transition (same contract as the
+    registry / policy engine), so no lock."""
+
+    def __init__(self, clock=time.time):
+        self._clock = clock
+        self._leases: dict[str, ChipLease] = {}
+        self._seq = 0
+        self._granted = 0
+        self._ended: dict[str, int] = {}  # terminal state -> count
+
+    # -- transitions -------------------------------------------------------- #
+
+    def grant(self, tenant: str, hosts: list[str], ttl_s: float, *,
+              lender: str = "default", trace_id: str = "") -> ChipLease:
+        self._seq += 1
+        now = self._clock()
+        lease = ChipLease(
+            lease_id=f"lease-{self._seq}",
+            tenant=tenant,
+            lender=lender,
+            hosts=list(hosts),
+            granted_at=now,
+            expires_at=now + max(float(ttl_s), 0.0),
+            trace_id=trace_id,
+        )
+        self._leases[lease.lease_id] = lease
+        self._granted += 1
+        return lease
+
+    def end(self, lease_id: str, state: str = ST_RETURNED
+            ) -> ChipLease | None:
+        """Terminal transition: the lease leaves the active book. Returns
+        the ended lease (state updated) or None if unknown/already ended."""
+        lease = self._leases.pop(lease_id, None)
+        if lease is None:
+            return None
+        lease.state = state
+        self._ended[state] = self._ended.get(state, 0) + 1
+        return lease
+
+    def extend(self, lease_id: str, ttl_s: float) -> ChipLease | None:
+        """Push an active lease's expiry out by `ttl_s` from now (the
+        arbiter chose `hold` for a borrower still under pressure)."""
+        lease = self._leases.get(lease_id)
+        if lease is None:
+            return None
+        lease.expires_at = self._clock() + max(float(ttl_s), 0.0)
+        return lease
+
+    # -- reads -------------------------------------------------------------- #
+
+    def get(self, lease_id: str) -> ChipLease | None:
+        return self._leases.get(lease_id)
+
+    def active(self) -> list[ChipLease]:
+        return sorted(self._leases.values(), key=lambda le: le.lease_id)
+
+    def due(self, now: float | None = None) -> list[ChipLease]:
+        """Active leases whose TTL has run out — the sweep feeds these to
+        the arbiter; nothing ends until the arbiter says so."""
+        t = self._clock() if now is None else now
+        return [le for le in self.active() if le.expired(t)]
+
+    def leased_hosts(self) -> set[str]:
+        """Hosts currently out on any active lease."""
+        out: set[str] = set()
+        for lease in self._leases.values():
+            out.update(lease.hosts)
+        return out
+
+    def find_by_host(self, host: str) -> ChipLease | None:
+        for lease in self.active():
+            if host in lease.hosts:
+                return lease
+        return None
+
+    def snapshot(self) -> dict:
+        """Bounded lease view for the /status pool block."""
+        return {
+            "active": [le.as_record() for le in self.active()],
+            "granted_total": self._granted,
+            "ended": dict(sorted(self._ended.items())),
+        }
+
+    # -- journal restore ----------------------------------------------------- #
+
+    def restore(self, journal_leases: dict) -> None:
+        """Rehydrate active leases from the replayed journal state
+        (elastic/journal.py state["leases"]). The id counter resumes past
+        the highest restored suffix so a restarted master never reissues
+        a lease id a dead incarnation already granted."""
+        for lease_id, rec in sorted((journal_leases or {}).items()):
+            if not isinstance(rec, dict):
+                continue
+            lease = ChipLease(
+                lease_id=str(lease_id),
+                tenant=str(rec.get("tenant") or "default"),
+                lender=str(rec.get("lender") or "default"),
+                hosts=[str(h) for h in (rec.get("hosts") or [])],
+                granted_at=float(rec.get("ts") or 0.0),
+                expires_at=float(rec.get("expires_at") or 0.0),
+            )
+            self._leases[lease.lease_id] = lease
+            suffix = lease.lease_id.rpartition("-")[2]
+            if suffix.isdigit():
+                self._seq = max(self._seq, int(suffix))

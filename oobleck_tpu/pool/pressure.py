@@ -1,0 +1,167 @@
+"""Serve-side pressure monitor: traffic peaks become borrow requests.
+
+Reads the serve metrics the batcher already publishes — queue depth,
+TTFT p99, and the ``deadline_queued`` outcome rate (requests whose
+deadline expired while still QUEUED: the unambiguous "not enough chips"
+signal, since a request that never reached a slot cannot blame model
+speed) — and turns them into a pressure verdict with hysteresis, plus
+an SLO-debt price in seconds the arbiter can weigh against training's
+preemption cost in one currency.
+
+The debt model: each ``sample()`` computes a dimensionless pressure
+score — how far queue depth, TTFT p99, and the deadline_queued rate sit
+above their thresholds — and ``slo_debt_s(horizon)`` projects it over
+the lease horizon, clamped so one pathological sample cannot price the
+whole fleet away. Hysteresis (``OOBLECK_POOL_HYST`` consecutive samples)
+keeps one burst from triggering a borrow and one quiet poll from
+triggering a reclaim: chip movement costs real drain/grow work, so the
+monitor must be slower than the noise.
+
+Runs in the SERVE process (where the metrics live); the computed
+pressure dict rides the POOL_BORROW request to the master, which never
+needs serve-side scrape access.
+"""
+
+from __future__ import annotations
+
+import os
+import time
+
+from oobleck_tpu.utils import metrics
+
+ENV_QUEUE_HIGH = "OOBLECK_POOL_QUEUE_HIGH"
+ENV_TTFT_SLO = "OOBLECK_POOL_TTFT_SLO_S"
+ENV_HYST = "OOBLECK_POOL_HYST"
+
+DEFAULT_QUEUE_HIGH = 8.0     # queued requests before pressure counts
+DEFAULT_TTFT_SLO_S = 2.0     # TTFT p99 target
+DEFAULT_HYST = 2             # consecutive samples to flip the verdict
+
+# One sample's score is clamped here before projection: debt prices a
+# peak, it must not price an outage (that is the failure planes' job).
+MAX_SCORE = 2.0
+
+
+def _env_float(name: str, default: float) -> float:
+    raw = os.environ.get(name, "")
+    try:
+        return float(raw) if raw else default
+    except ValueError:
+        return default
+
+
+class PressureMonitor:
+    """Hysteresis-filtered serve pressure for one replica group."""
+
+    def __init__(self, *, registry=None, clock=time.monotonic,
+                 queue_high: float | None = None,
+                 ttft_slo_s: float | None = None,
+                 hysteresis: int | None = None):
+        self._registry = registry
+        self._clock = clock
+        self.queue_high = (queue_high if queue_high is not None
+                           else _env_float(ENV_QUEUE_HIGH,
+                                           DEFAULT_QUEUE_HIGH))
+        self.ttft_slo_s = (ttft_slo_s if ttft_slo_s is not None
+                           else _env_float(ENV_TTFT_SLO, DEFAULT_TTFT_SLO_S))
+        self.hysteresis = max(int(hysteresis if hysteresis is not None
+                                  else _env_float(ENV_HYST, DEFAULT_HYST)), 1)
+        self._pressured = False
+        self._high_streak = 0
+        self._low_streak = 0
+        self._last_t: float | None = None
+        self._last_deadline_queued = 0.0
+        self._last: dict = {}
+
+    # -- raw reads ----------------------------------------------------------- #
+
+    def _reg(self):
+        return self._registry or metrics.registry()
+
+    def _queue_depth(self) -> float:
+        series = self._reg().gauge("oobleck_serve_queue_depth", "").series()
+        return max((s["value"] for s in series), default=0.0)
+
+    def _ttft_p99(self) -> float | None:
+        hist = self._reg().histogram("oobleck_serve_ttft_seconds", "")
+        merged = metrics.merge_histogram_series(hist.series())
+        if merged is None:
+            return None
+        return metrics.histogram_percentile(merged, 0.99)
+
+    def _deadline_queued_total(self) -> float:
+        counter = self._reg().counter("oobleck_serve_requests_total", "")
+        return sum(s["value"] for s in counter.series()
+                   if s["labels"].get("outcome") == "deadline_queued")
+
+    # -- the sample ---------------------------------------------------------- #
+
+    def sample(self) -> dict:
+        """One pressure reading; call at the load generator's poll cadence.
+
+        score = how far above threshold each signal sits, summed:
+        queue_depth/high - 1, ttft_p99/slo - 1, and the deadline_queued
+        rate (each clamped at >= 0; the rate term saturates at 1)."""
+        now = self._clock()
+        queue = self._queue_depth()
+        ttft = self._ttft_p99()
+        dq_total = self._deadline_queued_total()
+        if self._last_t is not None and now > self._last_t:
+            dq_rate = max(dq_total - self._last_deadline_queued, 0.0) \
+                / (now - self._last_t)
+        else:
+            dq_rate = 0.0
+        self._last_t = now
+        self._last_deadline_queued = dq_total
+
+        score = max(queue / self.queue_high - 1.0, 0.0) if self.queue_high \
+            else 0.0
+        if ttft is not None and self.ttft_slo_s > 0:
+            score += max(ttft / self.ttft_slo_s - 1.0, 0.0)
+        score += min(dq_rate, 1.0)
+        score = min(score, MAX_SCORE)
+
+        if score > 0:
+            self._high_streak += 1
+            self._low_streak = 0
+        else:
+            self._low_streak += 1
+            self._high_streak = 0
+        if not self._pressured and self._high_streak >= self.hysteresis:
+            self._pressured = True
+        elif self._pressured and self._low_streak >= self.hysteresis:
+            self._pressured = False
+
+        self._last = {
+            "queue_depth": round(queue, 6),
+            "ttft_p99_s": round(ttft, 6) if ttft is not None else None,
+            "deadline_queued_rate": round(dq_rate, 6),
+            "score": round(score, 6),
+            "pressured": self._pressured,
+        }
+        reg = self._reg()
+        reg.gauge(
+            "oobleck_pool_pressure_score",
+            "Serve pressure score feeding pool borrow requests",
+        ).set(score)
+        return dict(self._last)
+
+    @property
+    def pressured(self) -> bool:
+        return self._pressured
+
+    def slo_debt_s(self, horizon_s: float) -> float:
+        """The last sample's score projected over ``horizon_s`` — the
+        seconds of SLO-debt the arbiter charges to every arm that leaves
+        the pressure unrelieved. Zero before the first sample and zero
+        the moment the score clears (debt is a live price, not a
+        grudge)."""
+        score = float(self._last.get("score") or 0.0)
+        return min(score, MAX_SCORE) * max(float(horizon_s), 0.0)
+
+    def as_payload(self, *, horizon_s: float) -> dict:
+        """The pressure dict that rides a POOL_BORROW request: the last
+        sample plus the debt already priced in seconds, so the master
+        never needs serve-side scrape access."""
+        return dict(self._last,
+                    slo_debt_s=round(self.slo_debt_s(horizon_s), 6))

@@ -1,0 +1,118 @@
+"""Tenant registry: who shares the pool, and where their seconds went.
+
+A tenant is one training job or one serve replica group, described by a
+priority and an SLO descriptor. The registry also owns one attributed
+goodput ledger (obs/goodput.py) PER tenant — the PR-17 single-job
+ledger, multiplied — so cross-tenant arbitration can answer the only
+question that justifies it: whose seconds did this decision spend?
+``attribute`` charges one arbiter incident across several tenants in
+one call (borrower gains are the lender's recovery seconds), and
+``incident_cost`` returns the per-tenant breakdown that lands in the
+incident file's ``goodput_cost`` section.
+"""
+
+from __future__ import annotations
+
+import time
+from dataclasses import dataclass, field
+
+from oobleck_tpu.obs.goodput import GoodputLedger
+
+KIND_TRAIN = "train"
+KIND_SERVE = "serve"
+
+
+@dataclass
+class TenantSpec:
+    """One pool tenant: a training job or a serve replica group."""
+
+    name: str
+    kind: str = KIND_TRAIN          # "train" | "serve"
+    priority: int = 0               # higher preempts lower at equal cost
+    # SLO descriptor: serve tenants carry e.g. {"ttft_p99_s": 2.0};
+    # training tenants e.g. {"min_hosts": 1}. Free-form — the arbiter
+    # reads the keys it knows and carries the rest for forensics.
+    slo: dict = field(default_factory=dict)
+
+    def as_record(self) -> dict:
+        return {
+            "name": self.name,
+            "kind": self.kind,
+            "priority": self.priority,
+            "slo": dict(self.slo),
+        }
+
+
+class TenantRegistry:
+    """Tenant specs + per-tenant goodput ledgers for one pool.
+
+    Single-writer (the master's event loop / one sim run); the ledgers
+    themselves are thread-safe for the feeds that cross threads."""
+
+    def __init__(self, clock=time.monotonic):
+        self._clock = clock
+        self._specs: dict[str, TenantSpec] = {}
+        self._ledgers: dict[str, GoodputLedger] = {}
+
+    # -- membership ---------------------------------------------------------- #
+
+    def register(self, spec: TenantSpec) -> TenantSpec:
+        """Idempotent by name: re-registering updates the descriptor but
+        keeps the tenant's ledger (its wall-clock history is real)."""
+        self._specs[spec.name] = spec
+        self._ledgers.setdefault(spec.name, GoodputLedger(clock=self._clock))
+        return spec
+
+    def get(self, name: str) -> TenantSpec | None:
+        return self._specs.get(name)
+
+    def names(self) -> list[str]:
+        return sorted(self._specs)
+
+    def ledger(self, name: str) -> GoodputLedger:
+        """The tenant's ledger, creating tenant-less bookkeeping on first
+        touch — attribution must never be dropped because registration
+        raced the incident."""
+        if name not in self._ledgers:
+            self._ledgers[name] = GoodputLedger(clock=self._clock)
+        return self._ledgers[name]
+
+    # -- cross-tenant attribution -------------------------------------------- #
+
+    def attribute(self, trace_id: str, charges: dict[str, float], *,
+                  bucket: str = "recovery", cause: str = "") -> None:
+        """Charge one arbiter incident across tenants: ``charges`` maps
+        tenant -> seconds, each entering THAT tenant's ledger under the
+        shared trace id, so every tenant's buckets still sum to its own
+        wall while the incident file can total the cross-tenant bill."""
+        for tenant, seconds in charges.items():
+            self.ledger(tenant).attribute(
+                trace_id, seconds, bucket=bucket, cause=cause)
+
+    def incident_cost(self, trace_id: str) -> dict | None:
+        """Per-tenant ``goodput_cost`` breakdown for one incident file:
+        {tenant: {lost_s, buckets, cause}}, or None when no ledger holds
+        a charge for the trace."""
+        out = {}
+        for tenant in sorted(self._ledgers):
+            cost = self._ledgers[tenant].incident_cost(trace_id)
+            if cost is not None:
+                out[tenant] = cost
+        return out or None
+
+    # -- /status ------------------------------------------------------------- #
+
+    def snapshot(self) -> dict:
+        """Tenant block for /status: descriptor + ledger digest each."""
+        out = {}
+        for name in self.names():
+            ledger = self._ledgers[name]
+            led = ledger.snapshot()
+            out[name] = {
+                **self._specs[name].as_record(),
+                "wall_s": led["wall_s"],
+                "goodput_fraction": led["goodput_fraction"],
+                "buckets": led["buckets"],
+                "incidents": len(led["incidents"]),
+            }
+        return out

@@ -30,6 +30,7 @@ SUITE = (
     ("diurnal_traffic_64", "diurnal_traffic", 64, 1800.0, 1117, {}),
     ("capacity_arrival_64", "capacity_arrival", 64, 600.0, 1117, {}),
     ("straggler_64", "straggler", 64, 600.0, 1117, {}),
+    ("shared_pool_64", "shared_pool", 64, 1800.0, 1117, {}),
     ("churn_storm_1024", "churn_storm", 1024, 600.0, 1117,
      {"mean_interarrival_s": 4.0}),
 )
@@ -61,17 +62,21 @@ def measure() -> dict:
     for label, name, hosts, duration_s, seed, params in SUITE:
         out[label], renders[label] = _one(label, name, hosts, duration_s,
                                           seed, params)
-    # Determinism gate: the 64-host storm AND the straggler scenario
-    # (which adds the telemetry-tick event stream + the real detector to
-    # the loop) again, from fresh state; the canonical renders must
-    # match byte for byte.
+    # Determinism gate: the 64-host storm, the straggler scenario (which
+    # adds the telemetry-tick event stream + the real detector to the
+    # loop), AND the shared-pool scenario (which adds the cross-tenant
+    # arbiter + lease-sweep events) again, from fresh state; the
+    # canonical renders must match byte for byte.
     _, again = _one("churn_storm_64", *SUITE[0][1:])
     straggler_entry = next(s for s in SUITE if s[0] == "straggler_64")
     _, s_again = _one("straggler_64", *straggler_entry[1:])
+    pool_entry = next(s for s in SUITE if s[0] == "shared_pool_64")
+    _, p_again = _one("shared_pool_64", *pool_entry[1:])
     out["determinism"] = {
-        "scenario": "churn_storm_64+straggler_64",
+        "scenario": "churn_storm_64+straggler_64+shared_pool_64",
         "byte_identical": (renders["churn_storm_64"] == again
-                           and renders["straggler_64"] == s_again),
+                           and renders["straggler_64"] == s_again
+                           and renders["shared_pool_64"] == p_again),
     }
     out["elapsed_s"] = round(time.perf_counter() - t0, 3)
     return out

@@ -36,6 +36,20 @@ from oobleck_tpu.execution.schedule import replay_schedule
 from oobleck_tpu.obs.fleet import FleetTracker
 from oobleck_tpu.policy.engine import PolicyEngine
 from oobleck_tpu.policy.signals import priors_provenance
+from oobleck_tpu.pool.arbiter import (
+    MECH_BORROW_DRAIN,
+    MECH_BORROW_SPARE,
+    MECH_HOLD,
+    MODE_ADAPTIVE,
+    PoolArbiter,
+)
+from oobleck_tpu.pool.leases import ST_EXPIRED, LeaseBook
+from oobleck_tpu.pool.tenants import (
+    KIND_SERVE,
+    KIND_TRAIN,
+    TenantRegistry,
+    TenantSpec,
+)
 from oobleck_tpu.sim.scenarios import Scenario
 from oobleck_tpu.utils import metrics
 
@@ -49,6 +63,14 @@ JITTER_LO, JITTER_HI = 0.85, 1.15
 # scripts "slow" events, so the other scenarios' event streams (and
 # their byte-identical renders) are untouched.
 TELEMETRY_TICK_S = 5.0
+
+# Shared-pool scenario knobs: explicit constants, never the env, so the
+# run stays hermetic. The TTL is the borrow commitment window — expiry
+# is the arbiter's reclaim point (mid-peak it re-borrows; in the trough
+# the chips ride the grow path home).
+POOL_LEASE_TTL_S = 180.0
+POOL_TRAIN_TENANT = "train"
+POOL_SERVE_TENANT = "serve"
 
 
 @dataclass
@@ -143,6 +165,30 @@ class SimCluster:
         self._demand_integral = 0.0
         self.incidents: list[dict] = []
         self.lost_work_s = 0.0
+        # Shared-pool plane: constructed ONLY when the scenario scripts
+        # "serve" events (the TELEMETRY_TICK_S don't-perturb pattern) —
+        # every single-tenant scenario keeps its exact event stream and
+        # byte-identical render. The REAL arbiter, hermetic: injected
+        # clock, injected registry, explicit knobs.
+        self.pool: PoolArbiter | None = None
+        self._serve_debt = 0.0
+        self._leased_at: dict[str, float] = {}
+        self._pool_stats = {"granted": 0, "denied": 0, "held": 0,
+                            "train_charged_s": 0.0,
+                            "chip_seconds_lent": 0.0}
+        if any(ev.kind == "serve" for ev in scenario.events):
+            tenants = TenantRegistry(clock=lambda: self.now)
+            tenants.register(TenantSpec(name=POOL_TRAIN_TENANT,
+                                        kind=KIND_TRAIN))
+            tenants.register(TenantSpec(name=POOL_SERVE_TENANT,
+                                        kind=KIND_SERVE,
+                                        slo={"ttft_p99_s": 2.0}))
+            self.pool = PoolArbiter(
+                tenants=tenants,
+                leases=LeaseBook(clock=lambda: self.now),
+                registry=self.registry, clock=lambda: self.now,
+                mode=MODE_ADAPTIVE, lease_ttl_s=POOL_LEASE_TTL_S,
+                min_train_hosts=1, priors_path=config.priors_path)
 
     # -- throughput model (real replay, cached by schedule shape) ----------- #
 
@@ -603,6 +649,144 @@ class SimCluster:
             "pipelines": len(self.pipelines),
         })
 
+    # -- shared chip pool (shared_pool scenario) ------------------------------ #
+
+    def _pool_train_hosts(self) -> int:
+        return len({h for p in self.pipelines for h in p.hosts})
+
+    def _handle_serve(self, ev) -> None:
+        """One scripted serve-pressure step: ``demand`` is the co-tenant
+        serve group's priced SLO debt. A peak step with no active lease
+        is a borrow incident through the REAL arbiter; a trough step is
+        just the debt clearing — reclaim happens at lease expiry (the
+        sweep), absence of renewal being the off-peak signal, exactly
+        like the live master."""
+        self._serve_debt = max(ev.demand, 0.0)
+        if self._serve_debt > 0 and not self.pool.leases.active():
+            self._pool_borrow(ev)
+
+    def _pool_borrow(self, ev) -> None:
+        spares = self._spares()
+        decision = self.pool.decide_borrow(
+            POOL_SERVE_TENANT, 1,
+            train_hosts=self._pool_train_hosts(),
+            spare_hosts=len(spares),
+            slo_debt_s=self._serve_debt,
+            lender=POOL_TRAIN_TENANT,
+            cause=ev.cause or "serve_peak")
+        rate_before = self._rate()
+        realized = 0.0
+        host: int | None = None
+        if decision.mechanism == MECH_BORROW_SPARE and spares:
+            host = spares[-1]
+        elif decision.mechanism == MECH_BORROW_DRAIN:
+            assigned = sorted(h for p in self.pipelines for h in p.hosts)
+            host = assigned[-1] if assigned else None
+        if host is None:
+            self._pool_stats["denied"] += 1
+        else:
+            lease = self.pool.leases.grant(
+                POOL_SERVE_TENANT, [self._ip(host)], POOL_LEASE_TTL_S,
+                lender=POOL_TRAIN_TENANT, trace_id=decision.trace_id or "")
+            self._leased_at[lease.lease_id] = self.now
+            self._pool_stats["granted"] += 1
+            self.live.discard(host)
+            realized = (decision.arms[decision.mechanism]["latency_s"]
+                        * self.rng.uniform(JITTER_LO, JITTER_HI))
+            self.pool.observe_measured(decision.mechanism, realized)
+            if decision.mechanism == MECH_BORROW_DRAIN:
+                # Proactive drain, the slowdown path's shape: checkpoint
+                # flush + clean exit, survivors re-instantiate without
+                # the victim. No host died — the drain is the only stall.
+                dead = [i for i, p in enumerate(self.pipelines)
+                        if host in p.hosts]
+                for i in reversed(dead):
+                    self.pipelines.pop(i)
+                self._rebuild()
+                self._recovery_until = max(self._recovery_until,
+                                           self.now + realized)
+                self._push(self._recovery_until, "recovered", None)
+            self._pool_stats["train_charged_s"] += realized
+            self.pool.tenants.attribute(
+                decision.trace_id or "", {POOL_TRAIN_TENANT: realized},
+                cause="pool_borrow")
+            self._push(round(self.now + POOL_LEASE_TTL_S, 6),
+                       "lease_expire", lease.lease_id)
+        self._pool_incident(decision, ev.cause or "serve_peak",
+                            realized, rate_before)
+
+    def _pool_expire(self, lease_id: str) -> None:
+        """Lease-sweep point: the REAL arbiter scores hold-vs-reclaim.
+        A hold (borrower renewed under live pressure) extends and
+        re-arms the sweep; otherwise the chips ride the grow path home
+        and training re-instantiates over them."""
+        lease = self.pool.leases.get(lease_id)
+        if lease is None:
+            return
+        if not lease.expired(self.now):
+            self._push(round(lease.expires_at, 6), "lease_expire", lease_id)
+            return
+        decision = self.pool.decide_reclaim(
+            lease, train_hosts=self._pool_train_hosts(),
+            slo_debt_s=self._serve_debt, cause="expiry")
+        rate_before = self._rate()
+        realized = 0.0
+        if decision.mechanism == MECH_HOLD:
+            self.pool.leases.extend(lease_id, POOL_LEASE_TTL_S)
+            self._push(round(self.now + POOL_LEASE_TTL_S, 6),
+                       "lease_expire", lease_id)
+            self._pool_stats["held"] += 1
+        else:
+            ended = self.pool.leases.end(lease_id, ST_EXPIRED)
+            for ip in ended.hosts:
+                self.live.add(self._host_of(ip))
+            self._rebuild()
+            realized = (decision.arms[decision.mechanism]["latency_s"]
+                        * self.rng.uniform(JITTER_LO, JITTER_HI))
+            self.pool.observe_measured(decision.mechanism, realized)
+            self._recovery_until = max(self._recovery_until,
+                                       self.now + realized)
+            self._push(self._recovery_until, "recovered", None)
+            self._pool_stats["train_charged_s"] += realized
+            self._pool_stats["chip_seconds_lent"] += len(ended.hosts) * (
+                self.now - self._leased_at.pop(lease_id, self.now))
+            self.pool.tenants.attribute(
+                decision.trace_id or "", {POOL_TRAIN_TENANT: realized},
+                cause="pool_expiry")
+        self._pool_incident(decision, "expiry", realized, rate_before)
+
+    def _pool_incident(self, decision, cause: str, realized: float,
+                       rate_before: float) -> None:
+        reg = self.registry
+        if realized > 0:
+            reg.histogram(
+                "oobleck_sim_recovery_seconds",
+                "Simulated realized recovery latency by mechanism",
+            ).observe(realized, mechanism=decision.mechanism)
+        reg.counter(
+            "oobleck_sim_incidents_total",
+            "Simulated incidents by mechanism and cause",
+        ).inc(mechanism=decision.mechanism, cause=cause)
+        self.incidents.append({
+            "t": round(self.now, 6),
+            "direction": f"pool_{decision.direction}",
+            "lost_hosts": 0,
+            "cause": cause,
+            "correlated": False,
+            "proactive": True,
+            "tenant": decision.tenant,
+            "slo_debt_s": round(decision.slo_debt_s, 6),
+            "mechanism": decision.mechanism,
+            "reason": decision.reason,
+            "projected_cost_s": round(decision.projected_cost_s or 0.0, 6),
+            "realized_recovery_s": round(realized, 6),
+            "arms": decision.arms,
+            "rate_before": round(rate_before, 6),
+            "rate_after": round(self._rate(), 6),
+            "live_hosts": len(self.live),
+            "pipelines": len(self.pipelines),
+        })
+
     # -- the run ------------------------------------------------------------- #
 
     def _push(self, t: float, kind: str, payload) -> None:
@@ -679,6 +863,9 @@ class SimCluster:
                     self._handle_join(batch)
                 elif payload.kind == "slow":
                     self._set_slow(payload)
+                elif payload.kind == "serve":
+                    if self.pool is not None:
+                        self._handle_serve(payload)
                 elif payload.kind == "master_down":
                     # The control plane dies; training does not. Extend
                     # (never shorten) on overlapping outages.
@@ -692,6 +879,9 @@ class SimCluster:
             elif kind == "master_up":
                 if t >= self._master_down_until:
                     self._reconcile_outage()
+            elif kind == "lease_expire":
+                if self.pool is not None:
+                    self._pool_expire(payload)
             elif kind == "expire":
                 if payload in self.live:
                     from oobleck_tpu.sim.scenarios import ScenarioEvent
@@ -721,7 +911,7 @@ class SimCluster:
             "oobleck_sim_goodput_ratio",
             "Delivered/demanded goodput over the scenario",
         ).set(goodput)
-        return {
+        out = {
             "scenario": {
                 "name": self.scenario.name,
                 "seed": self.scenario.seed,
@@ -740,3 +930,19 @@ class SimCluster:
                 "quarantined": len(self.engine.health.quarantined()),
             },
         }
+        if self.pool is not None:
+            # Present only for shared-pool scenarios, so every other
+            # scenario's run record (and render) stays byte-identical.
+            snap = self.pool.leases.snapshot()
+            out["pool"] = {
+                "granted": self._pool_stats["granted"],
+                "denied": self._pool_stats["denied"],
+                "held": self._pool_stats["held"],
+                "ended": snap["ended"],
+                "still_active": len(snap["active"]),
+                "chip_seconds_lent": round(
+                    self._pool_stats["chip_seconds_lent"], 6),
+                "train_charged_s": round(
+                    self._pool_stats["train_charged_s"], 6),
+            }
+        return out

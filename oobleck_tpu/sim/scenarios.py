@@ -33,8 +33,10 @@ class ScenarioEvent:
     (demand factor changes), "master_down" (the control plane itself
     dies for repair_delay_s; the fleet keeps training masterless and
     losses inside the window wait for the restarted master's
-    reconcile), or "slow" (gray failure: the host keeps training but its
-    steps stretch by ``factor``; factor 1.0 = recovered)."""
+    reconcile), "slow" (gray failure: the host keeps training but its
+    steps stretch by ``factor``; factor 1.0 = recovered), or "serve"
+    (shared-pool scenarios: a co-tenant serve group's priced pressure
+    changes — ``demand`` carries the SLO debt in seconds, 0 = trough)."""
 
     t: float
     kind: str
@@ -284,6 +286,38 @@ def straggler(rng: random.Random, hosts: int, duration_s: float, *,
     return events
 
 
+def shared_pool(rng: random.Random, hosts: int, duration_s: float, *,
+                period_s: float = 600.0, peak_debt_s: float = 90.0,
+                mean_interarrival_s: float = 60.0,
+                mean_repair_s: float = 120.0) -> list[ScenarioEvent]:
+    """Multi-tenant chip pool: a diurnal serve-pressure wave over
+    background training churn. The wave steps through a piecewise
+    triangle (trough half at zero — off-peak IS the reclaim signal),
+    each step one "serve" event whose ``demand`` carries the priced SLO
+    debt in seconds. The cluster model feeds these to the REAL
+    PoolArbiter: peak steps become borrow incidents, lease expiry
+    mid-peak exercises the re-borrow path, and expiry in the trough
+    sends the chips home through the grow path. Incident ids live in
+    the 4_000_000 band (never collide with churn/join/outage/straggler
+    ids)."""
+    events = churn_storm(rng, hosts, duration_s,
+                         mean_interarrival_s=mean_interarrival_s,
+                         mean_repair_s=mean_repair_s)
+    incident = 4_000_000
+    # 8 steps per period: a trough half and a triangle to the peak.
+    profile = [0.0, 0.0, 0.5, 1.0, 1.0, 0.5, 0.0, 0.0]
+    t, i = 0.0, 0
+    while t < duration_s:
+        events.append(ScenarioEvent(
+            t=round(t, 6), kind="serve", incident_id=incident,
+            cause="serve_wave",
+            demand=round(peak_debt_s * profile[i % len(profile)], 6)))
+        incident += 1
+        t += period_s / len(profile)
+        i += 1
+    return events
+
+
 GENERATORS = {
     "churn_storm": churn_storm,
     "master_outage": master_outage,
@@ -293,6 +327,7 @@ GENERATORS = {
     "flap_sequence": flap_sequence,
     "diurnal_traffic": diurnal_traffic,
     "straggler": straggler,
+    "shared_pool": shared_pool,
 }
 
 

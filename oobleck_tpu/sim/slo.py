@@ -101,6 +101,10 @@ def slo_report(run: dict) -> dict:
         "prior_sources": sorted(prior_sources),
         "final": dict(run["final"]),
     }
+    if "pool" in run:
+        # Shared-pool scenarios only: lease traffic + the cross-tenant
+        # bill. Absent otherwise, so single-tenant renders are unchanged.
+        report["pool"] = dict(run["pool"])
     return report
 
 

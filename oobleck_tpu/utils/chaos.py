@@ -90,6 +90,17 @@ directives; each directive is ``action=arg[:qual][@ip]``:
                                 ``slow_host=10.0.0.1:2.5@3`` starts
                                 slowing on the 4th step poll (a healthy
                                 baseline first, then degradation)
+    traffic_wave=40:20          serve traffic wave: the open-loop load
+                                generator ramps its request rate in a
+                                triangle wave peaking at 40 req/s with a
+                                20 s period — the injectable diurnal peak
+                                that drives pool borrow/return cycles
+                                without a real client fleet. Like
+                                join_host, the ``@`` segment is a poll
+                                delay: ``traffic_wave=40:20@3`` stays at
+                                baseline for 3 polls first. NON-consuming
+                                after activation; activation is
+                                flight-recorded once
 
 Barriers are explicit calls (``chaos().barrier("step_end", ip=...)``)
 placed at recovery-relevant points: worker start, step start/end, and
@@ -119,7 +130,7 @@ _KNOWN_ACTIONS = ("delay_send", "drop_send", "stall_heartbeat", "kill_at",
                   "delay_at", "kill_stage", "flap_host", "kill_hosts",
                   "preempt_notice", "join_host", "join_hosts",
                   "spot_lifetime", "kill_master", "partition_master",
-                  "slow_host")
+                  "slow_host", "traffic_wave")
 
 
 @dataclass
@@ -218,6 +229,14 @@ def parse_spec(spec: str) -> list[Rule]:
                 raise ValueError(
                     f"slow_host needs a factor > 1.0: {directive!r}")
             int(rule.ip or 0)       # @segment = step-boundary delay
+        elif action == "traffic_wave":
+            if float(rule.arg) <= 0:  # traffic_wave=<peak_rps>:<period_s>[@<poll>]
+                raise ValueError(
+                    f"traffic_wave needs a positive peak rps: {directive!r}")
+            if float(rule.qual or 0) <= 0:
+                raise ValueError(
+                    f"traffic_wave needs a positive period: {directive!r}")
+            int(rule.ip or 0)       # @segment = poll delay
         elif rule.qual is not None:
             int(rule.qual)
         rules.append(rule)
@@ -488,6 +507,38 @@ class Chaos:
                     "chaos_injection", action="slow_host", ip=ip,
                     factor=factor)
             return float(r.qual or 0)
+        return None
+
+    # -- serve traffic wave (pool-plane fault) ------------------------------ #
+
+    def traffic_wave(self) -> tuple[float, float] | None:
+        """(peak_rps, period_s) of the injected serve traffic wave once its
+        rule has activated, else None. The load generator polls once per
+        tick; a rule with ``@<poll>`` activates on poll number poll+1
+        (deterministic, like slow_factor). NON-consuming after activation
+        — the wave keeps oscillating until the run ends; the activation is
+        flight-recorded once."""
+        for r in self.rules:
+            if r.action != "traffic_wave":
+                continue
+            i = self.rules.index(r)
+            n = self._counts.get(i, 0)
+            if n >= 0:
+                delay = int(r.ip or 0)
+                if n < delay:
+                    self._counts[i] = n + 1
+                    return None
+                self._counts[i] = -1  # active from here on
+                peak, period = float(r.arg), float(r.qual or 0)
+                logger.warning(
+                    "chaos: serve traffic wave active (peak %.1f rps, "
+                    "period %.1fs)", peak, period)
+                from oobleck_tpu.utils import metrics
+
+                metrics.flight_recorder().record(
+                    "chaos_injection", action="traffic_wave",
+                    peak_rps=peak, period_s=period)
+            return float(r.arg), float(r.qual or 0)
         return None
 
     # -- named barriers ---------------------------------------------------- #

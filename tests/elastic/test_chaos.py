@@ -109,6 +109,12 @@ def test_parse_spec_outage_directives():
     "slow_host=10.0.0.1",     # no factor
     "slow_host=10.0.0.1:1.0",         # factor must exceed 1.0
     "slow_host=10.0.0.1:2.5@soon",    # non-integer step delay
+    "traffic_wave=40",        # no period
+    "traffic_wave=0:20",      # non-positive peak rps
+    "traffic_wave=-5:20",     # negative peak rps
+    "traffic_wave=40:0",      # non-positive period
+    "traffic_wave=soon:20",   # non-numeric peak
+    "traffic_wave=40:20@soon",        # non-integer poll delay
 ])
 def test_parse_spec_rejects_typos_eagerly(bad):
     # A typo'd injection spec must fail the run at parse time, not
@@ -239,6 +245,41 @@ def test_slow_factor_activation_and_persistence():
     # No delay segment: slow from the first poll.
     now = Chaos("slow_host=10.0.0.2:4")
     assert now.slow_factor("10.0.0.2") == pytest.approx(4.0)
+
+
+def test_parse_spec_traffic_wave_grammar():
+    """Serve traffic wave (pool plane): traffic_wave=<peak>:<period>[@poll]
+    — the @ segment is a load-generator POLL delay, like join_host's
+    step delay (there is no victim process to filter on)."""
+    rules = parse_spec("traffic_wave=40:20, traffic_wave=12.5:60@3")
+    assert [(r.action, r.arg, r.qual, r.ip) for r in rules] == [
+        ("traffic_wave", "40", "20", None),
+        ("traffic_wave", "12.5", "60", "3"),
+    ]
+
+
+def test_traffic_wave_activation_delay_and_persistence():
+    """traffic_wave is polled once per load-generator tick: @<poll>
+    matures on poll+1, then the wave is NON-consuming — it oscillates
+    until the run ends. Activation flight-records exactly once."""
+    from oobleck_tpu.utils import metrics
+
+    c = Chaos("traffic_wave=40:20@2")
+    assert c.traffic_wave() is None                   # poll 1: maturing
+    assert c.traffic_wave() is None                   # poll 2: maturing
+    assert c.traffic_wave() == (40.0, 20.0)
+    assert c.traffic_wave() == (40.0, 20.0)           # persists
+    injected = [e for e in metrics.flight_recorder().events()
+                if e["event"] == "chaos_injection"
+                and e.get("action") == "traffic_wave"]
+    assert len(injected) == 1
+    assert injected[0]["peak_rps"] == pytest.approx(40.0)
+    assert injected[0]["period_s"] == pytest.approx(20.0)
+    # No delay segment: the wave is live from the first poll.
+    now = Chaos("traffic_wave=8:5")
+    assert now.traffic_wave() == (8.0, 5.0)
+    # No wave directive at all: always None.
+    assert Chaos("delay_send=0.1").traffic_wave() is None
 
 
 def test_inactive_chaos_is_a_noop():

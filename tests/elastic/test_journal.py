@@ -16,6 +16,7 @@ from oobleck_tpu.elastic.journal import (
     EV_INCIDENT_OPEN,
     EV_JOB,
     EV_JOB_DONE,
+    EV_LEASE,
     EV_QUARANTINE,
     EV_REGISTER,
     JOURNAL_FILE,
@@ -124,6 +125,78 @@ def test_status_is_bounded_and_plain(tmp_path):
     assert st["open_incidents"] == 1
     assert st["replayed_entries"] == 0
     json.dumps(st)  # /status must serialize
+
+
+def test_jobs_replay_keyed_by_tenant_not_last_writer_wins(tmp_path):
+    """Multi-job fix (pool plane): EV_JOB entries for N tenants replay as
+    N jobs; ending one tenant's job leaves the others running. The bare
+    "job" slot stays a live mirror of the DEFAULT tenant only, so
+    pre-pool readers see exactly what they always saw."""
+    j = reopened(tmp_path)
+    j.append(EV_JOB, args={"model": "m0"})                    # default
+    j.append(EV_JOB, args={"model": "m1"}, tenant="train-b")
+    j.append(EV_JOB_DONE, tenant="train-b")
+    j.append(EV_JOB, args={"model": "m2"}, tenant="train-c")
+    j.close()
+    s = reopened(tmp_path).state
+    assert s["jobs"] == {"default": {"model": "m0"},
+                         "train-c": {"model": "m2"}}
+    assert s["job"] == {"model": "m0"}  # legacy mirror: default only
+
+
+def test_non_default_job_done_keeps_legacy_mirror(tmp_path):
+    j = reopened(tmp_path)
+    j.append(EV_JOB, args={"model": "m0"})
+    j.append(EV_JOB, args={"model": "m1"}, tenant="train-b")
+    j.append(EV_JOB_DONE)  # default tenant's job ends
+    j.close()
+    s = reopened(tmp_path).state
+    assert s["job"] is None
+    assert s["jobs"] == {"train-b": {"model": "m1"}}
+
+
+def test_legacy_single_job_snapshot_lifts_into_tenant_map(tmp_path):
+    """A snapshot from a pre-multi-job incarnation carries only the bare
+    "job" slot; replay must lift it into the tenant-keyed map."""
+    (tmp_path / SNAPSHOT_FILE).write_text(json.dumps({
+        "epoch": 3, "entries": 0,
+        "state": {"agents": {}, "job": {"model": "old"}}}))
+    s = reopened(tmp_path).state
+    assert s["jobs"] == {"default": {"model": "old"}}
+    assert s["job"] == {"model": "old"}
+
+
+def test_lease_entries_fold_active_and_pop_on_end(tmp_path):
+    j = reopened(tmp_path)
+    j.append(EV_LEASE, lease_id="lease-1", state="active",
+             tenant="serve-a", lender="default",
+             hosts=["10.0.0.3"], expires_at=5_000_060.0)
+    j.append(EV_LEASE, lease_id="lease-2", state="active",
+             tenant="serve-b", hosts=["10.0.0.4"], expires_at=5_000_090.0)
+    j.append(EV_LEASE, lease_id="lease-2", state="returned")
+    j.append(EV_LEASE, state="active")  # no lease_id: ignored, not fatal
+    j.close()
+    s = reopened(tmp_path).state
+    assert list(s["leases"]) == ["lease-1"]
+    rec = s["leases"]["lease-1"]
+    assert rec["tenant"] == "serve-a"
+    assert rec["hosts"] == ["10.0.0.3"]
+    assert rec["expires_at"] == 5_000_060.0
+
+
+def test_torn_tail_after_lease_entries_keeps_intact_prefix(tmp_path):
+    """The PR-16 torn-tail guarantee must hold with pool-plane entries in
+    the journal: a crash mid-lease-append drops only the tear."""
+    j = reopened(tmp_path)
+    j.append(EV_REGISTER, ip="10.0.0.1", tenant="default")
+    j.append(EV_LEASE, lease_id="lease-1", state="active",
+             tenant="serve-a", hosts=["10.0.0.1"], expires_at=1e6)
+    j.close()
+    with open(tmp_path / JOURNAL_FILE, "ab") as f:
+        f.write(b'{"kind": "lease", "lease_id": "lease-2", "st')  # torn
+    j2 = reopened(tmp_path)
+    assert list(j2.state["leases"]) == ["lease-1"]
+    assert j2.replayed_entries == 2
 
 
 def test_health_restore_converts_wall_clock_to_tracker_clock():

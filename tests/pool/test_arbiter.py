@@ -1,0 +1,209 @@
+"""Pool arbiter (pool/arbiter.py): the cross-tenant decision engine.
+
+The economics under test (priors from policy/signals.py: deny 0.0,
+borrow_spare 0.1, borrow_drain 2.5, hold 0.0, reclaim_grow 1.2):
+
+* borrow — an idle requester is denied for free; live SLO debt rides
+  the arms that leave it unrelieved, so a real peak flips to borrowing;
+  spare capacity beats preempting training; the lease TTL is the
+  amortization window, so a long lease prices the drain dilution up.
+* reclaim — hold is free but dilutes training for the remaining lease;
+  a borrower's still-live debt rides reclaim_grow (taking chips back
+  re-exposes the peak), so the arbiter holds through peaks and reclaims
+  off-peak; an expired lease makes hold infeasible — leases end.
+"""
+
+import pytest
+
+from oobleck_tpu.pool.arbiter import (
+    ENV_POOL_POLICY,
+    MECH_BORROW_DRAIN,
+    MECH_BORROW_SPARE,
+    MECH_DENY,
+    MECH_HOLD,
+    MECH_RECLAIM_GROW,
+    MODE_ADAPTIVE,
+    PoolArbiter,
+)
+from oobleck_tpu.pool.leases import LeaseBook
+from oobleck_tpu.utils import metrics
+
+
+@pytest.fixture
+def clock():
+    now = {"t": 1000.0}
+
+    def read():
+        return now["t"]
+
+    read.advance = lambda dt: now.__setitem__("t", now["t"] + dt)
+    return read
+
+
+@pytest.fixture
+def arbiter(clock):
+    return PoolArbiter(clock=clock, mode=MODE_ADAPTIVE,
+                       registry=metrics.Registry(),
+                       lease_ttl_s=60.0, min_train_hosts=1)
+
+
+def lease_for(clock, hosts, ttl_s=60.0):
+    return LeaseBook(clock=clock).grant("serve-a", hosts, ttl_s)
+
+
+# -- borrow direction -------------------------------------------------- #
+
+
+def test_idle_borrow_is_denied_for_free(arbiter):
+    d = arbiter.decide_borrow("serve-a", 1, train_hosts=4)
+    assert d.direction == "borrow"
+    assert d.mechanism == MECH_DENY
+    assert d.reason == "cheapest"
+    assert d.projected_cost_s == 0.0
+    assert d.infeasible == {MECH_BORROW_SPARE: "no_spare_capacity"}
+    assert d.trace_id
+
+
+def test_live_debt_flips_to_borrow_drain(arbiter):
+    # deny now costs the debt (90 s); draining one of four costs
+    # 2.5 latency + 2.5 preempt + 0.25 * 60 dilution = 20 s.
+    d = arbiter.decide_borrow("serve-a", 1, train_hosts=4, slo_debt_s=90.0)
+    assert d.mechanism == MECH_BORROW_DRAIN
+    assert d.costs[MECH_DENY] == pytest.approx(90.0)
+    assert d.costs[MECH_BORROW_DRAIN] == pytest.approx(20.0)
+    assert d.slo_debt_s == 90.0
+    assert d.horizon_s == 60.0
+
+
+def test_spare_capacity_beats_preempting_training(arbiter):
+    d = arbiter.decide_borrow("serve-a", 1, train_hosts=4, spare_hosts=2,
+                              slo_debt_s=90.0)
+    assert d.mechanism == MECH_BORROW_SPARE
+    assert MECH_BORROW_SPARE not in d.infeasible
+
+
+def test_lease_ttl_is_the_amortization_window(arbiter):
+    # Same 30 s debt: a short lease makes the drain dilution cheap
+    # (0.25 * 60 = 15 s < 30), a long one prices it past deny
+    # (0.25 * 240 = 60 s).
+    short = arbiter.decide_borrow("serve-a", 1, train_hosts=4,
+                                  slo_debt_s=30.0, lease_ttl_s=60.0)
+    long = arbiter.decide_borrow("serve-a", 1, train_hosts=4,
+                                 slo_debt_s=30.0, lease_ttl_s=240.0)
+    assert short.mechanism == MECH_BORROW_DRAIN
+    assert long.mechanism == MECH_DENY
+    assert long.horizon_s == 240.0
+
+
+def test_train_floor_keeps_last_host(arbiter):
+    # Draining the only training host would kill the job: infeasible,
+    # and with no spares the arbiter denies even under heavy debt.
+    d = arbiter.decide_borrow("serve-a", 1, train_hosts=1,
+                              slo_debt_s=500.0)
+    assert d.mechanism == MECH_DENY
+    assert d.infeasible[MECH_BORROW_DRAIN] == "train_floor"
+
+
+# -- reclaim direction ------------------------------------------------- #
+
+
+def test_negligible_dilution_holds_to_expiry(arbiter, clock):
+    # 1 leased host against 63 training hosts: dilution over the
+    # remaining 60 s (~0.94 s) is cheaper than the 1.2 s grow path —
+    # the arbiter never returns early when holding is nearly free.
+    d = arbiter.decide_reclaim(lease_for(clock, ["h1"]), train_hosts=63)
+    assert d.direction == "reclaim"
+    assert d.mechanism == MECH_HOLD
+
+
+def test_painful_dilution_reclaims_early(arbiter, clock):
+    # 1 of 4 hosts out on lease: 15 s of dilution remaining vs the
+    # 1.2 s grow path — take the chips back.
+    d = arbiter.decide_reclaim(lease_for(clock, ["h1"]), train_hosts=3)
+    assert d.mechanism == MECH_RECLAIM_GROW
+    assert d.costs[MECH_HOLD] == pytest.approx(15.0)
+
+
+def test_live_pressure_holds_through_the_peak(arbiter, clock):
+    # The borrower's debt rides reclaim_grow: re-exposing a tenant
+    # mid-peak costs more than the dilution of holding.
+    d = arbiter.decide_reclaim(lease_for(clock, ["h1"]), train_hosts=3,
+                               slo_debt_s=90.0)
+    assert d.mechanism == MECH_HOLD
+    assert d.costs[MECH_RECLAIM_GROW] == pytest.approx(91.2)
+
+
+def test_expired_lease_must_end(arbiter, clock):
+    lease = lease_for(clock, ["h1"], ttl_s=10.0)
+    clock.advance(11.0)
+    d = arbiter.decide_reclaim(lease, train_hosts=3, slo_debt_s=500.0)
+    assert d.mechanism == MECH_RECLAIM_GROW
+    assert d.infeasible[MECH_HOLD] == "lease_expired"
+    assert d.horizon_s == 0.0
+
+
+# -- forced modes ------------------------------------------------------ #
+
+
+def test_forced_arm_pins_its_direction(clock):
+    arb = PoolArbiter(clock=clock, mode=MECH_BORROW_DRAIN,
+                      registry=metrics.Registry(), lease_ttl_s=60.0)
+    d = arb.decide_borrow("serve-a", 1, train_hosts=4)
+    assert d.mechanism == MECH_BORROW_DRAIN
+    assert d.reason == f"forced:{MECH_BORROW_DRAIN}"
+    # ...and ONLY its direction: reclaim decisions stay adaptive.
+    r = arb.decide_reclaim(lease_for(clock, ["h1"]), train_hosts=3)
+    assert r.mechanism == MECH_RECLAIM_GROW
+    assert r.reason == "cheapest"
+
+
+def test_infeasible_forced_arm_falls_back_honestly(clock):
+    arb = PoolArbiter(clock=clock, mode=MECH_BORROW_SPARE,
+                      registry=metrics.Registry(), lease_ttl_s=60.0)
+    d = arb.decide_borrow("serve-a", 1, train_hosts=4, slo_debt_s=90.0)
+    assert d.mechanism == MECH_DENY
+    assert d.reason == \
+        f"forced:{MECH_BORROW_SPARE}:infeasible:no_spare_capacity"
+
+
+def test_mode_comes_from_env_and_bad_values_fail_loud(clock, monkeypatch):
+    monkeypatch.setenv(ENV_POOL_POLICY, MECH_HOLD)
+    assert PoolArbiter(clock=clock).mode == MECH_HOLD
+    monkeypatch.setenv(ENV_POOL_POLICY, "yolo")
+    with pytest.raises(ValueError):
+        PoolArbiter(clock=clock)
+
+
+# -- feedback + status ------------------------------------------------- #
+
+
+def test_observe_measured_updates_ewma_and_closes_the_loop(arbiter):
+    d = arbiter.decide_borrow("serve-a", 1, train_hosts=4, slo_debt_s=90.0)
+    assert d.mechanism == MECH_BORROW_DRAIN and d.measured_s is None
+    arbiter.observe_measured(MECH_BORROW_DRAIN, 3.0)
+    assert d.measured_s == 3.0
+    arbiter.observe_measured(MECH_BORROW_DRAIN, 1.0)
+    # EWMA alpha 0.5: 0.5*3.0 + 0.5*1.0
+    assert arbiter._ewma[MECH_BORROW_DRAIN] == pytest.approx(2.0)
+    # The next decision scores with the measured latency, not the prior.
+    d2 = arbiter.decide_borrow("serve-a", 1, train_hosts=4, slo_debt_s=90.0)
+    assert d2.arms[MECH_BORROW_DRAIN]["latency_s"] == pytest.approx(2.0)
+    assert d2.arms[MECH_BORROW_DRAIN]["latency_source"] != ""
+
+
+def test_decision_payload_and_status_shape(arbiter, clock):
+    arbiter.decide_borrow("serve-a", 1, train_hosts=4, slo_debt_s=90.0)
+    arbiter.decide_reclaim(lease_for(clock, ["h1"]), train_hosts=3)
+    st = arbiter.status()
+    assert st["enabled"] is True
+    assert st["mode"] == MODE_ADAPTIVE
+    assert st["lease_ttl_s"] == 60.0
+    assert {"tenants", "leases", "decisions"} <= set(st)
+    last = st["decisions"][-1]
+    assert last["direction"] == "reclaim"
+    assert {"mechanism", "costs", "infeasible", "slo_debt_s",
+            "trace_id"} <= set(last)
+    # decisions ring is bounded (the /status contract)
+    for _ in range(30):
+        arbiter.decide_borrow("serve-a", 1, train_hosts=4)
+    assert len(arbiter.status()["decisions"]) == 16

@@ -1,0 +1,115 @@
+"""Lease book (pool/leases.py): grants, terminal transitions, extension,
+expiry surfacing, host lookups, and the journal-restore path that keeps a
+restarted master from reissuing a dead incarnation's lease ids."""
+
+import pytest
+
+from oobleck_tpu.pool.leases import (
+    ST_ACTIVE,
+    ST_EXPIRED,
+    ST_RECLAIMED,
+    ST_RETURNED,
+    ChipLease,
+    LeaseBook,
+)
+
+
+@pytest.fixture
+def clock():
+    now = {"t": 1000.0}
+
+    def read():
+        return now["t"]
+
+    read.advance = lambda dt: now.__setitem__("t", now["t"] + dt)
+    return read
+
+
+@pytest.fixture
+def book(clock):
+    return LeaseBook(clock=clock)
+
+
+def test_grant_assigns_monotonic_ids_and_expiry(book, clock):
+    a = book.grant("serve-a", ["10.0.0.3"], 60.0)
+    b = book.grant("serve-b", ["10.0.0.4", "10.0.0.5"], 30.0,
+                   lender="train-x", trace_id="t1")
+    assert (a.lease_id, b.lease_id) == ("lease-1", "lease-2")
+    assert a.state == ST_ACTIVE
+    assert a.expires_at == pytest.approx(1060.0)
+    assert b.lender == "train-x" and b.trace_id == "t1"
+    assert b.remaining_s(clock()) == pytest.approx(30.0)
+    assert not a.expired(clock())
+    clock.advance(61.0)
+    assert a.expired(clock())
+    assert a.remaining_s(clock()) == 0.0  # clamped, never negative
+
+
+def test_end_is_terminal_and_counted(book):
+    a = book.grant("serve-a", ["h1"], 60.0)
+    ended = book.end(a.lease_id, ST_RETURNED)
+    assert ended is a and ended.state == ST_RETURNED
+    assert book.get(a.lease_id) is None
+    assert book.end(a.lease_id, ST_RECLAIMED) is None  # already ended
+    assert book.end("lease-999", ST_EXPIRED) is None   # unknown
+    snap = book.snapshot()
+    assert snap["granted_total"] == 1
+    assert snap["ended"] == {ST_RETURNED: 1}
+    assert snap["active"] == []
+
+
+def test_extend_pushes_expiry_from_now(book, clock):
+    a = book.grant("serve-a", ["h1"], 10.0)
+    clock.advance(8.0)
+    assert book.extend(a.lease_id, 60.0) is a
+    assert a.expires_at == pytest.approx(1068.0)  # from NOW, not stacked
+    assert book.extend("lease-999", 60.0) is None
+
+
+def test_due_surfaces_expired_but_ends_nothing(book, clock):
+    a = book.grant("serve-a", ["h1"], 10.0)
+    b = book.grant("serve-b", ["h2"], 100.0)
+    assert book.due() == []
+    clock.advance(11.0)
+    assert book.due() == [a]
+    # due() is a read: the arbiter decides, the book never self-ends.
+    assert book.get(a.lease_id) is a
+    assert set(le.lease_id for le in book.active()) == \
+        {a.lease_id, b.lease_id}
+
+
+def test_host_lookups(book):
+    a = book.grant("serve-a", ["h1", "h2"], 60.0)
+    book.grant("serve-b", ["h3"], 60.0)
+    assert book.leased_hosts() == {"h1", "h2", "h3"}
+    assert book.find_by_host("h2") is a
+    assert book.find_by_host("h9") is None
+
+
+def test_as_record_is_wire_shaped(book):
+    a = book.grant("serve-a", ["h1"], 60.0, trace_id="t-9")
+    rec = a.as_record()
+    assert rec["lease_id"] == "lease-1"
+    assert rec["state"] == ST_ACTIVE
+    assert rec["hosts"] == ["h1"]
+    assert rec["trace_id"] == "t-9"
+    # a copy, not an alias into the live lease
+    rec["hosts"].append("h2")
+    assert a.hosts == ["h1"]
+
+
+def test_restore_resumes_seq_past_replayed_ids(clock):
+    book = LeaseBook(clock=clock)
+    book.restore({
+        "lease-7": {"tenant": "serve-a", "lender": "default",
+                    "hosts": ["h1"], "expires_at": 1500.0, "ts": 900.0},
+        "lease-3": {"tenant": "serve-b", "hosts": ["h2"],
+                    "expires_at": 1200.0},
+        "garbage": "not-a-dict",
+    })
+    restored = book.get("lease-7")
+    assert restored.tenant == "serve-a"
+    assert restored.expires_at == pytest.approx(1500.0)
+    assert book.get("lease-3").lender == "default"  # missing -> default
+    # The next grant never reuses an id a dead incarnation issued.
+    assert book.grant("serve-c", ["h3"], 60.0).lease_id == "lease-8"

@@ -1,0 +1,130 @@
+"""Serve pressure monitor (pool/pressure.py): score assembly from the
+batcher's metrics, hysteresis in both directions, the MAX_SCORE clamp,
+and the SLO-debt projection that prices a peak in arbiter seconds."""
+
+import pytest
+
+from oobleck_tpu.pool.pressure import MAX_SCORE, PressureMonitor
+from oobleck_tpu.utils import metrics
+
+
+@pytest.fixture
+def clock():
+    now = {"t": 0.0}
+
+    def read():
+        return now["t"]
+
+    read.advance = lambda dt: now.__setitem__("t", now["t"] + dt)
+    return read
+
+
+@pytest.fixture
+def reg():
+    # Hermetic registry: never the process-global one.
+    return metrics.Registry()
+
+
+@pytest.fixture
+def monitor(reg, clock):
+    return PressureMonitor(registry=reg, clock=clock,
+                           queue_high=4.0, ttft_slo_s=2.0, hysteresis=2)
+
+
+def set_queue(reg, depth):
+    reg.gauge("oobleck_serve_queue_depth", "").set(depth)
+
+
+def test_quiet_serve_scores_zero(monitor):
+    s = monitor.sample()
+    assert s["score"] == 0.0
+    assert not s["pressured"]
+    assert monitor.slo_debt_s(60.0) == 0.0
+
+
+def test_debt_is_zero_before_any_sample(monitor):
+    # Debt is a live price derived from the LAST sample, not a guess.
+    assert monitor.slo_debt_s(3600.0) == 0.0
+
+
+def test_hysteresis_flips_up_then_down(monitor, reg, clock):
+    set_queue(reg, 8.0)  # queue/high - 1 = 1.0
+    clock.advance(1.0)
+    assert not monitor.sample()["pressured"]  # streak 1 of 2
+    clock.advance(1.0)
+    assert monitor.sample()["pressured"]      # streak 2 -> flips
+    assert monitor.pressured
+    set_queue(reg, 0.0)
+    clock.advance(1.0)
+    assert monitor.sample()["pressured"]      # low streak 1: still holding
+    clock.advance(1.0)
+    assert not monitor.sample()["pressured"]  # low streak 2 -> clears
+    assert not monitor.pressured
+
+
+def test_one_burst_does_not_flip(monitor, reg, clock):
+    set_queue(reg, 20.0)
+    clock.advance(1.0)
+    monitor.sample()
+    set_queue(reg, 0.0)
+    clock.advance(1.0)
+    monitor.sample()
+    assert not monitor.pressured  # high streak reset before hysteresis
+
+
+def test_score_combines_queue_and_deadline_rate(monitor, reg, clock):
+    set_queue(reg, 6.0)  # +0.5
+    counter = reg.counter("oobleck_serve_requests_total", "")
+    clock.advance(1.0)
+    monitor.sample()  # baseline for the rate term
+    counter.inc(2.0, outcome="deadline_queued")
+    counter.inc(50.0, outcome="ok")  # other outcomes never count
+    clock.advance(4.0)
+    s = monitor.sample()
+    # 0.5 (queue) + 0.5 (2 expiries / 4s)
+    assert s["score"] == pytest.approx(1.0, abs=0.01)
+    assert s["deadline_queued_rate"] == pytest.approx(0.5)
+
+
+def test_ttft_above_slo_adds_pressure(monitor, reg, clock):
+    hist = reg.histogram("oobleck_serve_ttft_seconds", "")
+    for _ in range(100):
+        hist.observe(6.0)  # p99 well above the 2 s SLO
+    clock.advance(1.0)
+    s = monitor.sample()
+    assert s["ttft_p99_s"] is not None and s["ttft_p99_s"] >= 2.0
+    assert s["score"] > 0.0
+    # fast TTFT contributes nothing
+    fast = PressureMonitor(registry=metrics.Registry(), clock=clock,
+                           queue_high=4.0, ttft_slo_s=2.0, hysteresis=2)
+    assert fast.sample()["score"] == 0.0
+
+
+def test_score_clamps_at_max(monitor, reg, clock):
+    set_queue(reg, 1e6)
+    clock.advance(1.0)
+    s = monitor.sample()
+    assert s["score"] == MAX_SCORE
+    # Debt projects the clamped score — one pathological sample cannot
+    # price the fleet away.
+    assert monitor.slo_debt_s(60.0) == pytest.approx(MAX_SCORE * 60.0)
+    assert monitor.slo_debt_s(-5.0) == 0.0
+
+
+def test_as_payload_carries_priced_debt(monitor, reg, clock):
+    set_queue(reg, 8.0)
+    clock.advance(1.0)
+    monitor.sample()
+    payload = monitor.as_payload(horizon_s=60.0)
+    assert payload["score"] == pytest.approx(1.0)
+    assert payload["slo_debt_s"] == pytest.approx(60.0)
+    assert set(payload) >= {"queue_depth", "ttft_p99_s",
+                            "deadline_queued_rate", "pressured"}
+
+
+def test_pressure_score_gauge_is_published(monitor, reg, clock):
+    set_queue(reg, 8.0)
+    clock.advance(1.0)
+    monitor.sample()
+    series = reg.gauge("oobleck_pool_pressure_score", "").series()
+    assert [s["value"] for s in series] == [pytest.approx(1.0)]

@@ -1,0 +1,91 @@
+"""Tenant registry (pool/tenants.py): idempotent membership, the
+per-tenant goodput ledgers, and cross-tenant incident attribution — the
+property the pool plane exists for: every tenant's buckets still sum to
+its OWN wall-clock while one trace id totals the cross-tenant bill."""
+
+import pytest
+
+from oobleck_tpu.pool.tenants import (
+    KIND_SERVE,
+    KIND_TRAIN,
+    TenantRegistry,
+    TenantSpec,
+)
+
+
+@pytest.fixture
+def clock():
+    now = {"t": 0.0}
+
+    def read():
+        return now["t"]
+
+    read.advance = lambda dt: now.__setitem__("t", now["t"] + dt)
+    return read
+
+
+@pytest.fixture
+def reg(clock):
+    r = TenantRegistry(clock=clock)
+    r.register(TenantSpec("train-0", kind=KIND_TRAIN, slo={"min_hosts": 1}))
+    r.register(TenantSpec("serve-a", kind=KIND_SERVE, priority=1,
+                          slo={"ttft_p99_s": 2.0}))
+    return r
+
+
+def test_register_is_idempotent_but_keeps_ledger(reg, clock):
+    clock.advance(10.0)
+    reg.ledger("serve-a").attribute("t1", 3.0, bucket="recovery")
+    # Re-register with a new descriptor: spec updates, history survives.
+    reg.register(TenantSpec("serve-a", kind=KIND_SERVE, priority=9))
+    assert reg.get("serve-a").priority == 9
+    assert reg.ledger("serve-a").incident_cost("t1")["lost_s"] == \
+        pytest.approx(3.0)
+    assert reg.names() == ["serve-a", "train-0"]
+
+
+def test_unregistered_tenant_gets_ledger_on_first_touch(reg):
+    # Attribution must never be dropped because registration raced it.
+    reg.attribute("t2", {"ghost": 1.5}, cause="race")
+    assert reg.incident_cost("t2") == {
+        "ghost": {"lost_s": 1.5, "buckets": {"recovery": 1.5},
+                  "cause": "race"}}
+    assert reg.get("ghost") is None  # ledger != membership
+
+
+def test_cross_tenant_charge_lands_under_one_trace(reg, clock):
+    clock.advance(100.0)
+    reg.attribute("trace-borrow", {"train-0": 12.0, "serve-a": 0.5},
+                  bucket="recovery", cause="borrow_drain")
+    cost = reg.incident_cost("trace-borrow")
+    assert set(cost) == {"serve-a", "train-0"}
+    assert cost["train-0"]["lost_s"] == pytest.approx(12.0)
+    assert cost["serve-a"]["lost_s"] == pytest.approx(0.5)
+    assert cost["train-0"]["cause"] == "borrow_drain"
+    assert reg.incident_cost("trace-unknown") is None
+
+
+def test_buckets_sum_to_each_tenants_own_wall(reg, clock):
+    """The ledger invariant, per tenant: explained buckets + 'other'
+    equals that tenant's wall-clock, even after a cross-tenant charge."""
+    clock.advance(50.0)
+    reg.attribute("t3", {"train-0": 8.0, "serve-a": 2.0}, cause="reclaim")
+    for name in ("train-0", "serve-a"):
+        led = reg.ledger(name).snapshot()
+        assert sum(led["buckets"].values()) == pytest.approx(led["wall_s"])
+    train = reg.ledger("train-0").snapshot()
+    assert train["buckets"]["recovery"] == pytest.approx(8.0)
+    assert train["buckets"]["other"] == pytest.approx(42.0)
+
+
+def test_snapshot_is_status_shaped(reg, clock):
+    clock.advance(20.0)
+    reg.attribute("t4", {"train-0": 5.0})
+    snap = reg.snapshot()
+    assert set(snap) == {"serve-a", "train-0"}
+    t = snap["train-0"]
+    assert t["kind"] == KIND_TRAIN
+    assert t["slo"] == {"min_hosts": 1}
+    assert t["wall_s"] == pytest.approx(20.0)
+    assert t["incidents"] == 1
+    assert 0.0 <= t["goodput_fraction"] <= 1.0

@@ -255,6 +255,75 @@ def test_spot_joiner_expires_into_permanent_loss():
     assert run["final"]["live_hosts"] == 4
 
 
+def serve_event(t, debt, incident_id=4_000_000):
+    return ScenarioEvent(t=t, kind="serve", incident_id=incident_id,
+                         cause="serve_wave", demand=debt)
+
+
+def test_serve_peak_borrows_and_expiry_returns_via_grow():
+    """The full sim borrow/return cycle through the REAL PoolArbiter:
+    a priced peak drains one training host onto a lease; the trough
+    clears the debt; at expiry hold is infeasible (leases end) and the
+    chips ride the grow path home."""
+    sc = _scenario([serve_event(100.0, 90.0),
+                    serve_event(200.0, 0.0, 4_000_001)])
+    run = SimCluster(SimConfig(hosts=4), sc).run()
+    pool = run["pool"]
+    assert pool["granted"] == 1
+    assert pool["denied"] == 0
+    assert pool["ended"] == {"expired": 1}
+    assert pool["still_active"] == 0
+    # 1 host out from t=100 to the 180 s TTL expiry.
+    assert pool["chip_seconds_lent"] == pytest.approx(180.0, abs=1.0)
+    assert pool["train_charged_s"] > 0.0
+    borrow, reclaim = run["incidents"]
+    assert borrow["direction"] == "pool_borrow"
+    assert borrow["mechanism"] == "borrow_drain"  # 4 hosts, no spares
+    assert borrow["proactive"] is True
+    assert borrow["tenant"] == "serve"
+    assert borrow["slo_debt_s"] == pytest.approx(90.0)
+    assert borrow["lost_hosts"] == 0  # a drain, not a death
+    assert reclaim["direction"] == "pool_reclaim"
+    assert reclaim["mechanism"] == "reclaim_grow"
+    assert reclaim["t"] == pytest.approx(280.0)
+    assert reclaim["arms"]["hold"]["reason"] == "lease_expired"
+    # The fleet ends whole: borrowed chips came home.
+    assert run["final"]["live_hosts"] == 4
+    assert run["final"]["pipelines"] == 4
+
+
+def test_spare_capacity_lends_without_touching_pipelines():
+    # 5 hosts at 2 hosts/pipeline: 2 pipelines + 1 parked spare. The
+    # arbiter hands over the spare — no drain, no training stall.
+    sc = _scenario([serve_event(100.0, 90.0)], hosts=5, duration_s=400.0)
+    run = SimCluster(SimConfig(hosts=5, hosts_per_pipeline=2), sc).run()
+    borrow = run["incidents"][0]
+    assert borrow["mechanism"] == "borrow_spare"
+    assert borrow["rate_after"] == borrow["rate_before"]
+    assert run["pool"]["granted"] == 1
+
+
+def test_active_lease_is_never_doubled(monkeypatch):
+    # A second peak step while the lease is live must NOT borrow again:
+    # renewal is the sweep's business, not a new incident.
+    sc = _scenario([serve_event(100.0, 90.0),
+                    serve_event(175.0, 90.0, 4_000_001)])
+    run = SimCluster(SimConfig(hosts=4), sc).run()
+    assert run["pool"]["granted"] == 1
+    assert [i["direction"] for i in run["incidents"]] == \
+        ["pool_borrow", "pool_reclaim"]
+
+
+def test_pool_block_absent_without_serve_events():
+    # The don't-perturb contract: a single-tenant run's record (and so
+    # its canonical render) carries no pool key at all.
+    sc = _scenario([ScenarioEvent(t=100.0, kind="fail", host=1,
+                                  incident_id=0, cause="test",
+                                  repair_delay_s=1000.0)])
+    run = SimCluster(SimConfig(hosts=4), sc).run()
+    assert "pool" not in run
+
+
 def test_hermetic_registry_no_global_leak():
     sc = _scenario([ScenarioEvent(t=100.0, kind="fail", host=1,
                                   incident_id=0, cause="test",

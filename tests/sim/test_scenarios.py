@@ -94,6 +94,28 @@ def test_capacity_arrival_structure():
         assert len(batch) <= 2
 
 
+def test_shared_pool_wave_structure():
+    sc = make_scenario("shared_pool", seed=13, hosts=16, duration_s=1200.0)
+    serves = [e for e in sc.events if e.kind == "serve"]
+    assert serves
+    # Serve-pressure steps live in their own incident-id band, so they
+    # can never alias churn (0), joins (1M), outages (2M) or stragglers
+    # (3M); each step gets a fresh id.
+    assert all(e.incident_id >= 4_000_000 for e in serves)
+    assert len({e.incident_id for e in serves}) == len(serves)
+    assert all(e.cause == "serve_wave" for e in serves)
+    # The wave steps a piecewise triangle: trough half at ZERO (off-peak
+    # IS the reclaim signal), shoulders at half, crest at the full debt.
+    demands = {e.demand for e in serves}
+    assert demands == {0.0, 45.0, 90.0}
+    # 8 steps per 600 s period.
+    ts = sorted(e.t for e in serves)
+    assert ts[1] - ts[0] == pytest.approx(75.0)
+    # ...over a normal churn background, or there is nothing to borrow
+    # from and nothing to collide with.
+    assert any(e.kind == "fail" for e in sc.events)
+
+
 def test_unknown_scenario_raises():
     with pytest.raises(ValueError, match="unknown scenario"):
         make_scenario("no_such", seed=0, hosts=8, duration_s=10.0)

@@ -74,6 +74,17 @@ def test_oracle_window_prices_degraded_throughput():
     assert report["regret"]["oracle_agreement"] == pytest.approx(0.5)
 
 
+def test_pool_block_passes_through_only_when_present():
+    run = _run([])
+    assert "pool" not in slo.slo_report(run)  # single-tenant: unchanged
+    run["pool"] = {"granted": 2, "denied": 1, "held": 0,
+                   "ended": {"expired": 2}, "still_active": 0,
+                   "chip_seconds_lent": 360.0, "train_charged_s": 4.2}
+    report = slo.slo_report(run)
+    assert report["pool"] == run["pool"]
+    assert '"pool"' in slo.render(report)
+
+
 def test_render_is_canonical():
     report = slo.slo_report(_run([]))
     s = slo.render(report)
