@@ -2,7 +2,7 @@
 
 PY ?= python
 
-.PHONY: lint analyze gen-registry test test-slow tier1 bench bench-diff trace-report ckpt-bench serve-bench pipeline-bench degrade-bench policy-bench sim-bench grow-bench overlap-bench master-bench goodput-bench pool-bench
+.PHONY: lint analyze gen-registry test test-slow tier1 bench bench-diff trace-report ckpt-bench serve-bench pipeline-bench degrade-bench policy-bench sim-bench grow-bench overlap-bench master-bench goodput-bench pool-bench router-bench
 
 # Lint = the project-native analyzer (always available, stdlib-only)
 # plus ruff (config in pyproject.toml). Ruff degrades to a skip when not
@@ -148,3 +148,13 @@ master-bench:
 pool-bench:
 	JAX_PLATFORMS=cpu OOBLECK_METRICS_DIR= \
 		$(PY) -m oobleck_tpu.pool.bench
+
+# Multi-replica serving router: 1-vs-3 replica scaling through one
+# router address, prefix-affine vs random routing hit rates, a chaos
+# kill_replica absorbed mid-traffic with zero failed idempotent
+# requests, and a pool borrow -> replica scale-out -> reclaim -> drain
+# cycle against a scripted-agent training master. Real sockets + a
+# tiny model (also under bench.py's "router" key, diffed by --diff).
+router-bench:
+	JAX_PLATFORMS=cpu OOBLECK_METRICS_DIR= \
+		$(PY) -m oobleck_tpu.serve.router.bench

@@ -821,6 +821,49 @@ def _pool_summary() -> dict:
         return {"error": f"unparseable pool bench output: {exc}"}
 
 
+ROUTER_BENCH_TIMEOUT_S = 300
+
+
+def _router_summary() -> dict:
+    """Multi-replica serving router (oobleck_tpu/serve/router/bench.py)
+    in a throwaway CPU subprocess: 1-vs-3 replica sustained rps and TTFT
+    through one router address, prefix-affine vs random hit rates, a
+    chaos kill_replica absorbed with zero failed idempotent requests,
+    and a full pool borrow -> scale-out -> reclaim -> drain cycle."""
+    env = dict(os.environ)
+    env.update({"JAX_PLATFORMS": "cpu", "PALLAS_AXON_POOL_IPS": "",
+                "OOBLECK_METRICS_DIR": ""})
+    env.pop(_INNER_ENV, None)
+    env.pop(_PIPELINE_ENV, None)
+    # The bench owns its router knobs, chaos directives, pool config,
+    # and journal dir; ambient operator config must not leak in.
+    for knob in ("OOBLECK_MASTER_STATE_DIR", "OOBLECK_CHAOS",
+                 "OOBLECK_POOL", "OOBLECK_POOL_POLICY",
+                 "OOBLECK_POOL_LEASE_TTL_S", "OOBLECK_POOL_MIN_TRAIN_HOSTS",
+                 "OOBLECK_POOL_SWEEP_S", "OOBLECK_POOL_QUEUE_HIGH",
+                 "OOBLECK_POOL_TTFT_SLO_S", "OOBLECK_POOL_HYST",
+                 "OOBLECK_ROUTER_PORT", "OOBLECK_ROUTER_PROBE_S",
+                 "OOBLECK_ROUTER_SKEW_MAX", "OOBLECK_ROUTER_RETRY",
+                 "OOBLECK_ROUTER_URL"):
+        env.pop(knob, None)
+    proc = subprocess.Popen(
+        [sys.executable, "-m", "oobleck_tpu.serve.router.bench"],
+        stdout=subprocess.PIPE, stderr=subprocess.PIPE, text=True, env=env)
+    try:
+        out, err = proc.communicate(timeout=ROUTER_BENCH_TIMEOUT_S)
+    except subprocess.TimeoutExpired:
+        proc.kill()
+        return {"error": f"router bench hung >{ROUTER_BENCH_TIMEOUT_S}s"}
+    if proc.returncode != 0:
+        tail = (err or "").strip().splitlines()[-1:] or ["no stderr"]
+        return {"error":
+                f"router bench exit {proc.returncode}: {tail[0][:160]}"}
+    try:
+        return json.loads(out.strip().splitlines()[-1])
+    except Exception as exc:  # noqa: BLE001
+        return {"error": f"unparseable router bench output: {exc}"}
+
+
 def _analysis_summary() -> dict:
     """One oobleck-lint run over the tree: rule inventory plus finding
     counts, so the bench line records the static-analysis posture the
@@ -922,6 +965,13 @@ def _emit(result: dict) -> None:
         result["pool"] = _pool_summary()
     except Exception as exc:  # noqa: BLE001 — emit must never fail
         result["pool"] = {"error": f"{type(exc).__name__}: {exc}"}
+    # Multi-replica serving router (scaling, prefix affinity, chaos
+    # failover, pool-driven replica elasticity): CPU subprocess, real
+    # sockets, bounded, best-effort — see _router_summary.
+    try:
+        result["router"] = _router_summary()
+    except Exception as exc:  # noqa: BLE001 — emit must never fail
+        result["router"] = {"error": f"{type(exc).__name__}: {exc}"}
     # Static-analysis posture (oobleck_tpu/analysis): in-process, cheap.
     # `findings` counts NEW findings — anything nonzero means the tree
     # regressed against the lint gate, so the diff treats it lower-is-
@@ -972,7 +1022,8 @@ _HIGHER_BETTER = ("per_sec", "per_second", "speedup", "retention",
                   "hit_rate", "hidden_fraction", "attainment")
 _LOWER_BETTER = ("latency", "seconds", "ttft", "pause", "bubble", "stall",
                  "p50", "p90", "p99", "findings", "parse_errors", "regret",
-                 "bytes_per_token", "abs_diff", "overhead")
+                 "bytes_per_token", "abs_diff", "overhead", "failed",
+                 "dropped")
 _LOWER_BETTER_SUFFIXES = ("_s", "_ms", "_us")
 
 

@@ -15,13 +15,19 @@ serving, without dropping in-flight requests.
                  decode path, swap at a decode-step barrier
     server.py    stdlib HTTP: POST /v1/generate, GET /healthz, /metrics
     bench.py     tokens/sec, TTFT and reload-pause percentiles
+    router/      multi-replica front door: prefix-affine routing,
+                 failover, pool-driven scale-out (own package docstring)
 
-`ServingPlane` wires the four together over one checkpoint root.
+`ServingPlane` wires the four together over one checkpoint root; pass
+`router_url=` (or set `OOBLECK_ROUTER_URL`) and the replica
+self-registers with a router on start and deregisters on stop.
 """
 
 from __future__ import annotations
 
 import logging
+import os
+import threading
 import time
 
 from oobleck_tpu.config import ServeArguments
@@ -56,7 +62,8 @@ class ServingPlane:
     def __init__(self, root, *, model=None, model_name: str | None = None,
                  model_args: dict | None = None,
                  args: ServeArguments | None = None,
-                 wait_secs: float = 60.0, ip: str | None = None):
+                 wait_secs: float = 60.0, ip: str | None = None,
+                 router_url: str | None = None):
         self.root = root
         self.model = model
         self.model_name = model_name
@@ -65,6 +72,12 @@ class ServingPlane:
         self.args.apply_serve_env_overrides()
         self.wait_secs = wait_secs
         self.ip = ip
+        # Multi-replica mode: a router front door to self-register with
+        # (serve/router/). Explicit arg wins; env covers deployments that
+        # launch replicas as plain `python -m oobleck_tpu.serve.server`.
+        self.router_url = router_url \
+            if router_url is not None \
+            else (os.environ.get("OOBLECK_ROUTER_URL") or None)
         self.engine: DecodeEngine | None = None
         self.batcher: ContinuousBatcher | None = None
         self.watcher: CheckpointWatcher | None = None
@@ -141,9 +154,47 @@ class ServingPlane:
         logger.info("serving plane up: step %d, %d slots, max_seq %d, "
                     "port %d", step, self.args.slots, max_seq,
                     self.server.port)
+        if self.router_url:
+            # Register off-thread: a replica may come up before its
+            # router, and serving must not block on the handshake.
+            threading.Thread(target=self._register_with_router,
+                             name="oobleck-serve-register",
+                             daemon=True).start()
         return self
 
+    def _register_with_router(self, attempts: int = 30,
+                              backoff_s: float = 1.0) -> None:
+        from oobleck_tpu.serve.router import register_with_router
+        from oobleck_tpu.serve.server import REPLICA_WIRE_V
+
+        payload = {
+            "v": REPLICA_WIRE_V,
+            "host": self.ip or "127.0.0.1",
+            "port": self.server.port,
+            "lanes": int(getattr(self.engine, "slots", 0) or 1),
+            "weights_step": self.engine.params_step,
+            "page_size": int(getattr(self.engine, "page_size", 0) or 0),
+        }
+        for _ in range(attempts):
+            ack = register_with_router(self.router_url, payload)
+            if ack is not None:
+                logger.info("registered with router %s as %s:%d",
+                            self.router_url, payload["host"],
+                            payload["port"])
+                return
+            time.sleep(backoff_s)
+        logger.warning("could not register with router %s after %d "
+                       "attempts", self.router_url, attempts)
+
     def stop(self) -> None:
+        if self.router_url and self.server is not None:
+            from oobleck_tpu.serve.router import deregister_from_router
+
+            # Best-effort clean exit; a missed deregister just means the
+            # router's prober declares us down in a couple of sweeps.
+            deregister_from_router(self.router_url,
+                                   self.ip or "127.0.0.1",
+                                   self.server.port, timeout_s=2.0)
         if self.server is not None:
             self.server.close()
         if self.watcher is not None:

@@ -113,6 +113,12 @@ class ContinuousBatcher:
         self._thread = threading.Thread(
             target=self._run, name="oobleck-serve-batcher", daemon=True)
         self._tok_window = (time.monotonic(), 0)
+        # Queue drain rate (completed requests/sec, EWMA over ~1 s
+        # windows): what an honest Retry-After is derived from — how fast
+        # this replica actually works its queue off, not a guess.
+        self._completions = 0
+        self._drain_window = (time.monotonic(), 0)
+        self._drain_rate = 0.0
 
         reg = metrics.registry()
         self.m_ttft = reg.histogram(
@@ -195,12 +201,28 @@ class ContinuousBatcher:
     def queue_depth(self) -> int:
         return self._queue.qsize() + len(self._waiting)
 
+    @property
+    def drain_rate(self) -> float:
+        """Completed requests/sec (EWMA). 0.0 until the first window."""
+        return self._drain_rate
+
+    def retry_after_s(self, default: float = 5.0,
+                      cap: float = 60.0) -> int:
+        """Honest Retry-After for a 429: the whole-second wait the current
+        queue takes to drain at the measured completion rate, clamped to
+        [1, cap]. Before any completion window lands, `default` — a flat
+        guess beats advertising an infinite wait."""
+        rate = self._drain_rate
+        wait = default if rate <= 0.0 else self.queue_depth / rate
+        return int(max(1.0, min(wait, cap)))
+
     # -- scheduler ------------------------------------------------------- #
 
     def _finish(self, req: GenRequest, reason: str) -> None:
         req.finish_reason = reason
         req.step = self.engine.params_step
         req.total_s = time.monotonic() - req.submitted
+        self._completions += 1
         self.m_requests.inc(outcome=reason)
         self._record_spans(req, reason)
         req.done.set()
@@ -377,6 +399,13 @@ class ContinuousBatcher:
             n = self.m_tokens.value()
             self.m_tps.set((n - n_last) / (now - t_last))
             self._tok_window = (now, n)
+        t_last, c_last = self._drain_window
+        if now - t_last >= 1.0:
+            rate = (self._completions - c_last) / (now - t_last)
+            # EWMA so one quiet second doesn't zero the advertised drain.
+            self._drain_rate = rate if self._drain_rate == 0.0 \
+                else 0.5 * self._drain_rate + 0.5 * rate
+            self._drain_window = (now, self._completions)
 
     def _run(self) -> None:
         while not self._stop.is_set():

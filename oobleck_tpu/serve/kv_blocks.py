@@ -42,6 +42,21 @@ def pages_for(tokens: int, page_size: int) -> int:
     return -(-tokens // page_size)
 
 
+def chain_hashes(tokens: Sequence[int], page_size: int) -> list[int]:
+    """Rolling hash per FULL page boundary of `tokens` (h_i = hash(h_{i-1},
+    page_i's tokens) — position-dependent by construction). The prefix
+    cache keys pages with it; the serving router reuses the SAME chain to
+    map a request's prompt head to the replica most likely to hold its
+    prefix pages. Deterministic within a process for integer tokens
+    (PYTHONHASHSEED only salts str/bytes)."""
+    out = []
+    h = 0
+    for i in range(len(tokens) // page_size):
+        h = hash((h, tuple(tokens[i * page_size:(i + 1) * page_size])))
+        out.append(h)
+    return out
+
+
 class BlockAllocator:
     """Free-list page allocator with refcounts and prefix-chain cache."""
 
@@ -138,13 +153,7 @@ class BlockAllocator:
 
     def _chain_hashes(self, tokens: Sequence[int]) -> list[int]:
         """Rolling hash per FULL page boundary of `tokens`."""
-        out = []
-        h = 0
-        ps = self.page_size
-        for i in range(len(tokens) // ps):
-            h = hash((h, tuple(tokens[i * ps:(i + 1) * ps])))
-            out.append(h)
-        return out
+        return chain_hashes(tokens, self.page_size)
 
     def _evict_registration(self, page: int) -> None:
         h = self._page_to_chain.pop(page, None)

@@ -1,0 +1,454 @@
+"""Router microbenchmark: scale-out, affinity, failover, pool cycle.
+
+Four measurements, all CPU-friendly on a tiny model, one JSON line out
+(consumed by bench.py's "router" key and `make router-bench`):
+
+  1. 1 -> 3 replica scaling: the same open-loop request mix against a
+     single replica and against three behind the router — sustained
+     rps and replica-measured TTFT p50/p99 for both (the router's win
+     is the p99 under load, where the single replica queues).
+  2. Prefix affinity vs random: repeated shared-prefix prompts routed
+     affine (rendezvous on the page-chain hash) vs balanced-random;
+     the fleet-wide prefix-cache hit rate each routing mode earns is
+     the direct measure of why affinity exists.
+  3. Failover: a `kill_replica` chaos directive murders the affine
+     replica mid-request; idempotent traffic continues; reported are
+     failed idempotent requests (bar: ZERO) and recovery seconds
+     (kill -> first post-failover request routed cleanly).
+  4. Pool elasticity: a burst overloads the fleet, the router's
+     FleetPressureMonitor prices it onto a POOL_BORROW against a real
+     (scripted-agent) training master, the granted lease becomes a 4th
+     replica via ReplicaScaler, absorbs live traffic, and the release
+     rides LEASE_RECLAIM into a router drain — dropped bar: ZERO.
+
+Standalone:  python -m oobleck_tpu.serve.router.bench
+"""
+
+from __future__ import annotations
+
+import asyncio
+import http.client
+import json
+import os
+import shutil
+import tempfile
+import threading
+import time
+
+import numpy as np
+
+MODEL = "gpt2-tiny"
+MODEL_ARGS = {"num_layers": 2}
+PAGE = 16
+GEN_TOKENS = 4
+SCALE_REQUESTS = 30      # per scaling phase
+# Bursty arrivals of tiny generations: TTFT is queue wait for a decode
+# lane (2 per replica, 6 behind the router), not raw FLOPs — the regime
+# where replica count matters even on a shared-CPU bench host.
+SCALE_RATE_HZ = 150.0
+AFFINITY_HEADS = 8
+AFFINITY_ROUNDS = 3
+POOL_AGENTS = ("10.9.0.1", "10.9.0.2", "10.9.0.3")
+LEASE_TTL_S = 60.0
+PHASE_TIMEOUT_S = 30.0
+
+
+def _post(port: int, body: dict, timeout: float = 120.0):
+    conn = http.client.HTTPConnection("127.0.0.1", port, timeout=timeout)
+    conn.request("POST", "/v1/generate", json.dumps(body),
+                 {"Content-Type": "application/json"})
+    resp = conn.getresponse()
+    out = json.loads(resp.read())
+    conn.close()
+    return resp.status, out
+
+
+def _pcts(values: list[float]) -> dict:
+    if not values:
+        return {"p50": None, "p99": None}
+    return {"p50": round(float(np.percentile(values, 50)), 3),
+            "p99": round(float(np.percentile(values, 99)), 3)}
+
+
+def _heads(rng, n: int) -> list[list[int]]:
+    """Distinct 2-page prompt heads (the affinity fingerprint unit)."""
+    return [[int(t) for t in rng.integers(1, 200, 2 * PAGE)]
+            for _ in range(n)]
+
+
+def _open_loop(port: int, prompts: list[list[int]], *, rate_hz: float,
+               gen_tokens: int = GEN_TOKENS, seed: int = 0) -> dict:
+    """Open-loop Poisson arrivals through the router; returns sustained
+    rps, replica-reported TTFT values, and the failure count."""
+    rng = np.random.default_rng(seed)
+    ttfts, failed = [], []
+    lock = threading.Lock()
+
+    def one(tokens):
+        try:
+            status, out = _post(port, {"tokens": tokens,
+                                       "max_tokens": gen_tokens,
+                                       "temperature": 0.0})
+            if status != 200:
+                raise RuntimeError(f"status {status}: {out}")
+            with lock:
+                ttfts.append(float(out["ttft_ms"]))
+        except Exception as exc:  # noqa: BLE001 — failure IS the measurement
+            with lock:
+                failed.append(f"{type(exc).__name__}: {exc}")
+
+    t0 = time.perf_counter()
+    threads = []
+    for tokens in prompts:
+        t = threading.Thread(target=one, args=(tokens,))
+        t.start()
+        threads.append(t)
+        time.sleep(float(rng.exponential(1.0 / rate_hz)))
+    for t in threads:
+        t.join()
+    wall = time.perf_counter() - t0
+    return {"rps": round(len(ttfts) / max(wall, 1e-9), 2),
+            "ttft_ms": _pcts(ttfts), "completed": len(ttfts),
+            "failed": len(failed), "errors": failed[:3]}
+
+
+def _mk_plane(root, model, *, router_url=None):
+    from oobleck_tpu.config import ServeArguments
+    from oobleck_tpu.serve import ServingPlane
+
+    return ServingPlane(
+        root, model=model,
+        args=ServeArguments(port=0, slots=2, max_seq=64, reload_secs=5.0,
+                            page_size=PAGE, kv_pages=64, lanes=2),
+        router_url=router_url).start()
+
+
+def _wait_routable(router, n: int, timeout_s: float = 60.0) -> None:
+    deadline = time.monotonic() + timeout_s
+    while time.monotonic() < deadline:
+        fresh, _ = router.registry.routable()
+        if len(fresh) >= n:
+            return
+        time.sleep(0.05)
+    raise TimeoutError(f"fleet never reached {n} routable replicas")
+
+
+def _warm(planes) -> None:
+    """One direct request per replica so JIT compilation happens outside
+    the measurement window (fresh engines otherwise pay it on their
+    first routed request)."""
+    for p in planes:
+        _post(p.server.port, {"tokens": [1, 2, 3], "max_tokens": 2})
+
+
+def _prefix_hit_rate(router, prompts: list[list[int]]) -> float:
+    """Fleet-wide prefix-cache hit rate for a closed-loop pass (the
+    engines share the process-global hit counter, so the delta IS the
+    fleet total)."""
+    from oobleck_tpu.utils import metrics
+
+    hits0 = metrics.registry().counter(
+        "oobleck_serve_prefix_hits_total", "").value()
+    n = 0
+    for tokens in prompts:
+        status, out = _post(router.port, {"tokens": tokens,
+                                          "max_tokens": 4})
+        if status == 200:
+            n += 1
+    hits = metrics.registry().counter(
+        "oobleck_serve_prefix_hits_total", "").value() - hits0
+    return round(hits / max(n, 1), 4)
+
+
+def _measure_failover(router) -> dict:
+    from oobleck_tpu.utils import chaos as chaos_mod
+
+    rng = np.random.default_rng(7)
+    head = _heads(rng, 1)[0]
+    # Warm the head so it has an affine owner, then murder that owner
+    # on its next generate request.
+    status, out = _post(router.port, {"tokens": head, "max_tokens": 4})
+    assert status == 200, out
+    victim = out["routed_to"]
+    chaos_mod.reset(f"kill_replica={int(victim.split(':')[1])}@1")
+    t0 = time.perf_counter()
+    failed = 0
+    failover_seen = False
+    recovery_s = None
+    for i in range(8):
+        status, out = _post(router.port, {
+            "tokens": head + [i + 1], "max_tokens": 4,
+            "temperature": 0.0})
+        if status != 200:
+            failed += 1
+            continue
+        if out["route_reason"] == "failover":
+            failover_seen = True
+        elif failover_seen and recovery_s is None:
+            recovery_s = round(time.perf_counter() - t0, 4)
+    chaos_mod.reset("")
+    return {"victim": victim, "failover_absorbed": failover_seen,
+            "failed_idempotent": failed,
+            "recovery_s": recovery_s}
+
+
+async def _wait_verb(agents, verb: str) -> None:
+    for a in agents:
+        deadline = time.monotonic() + PHASE_TIMEOUT_S
+        while time.monotonic() < deadline:
+            if any(m.get("kind") == verb for m in a.inbox):
+                break
+            await asyncio.sleep(0.01)
+        else:
+            raise TimeoutError(f"{a.ip}: no {verb} broadcast")
+
+
+async def _pool_cycle(router, root, model) -> dict:
+    """Borrow -> scale-out -> absorb -> reclaim -> drain, against a real
+    journaling master with scripted training agents (elastic/
+    master_bench harness — real TCP, no workers)."""
+    from oobleck_tpu.config import OobleckArguments
+    from oobleck_tpu.elastic import journal as journal_mod
+    from oobleck_tpu.elastic.master_bench import (
+        ScriptedAgent,
+        _hard_kill,
+        _start_master,
+    )
+    from oobleck_tpu.elastic.message import (
+        LEASE_KEY,
+        TENANT_KEY,
+        RequestType,
+        ResponseType,
+        recv_msg,
+        send_request,
+    )
+    from oobleck_tpu.pool import arbiter as pool_arbiter
+    from oobleck_tpu.serve.router import ReplicaScaler
+
+    tmp = tempfile.mkdtemp(prefix="oobleck-router-bench-journal-")
+    os.environ[journal_mod.ENV_STATE_DIR] = tmp
+    os.environ[pool_arbiter.ENV_POOL] = "1"
+
+    args = OobleckArguments()
+    args.dist.node_ips = list(POOL_AGENTS)
+    m, mtask = await _start_master(0)
+    mport = m.port
+    r, w = await asyncio.open_connection("127.0.0.1", mport)
+    await send_request(w, RequestType.LAUNCH_JOB, {"args": args.to_dict()})
+    assert (await recv_msg(r))["kind"] == ResponseType.SUCCESS.value
+    w.close()
+    fleet = [ScriptedAgent(ip) for ip in POOL_AGENTS]
+    for a in fleet:
+        await a.register(mport)
+
+    monitor = router.pressure
+    monitor.queue_high = 1.0
+    monitor.hysteresis = 1
+
+    planes = []
+
+    def factory(lease):
+        plane = _mk_plane(root, model)
+        planes.append(plane)
+        plane.port = plane.server.port
+        plane.lanes = 2
+        plane.weights_step = plane.engine.params_step
+        plane.page_size = PAGE
+        return plane
+
+    scaler = ReplicaScaler(router.registry, factory, poll_s=0.05)
+    rng = np.random.default_rng(11)
+    try:
+        # Overload the fleet so queues build behind every replica: the
+        # FLEET aggregate, not one replica's, is what must pressure.
+        burst_prompts = [[int(t) for t in rng.integers(1, 90, 8)]
+                         for _ in range(24)]
+        burst = asyncio.create_task(asyncio.to_thread(
+            _open_loop, router.port, burst_prompts, rate_hz=60.0,
+            gen_tokens=48, seed=3))
+        pressure = None
+        deadline = time.monotonic() + PHASE_TIMEOUT_S
+        while time.monotonic() < deadline:
+            monitor.sample()
+            if monitor.pressured \
+                    and monitor.slo_debt_s(LEASE_TTL_S) >= 5.0:
+                pressure = monitor.as_payload(horizon_s=LEASE_TTL_S)
+                break
+            await asyncio.sleep(0.02)
+        assert pressure is not None, "fleet never pressured under burst"
+
+        t0 = time.monotonic()
+        r, w = await asyncio.open_connection("127.0.0.1", mport)
+        await send_request(w, RequestType.POOL_BORROW, {
+            TENANT_KEY: "router-serve", "chips": 1, "pressure": pressure,
+            "slo": {"ttft_p99_s": monitor.ttft_slo_s},
+            "lease_ttl_s": LEASE_TTL_S, "cause": "router_fleet_pressure"})
+        msg = await recv_msg(r)
+        w.close()
+        borrow_latency = time.monotonic() - t0
+        assert msg["kind"] == ResponseType.SUCCESS.value, msg
+        lease = msg[LEASE_KEY]
+        victim_ip = lease["hosts"][0]
+        # Grant broadcast first, THEN the victim drains out of the
+        # training fleet — a lease is a clean exit, not a failure, but
+        # only once the master has marked it leaving.
+        await _wait_verb(fleet, ResponseType.LEASE_GRANT.value)
+        next(a for a in fleet if a.ip == victim_ip).close()
+
+        # Lease -> new replica, registered and probed routable.
+        t0 = time.monotonic()
+        handle = await asyncio.to_thread(
+            scaler.scale_out, dict(lease), timeout_s=60.0)
+        scale_out_s = time.monotonic() - t0
+        new_key = f"127.0.0.1:{handle.port}"
+
+        # The new replica absorbs live traffic (short prompts balance
+        # by load; the fresh empty replica wins the po2 pick).
+        absorbed = 0
+        absorb_failed = 0
+        for i in range(8):
+            status, out = _post(router.port, {
+                "tokens": [int(t) for t in rng.integers(1, 90, 6)],
+                "max_tokens": 4, "temperature": 0.0})
+            if status != 200:
+                absorb_failed += 1
+            elif out["routed_to"] == new_key:
+                absorbed += 1
+        burst_out = await burst
+
+        # Off-peak: release; the reclaim broadcast reaches the training
+        # fleet while the router drains the leased replica to zero.
+        monitor.sample()
+        t0 = time.monotonic()
+        r, w = await asyncio.open_connection("127.0.0.1", mport)
+        await send_request(w, RequestType.POOL_BORROW, {
+            TENANT_KEY: "router-serve", "release": lease["lease_id"],
+            "pressure": monitor.as_payload(horizon_s=LEASE_TTL_S)})
+        msg = await recv_msg(r)
+        w.close()
+        assert msg["kind"] == ResponseType.SUCCESS.value, msg
+        survivors = [a for a in fleet if a.ip != victim_ip]
+        await _wait_verb(survivors, ResponseType.LEASE_RECLAIM.value)
+        drain = await asyncio.to_thread(
+            scaler.drain, lease["lease_id"], timeout_s=30.0)
+        reclaim_s = time.monotonic() - t0
+
+        return {
+            "pressure_at_borrow": {
+                "score": pressure["score"],
+                "queue_depth": pressure["queue_depth"],
+                "slo_debt_s": pressure["slo_debt_s"]},
+            "borrow_latency_s": round(borrow_latency, 6),
+            "victim": victim_ip,
+            "scale_out_s": round(scale_out_s, 6),
+            "new_replica": new_key,
+            "absorbed_requests": absorbed,
+            "burst": {"completed": burst_out["completed"],
+                      "failed": burst_out["failed"]},
+            "dropped": absorb_failed + burst_out["failed"],
+            "drained_clean": drain["drained_clean"],
+            "drain_s": round(drain["drain_s"], 6),
+            "release_to_drained_s": round(reclaim_s, 6),
+        }
+    finally:
+        for p in planes:
+            try:
+                p.stop()
+            except Exception:  # noqa: BLE001 — teardown is best-effort
+                pass
+        _hard_kill(m)
+        mtask.cancel()
+        await m.stop()
+        for a in fleet:
+            a.close()
+        shutil.rmtree(tmp, ignore_errors=True)
+
+
+def measure_router() -> dict:
+    import jax
+
+    from oobleck_tpu.models import build_model
+    from oobleck_tpu.serve.reload import publish_params
+    from oobleck_tpu.serve.router import RouterPlane
+    from oobleck_tpu.utils import chaos as chaos_mod
+
+    chaos_mod.reset("")
+    tmp = tempfile.mkdtemp(prefix="oobleck_router_bench_")
+    router = None
+    planes = []
+    rng = np.random.default_rng(0)
+    try:
+        model = build_model(MODEL, MODEL_ARGS)
+        params = model.init_params(jax.random.PRNGKey(0))
+        publish_params(tmp, model, params, step=1, model_name=MODEL)
+        router = RouterPlane(host="127.0.0.1", probe_s=0.1,
+                             seed=0).start()
+        url = f"127.0.0.1:{router.port}"
+
+        # -- 1 replica vs 3, same workload shape -------------------- #
+        planes.append(_mk_plane(tmp, model, router_url=url))
+        _wait_routable(router, 1)
+        _warm(planes)
+        single_prompts = [h + [i] for i, h in
+                          enumerate(_heads(rng, SCALE_REQUESTS))]
+        single = _open_loop(router.port, single_prompts,
+                            rate_hz=SCALE_RATE_HZ, seed=1)
+        planes.extend(_mk_plane(tmp, model, router_url=url)
+                      for _ in range(2))
+        _wait_routable(router, 3)
+        _warm(planes[1:])
+        multi_prompts = [h + [i] for i, h in
+                         enumerate(_heads(rng, SCALE_REQUESTS))]
+        multi = _open_loop(router.port, multi_prompts,
+                           rate_hz=SCALE_RATE_HZ, seed=2)
+        multi["replicas"] = 3
+        speedup = round(multi["rps"] / max(single["rps"], 1e-9), 3)
+
+        # -- prefix affinity vs random routing ---------------------- #
+        # Fresh head sets per mode so each starts with a cold cache.
+        affine_heads = _heads(rng, AFFINITY_HEADS)
+        affine_prompts = [h + [r] for r in range(AFFINITY_ROUNDS)
+                          for h in affine_heads]
+        affine_rate = _prefix_hit_rate(router, affine_prompts)
+        router.policy.affinity = False
+        random_heads = _heads(rng, AFFINITY_HEADS)
+        random_prompts = [h + [r] for r in range(AFFINITY_ROUNDS)
+                          for h in random_heads]
+        random_rate = _prefix_hit_rate(router, random_prompts)
+        router.policy.affinity = True
+
+        # -- failover under chaos ----------------------------------- #
+        failover = _measure_failover(router)
+
+        # -- pool borrow -> scale-out -> reclaim -> drain ----------- #
+        pool = asyncio.run(_pool_cycle(router, tmp, model))
+
+        return {
+            "model": MODEL,
+            "single_replica": single,
+            "multi_replica": multi,
+            "rps_speedup": speedup,
+            "prefix": {
+                "affine_hit_rate": affine_rate,
+                "random_hit_rate": random_rate,
+                "affinity_gain": round(affine_rate - random_rate, 4)},
+            "failover": failover,
+            "pool": pool,
+            "note": ("tiny model on CPU; 3 in-process replicas behind "
+                     "one router over real sockets; pool cycle against "
+                     "a scripted-agent training master"),
+        }
+    finally:
+        chaos_mod.reset("")
+        if router is not None:
+            router.stop()
+        for p in planes:
+            try:
+                p.stop()
+            except Exception:  # noqa: BLE001 — teardown is best-effort
+                pass
+        shutil.rmtree(tmp, ignore_errors=True)
+
+
+if __name__ == "__main__":
+    print(json.dumps(measure_router()))

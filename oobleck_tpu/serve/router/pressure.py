@@ -1,0 +1,54 @@
+"""Fleet-wide pressure: the router's view feeds the pool arbiter.
+
+The pool arbiter (pool/pressure.py) already knows how to turn serve
+metrics into a borrow verdict — hysteresis, SLO-debt pricing, the
+``as_payload`` dict that rides POOL_BORROW. What changes behind a
+router is WHICH metrics: one replica's queue depth is noise, the
+FLEET's aggregate is signal (one hot replica with two idle siblings is
+a routing problem, not a capacity problem — the fleet queue stays low
+and no borrow fires; every replica deep is a capacity problem and the
+aggregate says so).
+
+So this subclass swaps only the three raw reads for the router-side
+aggregates the registry and proxy path publish, and inherits the entire
+verdict/debt/payload model unchanged:
+
+  * queue depth   <- ``oobleck_router_fleet_queue_depth`` (the probe
+                     loop's sum of replica admission queues)
+  * TTFT p99      <- ``oobleck_router_ttft_seconds`` (replica-reported
+                     TTFT as observed through the proxy path)
+  * deadline debt <- ``oobleck_router_requests_total`` with
+                     outcome=deadline_queued (replicas' own verdicts,
+                     counted where the fleet total lives)
+
+Because ``sample()``/``slo_debt_s()``/``as_payload()`` are inherited,
+the router's pressure rides the existing POOL_BORROW wire format with
+zero master-side changes: sustained fleet-wide peak -> borrow -> the
+ReplicaScaler (scale.py) turns the granted lease into a new replica.
+"""
+
+from __future__ import annotations
+
+from oobleck_tpu.pool.pressure import PressureMonitor
+from oobleck_tpu.utils import metrics
+
+
+class FleetPressureMonitor(PressureMonitor):
+    """PressureMonitor over the router's fleet-wide aggregates."""
+
+    def _queue_depth(self) -> float:
+        series = self._reg().gauge(
+            "oobleck_router_fleet_queue_depth", "").series()
+        return max((s["value"] for s in series), default=0.0)
+
+    def _ttft_p99(self) -> float | None:
+        hist = self._reg().histogram("oobleck_router_ttft_seconds", "")
+        merged = metrics.merge_histogram_series(hist.series())
+        if merged is None:
+            return None
+        return metrics.histogram_percentile(merged, 0.99)
+
+    def _deadline_queued_total(self) -> float:
+        counter = self._reg().counter("oobleck_router_requests_total", "")
+        return sum(s["value"] for s in counter.series()
+                   if s["labels"].get("outcome") == "deadline_queued")

@@ -1,0 +1,397 @@
+"""Replica registry: who is routable, how loaded, and how stale.
+
+Replicas self-register over a versioned JSON handshake (``v`` =
+``ROUTER_WIRE_V``) advertising ``host``/``port``/``lanes``/
+``weights_step``/``page_size``. Legacy replicas — older builds whose
+handshake and ``/healthz`` carry none of the router keys — register and
+route fine on conservative defaults (wire compat is a test, not an
+accident).
+
+Liveness is two signals, because replicas fail two ways:
+
+  * **probes** — a daemon thread GETs every replica's ``/healthz`` each
+    ``OOBLECK_ROUTER_PROBE_S`` seconds, refreshing queue depth, lane
+    occupancy, and ``weights_step``, and folding the round-trip into an
+    RTT EWMA. ``DOWN_AFTER`` consecutive probe failures (refused, reset,
+    or hung past the probe timeout — the alive-but-unresponsive case TCP
+    disconnects never surface) mark the replica DOWN.
+  * **proxy errors** — a connection that dies mid-request marks the
+    replica down immediately (the router was just told, no need to wait
+    for the prober).
+
+Marking a replica down is an INCIDENT, not a log line: the transition is
+flight-recorded and committed through the obs incident machinery under
+the trace id of the request (or probe) that saw it die, so a replica
+death is forensically reconstructible exactly like a training host loss.
+
+Weights-skew gate: a replica lagging more than ``OOBLECK_ROUTER_SKEW_MAX``
+hot-reloads behind the fleet's newest ``weights_step`` is COOLED — kept
+registered and probed, but routed to only when nothing fresher can take
+the request. Serving stale weights silently is how A/B mysteries are
+born; cooling is visible in ``/replicas`` and the state gauge.
+"""
+
+from __future__ import annotations
+
+import http.client
+import json
+import logging
+import threading
+import time
+
+from oobleck_tpu.obs import incident as incident_mod
+from oobleck_tpu.utils import metrics
+
+logger = logging.getLogger("oobleck.router")
+
+# Handshake/wire version the router speaks. Registrations without "v"
+# (legacy replicas) are accepted with conservative defaults.
+ROUTER_WIRE_V = 1
+
+ENV_PROBE_S = "OOBLECK_ROUTER_PROBE_S"
+ENV_SKEW_MAX = "OOBLECK_ROUTER_SKEW_MAX"
+
+DEFAULT_PROBE_S = 1.0
+DEFAULT_SKEW_MAX = 2        # hot-reloads behind fleet max before cooling
+DOWN_AFTER = 2              # consecutive probe failures -> DOWN
+# Service-time floor for load estimates before any TTFT has been
+# measured: an idle fleet must not estimate zero wait for a deep queue.
+DEFAULT_SERVICE_S = 0.05
+
+
+def _env_float(name: str, default: float) -> float:
+    import os
+
+    raw = os.environ.get(name, "")
+    try:
+        return float(raw) if raw else default
+    except ValueError:
+        return default
+
+
+class Replica:
+    """One serving replica's registered identity + probed live state."""
+
+    def __init__(self, host: str, port: int, *, lanes: int = 1,
+                 weights_step: int = -1, page_size: int = 0,
+                 wire_v: int = 0):
+        self.host = host
+        self.port = int(port)
+        self.lanes = max(int(lanes), 1)
+        self.weights_step = int(weights_step)   # -1 = unknown (legacy)
+        self.page_size = int(page_size)
+        self.wire_v = int(wire_v)
+        # Probed state.
+        self.queue_depth = 0.0
+        self.slots_active = 0
+        self.retry_after_s = 1
+        self.rtt_ewma_s: float | None = None
+        self.ttft_ewma_s: float | None = None   # router-measured
+        self.probe_failures = 0
+        self.last_probe_t: float | None = None
+        # Lifecycle.
+        self.down = False
+        self.down_reason: str | None = None
+        self.draining = False
+
+    @property
+    def key(self) -> str:
+        return f"{self.host}:{self.port}"
+
+    def est_wait_s(self) -> float:
+        """Projected time-to-first-token for a NEW request on this
+        replica: queued requests plus fractional lane occupancy, each
+        costed at the router-measured TTFT EWMA (floor: a nominal service
+        time, so a deep queue is never estimated free)."""
+        service = self.ttft_ewma_s if self.ttft_ewma_s else DEFAULT_SERVICE_S
+        occupancy = self.slots_active / self.lanes
+        return (self.queue_depth + occupancy) * service
+
+    def observe_ttft(self, ttft_s: float) -> None:
+        self.ttft_ewma_s = ttft_s if self.ttft_ewma_s is None \
+            else 0.7 * self.ttft_ewma_s + 0.3 * ttft_s
+
+    def as_dict(self, *, cooled: bool = False) -> dict:
+        return {
+            "replica": self.key, "wire_v": self.wire_v,
+            "lanes": self.lanes, "weights_step": self.weights_step,
+            "page_size": self.page_size,
+            "queue_depth": self.queue_depth,
+            "slots_active": self.slots_active,
+            "est_wait_s": round(self.est_wait_s(), 6),
+            "rtt_ewma_s": round(self.rtt_ewma_s, 6)
+            if self.rtt_ewma_s is not None else None,
+            "ttft_ewma_s": round(self.ttft_ewma_s, 6)
+            if self.ttft_ewma_s is not None else None,
+            "state": ("down" if self.down else
+                      "draining" if self.draining else
+                      "cooled" if cooled else "up"),
+            "down_reason": self.down_reason,
+        }
+
+
+class ReplicaRegistry:
+    """Thread-safe replica book + background ``/healthz`` prober."""
+
+    def __init__(self, *, probe_s: float | None = None,
+                 skew_max: int | None = None,
+                 probe_timeout_s: float | None = None):
+        self.probe_s = probe_s if probe_s is not None \
+            else _env_float(ENV_PROBE_S, DEFAULT_PROBE_S)
+        self.skew_max = int(skew_max if skew_max is not None
+                            else _env_float(ENV_SKEW_MAX, DEFAULT_SKEW_MAX))
+        # A hung replica is only as detectable as the probe's patience.
+        self.probe_timeout_s = probe_timeout_s if probe_timeout_s \
+            else max(self.probe_s, 0.25)
+        self._lock = threading.Lock()
+        self._replicas: dict[str, Replica] = {}
+        self._stop = threading.Event()
+        self._thread: threading.Thread | None = None
+        reg = metrics.registry()
+        self.m_replicas = reg.gauge(
+            "oobleck_router_replicas", "Registered replicas by state")
+        self.m_fleet_queue = reg.gauge(
+            "oobleck_router_fleet_queue_depth",
+            "Sum of probed replica admission-queue depths")
+        self.m_probe_failures = reg.counter(
+            "oobleck_router_probe_failures_total",
+            "Replica health probes that failed or timed out")
+
+    # -- handshake -------------------------------------------------------- #
+
+    def register(self, payload: dict, *, default_host: str = "127.0.0.1") \
+            -> dict:
+        """Versioned registration handshake. Required: ``port``. Legacy
+        payloads (no ``v``/``lanes``/``weights_step``/``page_size``)
+        register with conservative defaults. Re-registration supersedes
+        (a restarted replica on the same port is the same replica,
+        fresher)."""
+        if not isinstance(payload, dict) or "port" not in payload:
+            raise ValueError("registration needs a 'port'")
+        port = int(payload["port"])
+        if port <= 0:
+            raise ValueError(f"bad replica port {port}")
+        wire_v = int(payload.get("v") or 0)
+        rep = Replica(
+            str(payload.get("host") or default_host), port,
+            lanes=int(payload.get("lanes") or 1),
+            weights_step=int(payload.get("weights_step", -1)),
+            page_size=int(payload.get("page_size") or 0),
+            wire_v=wire_v)
+        with self._lock:
+            self._replicas[rep.key] = rep
+        metrics.flight_recorder().record(
+            "router_register", replica=rep.key, wire_v=wire_v,
+            lanes=rep.lanes, weights_step=rep.weights_step,
+            legacy=wire_v < ROUTER_WIRE_V)
+        logger.info("router: replica %s registered (v%d, %d lanes, "
+                    "step %d)", rep.key, wire_v, rep.lanes,
+                    rep.weights_step)
+        self._set_state_gauges()
+        return {"ok": True, "v": ROUTER_WIRE_V, "replica": rep.key,
+                "probe_s": self.probe_s}
+
+    def deregister(self, host: str, port: int) -> bool:
+        with self._lock:
+            rep = self._replicas.pop(f"{host}:{int(port)}", None)
+        if rep is not None:
+            logger.info("router: replica %s deregistered", rep.key)
+        self._set_state_gauges()
+        return rep is not None
+
+    # -- lookups ---------------------------------------------------------- #
+
+    def get(self, key: str) -> Replica | None:
+        with self._lock:
+            return self._replicas.get(key)
+
+    def replicas(self) -> list[Replica]:
+        with self._lock:
+            return list(self._replicas.values())
+
+    def fleet_weights_step(self) -> int:
+        """Newest weights_step any live replica serves (-1: unknown)."""
+        return max((r.weights_step for r in self.replicas()
+                    if not r.down), default=-1)
+
+    def is_cooled(self, rep: Replica) -> bool:
+        """Weights-skew gate: lagging more than skew_max hot-reloads
+        behind the fleet's newest step. Unknown steps (legacy replicas)
+        are never cooled — the gate needs evidence, not absence."""
+        if rep.weights_step < 0:
+            return False
+        fleet = self.fleet_weights_step()
+        return fleet >= 0 and fleet - rep.weights_step > self.skew_max
+
+    def routable(self) -> tuple[list[Replica], list[Replica]]:
+        """(fresh, cooled): fresh replicas are up, not draining, within
+        the skew gate; cooled ones are routable only as a last resort."""
+        fresh, cooled = [], []
+        for r in self.replicas():
+            if r.down or r.draining:
+                continue
+            (cooled if self.is_cooled(r) else fresh).append(r)
+        return fresh, cooled
+
+    # -- state transitions ------------------------------------------------- #
+
+    def mark_down(self, key: str, *, reason: str,
+                  trace_id: str | None = None) -> Replica | None:
+        """Mark a replica down (idempotent). The DOWN transition is a
+        first-class incident: flight-recorded and committed through the
+        obs incident machinery under the observing request's trace id.
+        Returns the replica iff this call performed the transition."""
+        with self._lock:
+            rep = self._replicas.get(key)
+            if rep is None or rep.down:
+                return None
+            rep.down = True
+            rep.down_reason = reason
+        logger.warning("router: replica %s marked down (%s)", key, reason)
+        metrics.flight_recorder().record(
+            "router_replica_down", replica=key, reason=reason,
+            trace_id=trace_id)
+        builder = incident_mod.IncidentBuilder(
+            key, trace_id=trace_id, cause="serve_replica_down",
+            reason=reason)
+        builder.mark("detect")
+        builder.commit()
+        self._set_state_gauges()
+        return rep
+
+    def mark_draining(self, key: str) -> Replica | None:
+        with self._lock:
+            rep = self._replicas.get(key)
+            if rep is not None:
+                rep.draining = True
+        self._set_state_gauges()
+        return rep
+
+    def _set_state_gauges(self) -> None:
+        counts = {"up": 0, "cooled": 0, "down": 0, "draining": 0}
+        for r in self.replicas():
+            if r.down:
+                counts["down"] += 1
+            elif r.draining:
+                counts["draining"] += 1
+            elif self.is_cooled(r):
+                counts["cooled"] += 1
+            else:
+                counts["up"] += 1
+        for state, n in counts.items():
+            self.m_replicas.set(n, state=state)
+
+    # -- probing ----------------------------------------------------------- #
+
+    def probe_once(self) -> None:
+        """One sweep over every replica's /healthz. Down replicas stay
+        probed: one that answers again self-heals (DOWN is a judgment,
+        not a tombstone — a deregister is the tombstone)."""
+        fleet_queue = 0.0
+        for rep in self.replicas():
+            t0 = time.monotonic()
+            try:
+                conn = http.client.HTTPConnection(
+                    rep.host, rep.port, timeout=self.probe_timeout_s)
+                conn.request("GET", "/healthz")
+                resp = conn.getresponse()
+                health = json.loads(resp.read())
+                conn.close()
+                if resp.status != 200 or not health.get("ok"):
+                    raise OSError(f"healthz status {resp.status}")
+            except (OSError, ValueError) as e:
+                rep.probe_failures += 1
+                self.m_probe_failures.inc()
+                if rep.probe_failures >= DOWN_AFTER and not rep.down:
+                    self.mark_down(
+                        rep.key,
+                        reason=f"probe: {type(e).__name__}: {e}")
+                continue
+            rtt = time.monotonic() - t0
+            rep.rtt_ewma_s = rtt if rep.rtt_ewma_s is None \
+                else 0.8 * rep.rtt_ewma_s + 0.2 * rtt
+            rep.probe_failures = 0
+            rep.last_probe_t = time.monotonic()
+            if rep.down:
+                logger.info("router: replica %s back up", rep.key)
+                rep.down = False
+                rep.down_reason = None
+            # Versioned healthz: fall back to the legacy keys when the
+            # richer ones are absent (wire compat both directions).
+            rep.queue_depth = float(health.get("queue_depth") or 0.0)
+            rep.slots_active = int(health.get("slots_active") or 0)
+            step = health.get("weights_step", health.get("step", -1))
+            rep.weights_step = int(step if step is not None else -1)
+            if health.get("lanes"):
+                rep.lanes = max(int(health["lanes"]), 1)
+            if health.get("page_size"):
+                rep.page_size = int(health["page_size"])
+            if health.get("retry_after_s"):
+                rep.retry_after_s = int(health["retry_after_s"])
+            if not rep.draining:
+                fleet_queue += rep.queue_depth
+        self.m_fleet_queue.set(fleet_queue)
+        self._set_state_gauges()
+
+    def start(self) -> "ReplicaRegistry":
+        self._thread = threading.Thread(
+            target=self._probe_loop, name="oobleck-router-probe",
+            daemon=True)
+        self._thread.start()
+        return self
+
+    def stop(self) -> None:
+        self._stop.set()
+        if self._thread is not None:
+            self._thread.join(self.probe_timeout_s + self.probe_s + 5.0)
+
+    def _probe_loop(self) -> None:
+        while not self._stop.wait(self.probe_s):
+            try:
+                self.probe_once()
+            except Exception:  # noqa: BLE001 — the prober must outlive any bad sweep
+                logger.exception("router probe sweep failed")
+
+
+def register_with_router(router_url: str, payload: dict,
+                         *, timeout_s: float = 5.0) -> dict | None:
+    """POST a registration handshake to ``router_url`` (``host:port`` or
+    ``http://host:port``); the ack dict, or None on failure (callers
+    retry — a replica may come up before its router)."""
+    host, port = _parse_url(router_url)
+    try:
+        conn = http.client.HTTPConnection(host, port, timeout=timeout_s)
+        conn.request("POST", "/v1/register", json.dumps(payload),
+                     {"Content-Type": "application/json"})
+        resp = conn.getresponse()
+        ack = json.loads(resp.read())
+        conn.close()
+        return ack if resp.status == 200 else None
+    except (OSError, ValueError):
+        return None
+
+
+def deregister_from_router(router_url: str, host: str, port: int,
+                           *, timeout_s: float = 5.0) -> bool:
+    host_r, port_r = _parse_url(router_url)
+    try:
+        conn = http.client.HTTPConnection(host_r, port_r,
+                                          timeout=timeout_s)
+        conn.request("POST", "/v1/deregister",
+                     json.dumps({"host": host, "port": int(port)}),
+                     {"Content-Type": "application/json"})
+        resp = conn.getresponse()
+        resp.read()
+        conn.close()
+        return resp.status == 200
+    except OSError:
+        return False
+
+
+def _parse_url(url: str) -> tuple[str, int]:
+    u = url.strip()
+    if u.startswith("http://"):
+        u = u[len("http://"):]
+    u = u.rstrip("/")
+    host, _, port = u.partition(":")
+    return host or "127.0.0.1", int(port or 80)

@@ -1,0 +1,121 @@
+"""Routing policy: prefix affinity first, deadlines override, load decides.
+
+A request's prompt head is mapped to a replica with the SAME rolling
+page-chain hash the paged KV allocator keys its prefix cache with
+(serve/kv_blocks.py `chain_hashes`): the hash of the first
+``affinity_pages`` full pages is a stable fingerprint of the prompt
+head, and rendezvous hashing (highest-random-weight) over it picks the
+replica most likely to already hold those prefix pages. Rendezvous —
+not modulo — so replica churn remaps only the keys that MUST move:
+when a replica joins or dies, every other key keeps its owner, which is
+exactly the property a prefix cache wants.
+
+Affinity is a preference, not a promise. When the affine replica's
+projected wait (queue depth x TTFT EWMA) would blow the request's
+deadline while a less-loaded replica would not, the policy spills to
+power-of-two-choices over load — a cold prefill beats a missed
+deadline. Requests without a usable head (shorter than one page, or no
+paged replicas) go straight to po2.
+
+The policy returns an ORDERED candidate list, not a single pick: the
+proxy layer walks it on 429 spill and on failover, so "where next?" is
+decided once, here, and every hop downstream is mechanical.
+Weights-cooled replicas (registry skew gate) always sort last — stale
+weights serve only when nothing fresh can.
+"""
+
+from __future__ import annotations
+
+import random
+
+from oobleck_tpu.serve.kv_blocks import chain_hashes
+from oobleck_tpu.utils import metrics
+
+# Fallback page granularity for the affinity fingerprint when no replica
+# advertises one (dense-engine fleets still get stable prompt-head
+# affinity; they just don't get prefix-cache hits out of it).
+DEFAULT_AFFINITY_PAGE = 16
+# Affine replica must project under deadline * margin to keep the
+# request; the slack absorbs estimate noise before spilling.
+DEADLINE_MARGIN = 0.8
+
+
+class RoutingPolicy:
+    """Orders routable replicas for one request."""
+
+    def __init__(self, registry, *, affinity: bool = True,
+                 affinity_pages: int = 2, seed: int | None = None):
+        self.registry = registry
+        self.affinity = affinity
+        self.affinity_pages = max(int(affinity_pages), 1)
+        self._rng = random.Random(seed)
+        self.m_decisions = metrics.registry().counter(
+            "oobleck_router_decisions_total",
+            "Routing decisions by reason (affine/balanced/deadline_spill/"
+            "cooled_only)")
+
+    # -- affinity fingerprint --------------------------------------------- #
+
+    def head_key(self, tokens: list[int]) -> int | None:
+        """Prompt-head fingerprint: the rolling chain hash of the first
+        `affinity_pages` FULL pages — the same chain the replicas' prefix
+        caches are keyed with, so affinity lands requests where their
+        pages already are. None when the prompt is shorter than one page
+        (nothing cacheable to be affine to)."""
+        page = max((r.page_size for r in self.registry.replicas()
+                    if r.page_size > 0), default=DEFAULT_AFFINITY_PAGE)
+        chain = chain_hashes(tokens, page)[:self.affinity_pages]
+        return chain[-1] if chain else None
+
+    @staticmethod
+    def rendezvous_score(key: int, replica_key: str) -> int:
+        """Highest-random-weight score of (prompt head, replica)."""
+        return hash((key, replica_key))
+
+    # -- candidate ordering ------------------------------------------------ #
+
+    def plan(self, tokens: list[int],
+             deadline_s: float | None = None) -> tuple[list, str]:
+        """(ordered candidate replicas, decision reason).
+
+        First element is the primary pick; the rest are fallbacks in
+        preference order (load-ascending, cooled replicas last). Empty
+        list: nothing registered and alive.
+        """
+        fresh, cooled = self.registry.routable()
+        cooled_tail = sorted(cooled, key=lambda r: (r.est_wait_s(), r.key))
+        if not fresh:
+            reason = "cooled_only" if cooled_tail else "no_replicas"
+            if cooled_tail:
+                self.m_decisions.inc(reason=reason)
+            return cooled_tail, reason
+        by_load = sorted(fresh, key=lambda r: (r.est_wait_s(), r.key))
+        key = self.head_key(tokens) if self.affinity else None
+        if key is None:
+            order, reason = self._po2_order(by_load), "balanced"
+        else:
+            affine = max(fresh, key=lambda r:
+                         self.rendezvous_score(key, r.key))
+            if (deadline_s is not None
+                    and affine.est_wait_s() > deadline_s * DEADLINE_MARGIN
+                    and len(fresh) > 1
+                    and by_load[0] is not affine):
+                # The warm replica can't make the deadline and a colder
+                # one can — recompute beats late.
+                order, reason = self._po2_order(by_load), "deadline_spill"
+            else:
+                order = [affine] + [r for r in by_load if r is not affine]
+                reason = "affine"
+        self.m_decisions.inc(reason=reason)
+        return order + cooled_tail, reason
+
+    def _po2_order(self, by_load: list) -> list:
+        """Power-of-two-choices: sample two distinct replicas, lead with
+        the less loaded; everyone else follows load-ascending. Two random
+        probes avoid the thundering herd a strict argmin invites when
+        many routers (or threads) share stale load estimates."""
+        if len(by_load) < 2:
+            return list(by_load)
+        a, b = self._rng.sample(by_load, 2)
+        pick = a if a.est_wait_s() <= b.est_wait_s() else b
+        return [pick] + [r for r in by_load if r is not pick]

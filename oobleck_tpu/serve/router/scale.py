@@ -1,0 +1,147 @@
+"""Pool leases become replicas; reclaims become drains. Zero drops.
+
+The borrow half of the elasticity loop: FleetPressureMonitor
+(pressure.py) prices sustained fleet-wide pressure onto a POOL_BORROW
+request, the arbiter grants a lease of borrowed chips, and this module
+turns the grant into a NEW serving replica — started via an injected
+factory (tests hand in stub replicas; production hands in a
+ServingPlane launcher targeting the leased chips), registered with the
+router, confirmed routable before the lease is considered absorbed.
+
+The reclaim half is where the zero-drop guarantee lives: LEASE_RECLAIM
+means training wants its chips BACK, but a replica holding in-flight
+requests cannot just die — that would convert a scheduling decision
+into user-visible failures. So ``drain()`` goes through the router:
+mark the replica draining (the policy stops routing NEW work to it
+instantly), poll its probed state until queue and lanes are empty
+(every accepted request finishes), then deregister and stop. Only a
+drain that outlives ``timeout_s`` force-stops — and says so in the
+flight record, because a forced stop IS a drop risk and must be
+forensically visible.
+
+Both transitions are flight-recorded (``router_scale_out`` /
+``router_drain``) so a pool-elasticity cycle reads back out of the
+flight recorder as a narrative: borrow granted -> replica up ->
+reclaim -> drained clean.
+"""
+
+from __future__ import annotations
+
+import logging
+import threading
+import time
+
+from oobleck_tpu.utils import metrics
+
+logger = logging.getLogger("oobleck.router")
+
+
+class ReplicaScaler:
+    """Lease -> replica lifecycle against a ReplicaRegistry.
+
+    ``factory(lease)`` must return a handle exposing ``.port`` (int,
+    listening when the call returns) and ``.stop()``; anything more is
+    the factory's business. The scaler registers the replica itself when
+    the factory's replica does not self-register.
+    """
+
+    def __init__(self, registry, factory, *, host: str = "127.0.0.1",
+                 poll_s: float = 0.05):
+        self.registry = registry
+        self._factory = factory
+        self.host = host
+        self.poll_s = poll_s
+        self._lock = threading.Lock()
+        self._handles: dict[str, object] = {}   # lease_id -> handle
+        self._ports: dict[str, int] = {}
+
+    def scale_out(self, lease: dict, *, timeout_s: float = 60.0):
+        """Turn a granted lease into a routable replica.
+
+        Blocks until the router's registry has the new replica probed
+        and routable (a lease the router cannot route to has absorbed
+        nothing). Returns the factory handle; raises TimeoutError when
+        the replica never becomes routable (the handle is stopped — a
+        half-joined replica must not leak).
+        """
+        lease_id = str(lease.get("lease_id") or lease.get("id") or "lease")
+        handle = self._factory(lease)
+        port = int(handle.port)
+        key = f"{self.host}:{port}"
+        try:
+            deadline = time.monotonic() + timeout_s
+            while time.monotonic() < deadline:
+                rep = self.registry.get(key)
+                if rep is None:
+                    # Factory replicas that don't self-register get
+                    # registered here; probes fill in live state.
+                    self.registry.register({
+                        "host": self.host, "port": port,
+                        "v": 1,
+                        "lanes": int(getattr(handle, "lanes", 0) or 1),
+                        "weights_step": int(
+                            getattr(handle, "weights_step", -1)),
+                        "page_size": int(
+                            getattr(handle, "page_size", 0) or 0)})
+                elif not rep.down and rep.last_probe_t is not None:
+                    break
+                else:
+                    self.registry.probe_once()
+                time.sleep(self.poll_s)
+            else:
+                raise TimeoutError(
+                    f"leased replica {key} never became routable")
+        except Exception:
+            try:
+                handle.stop()
+            except Exception:  # noqa: BLE001 — best-effort cleanup, original error wins
+                pass
+            raise
+        with self._lock:
+            self._handles[lease_id] = handle
+            self._ports[lease_id] = port
+        metrics.flight_recorder().record(
+            "router_scale_out", lease_id=lease_id, replica=key)
+        logger.info("router: lease %s absorbed as replica %s",
+                    lease_id, key)
+        return handle
+
+    def drain(self, lease_id: str, *, timeout_s: float = 30.0) -> dict:
+        """Reclaim path: drain the leased replica THROUGH the router and
+        stop it. Returns {"replica", "drained_clean", "drain_s"};
+        drained_clean False means the timeout forced the stop (drop
+        risk — flight-recorded as such)."""
+        with self._lock:
+            handle = self._handles.pop(lease_id, None)
+            port = self._ports.pop(lease_id, None)
+        if handle is None:
+            raise KeyError(f"no replica held for lease {lease_id}")
+        key = f"{self.host}:{port}"
+        t0 = time.monotonic()
+        self.registry.mark_draining(key)
+        clean = False
+        deadline = t0 + timeout_s
+        while time.monotonic() < deadline:
+            self.registry.probe_once()
+            rep = self.registry.get(key)
+            if rep is None or rep.down:
+                # Died while draining; nothing left to wait for.
+                break
+            if rep.queue_depth <= 0 and rep.slots_active <= 0:
+                clean = True
+                break
+            time.sleep(self.poll_s)
+        self.registry.deregister(self.host, port)
+        handle.stop()
+        drain_s = time.monotonic() - t0
+        metrics.flight_recorder().record(
+            "router_drain", lease_id=lease_id, replica=key,
+            drained_clean=clean, drain_s=round(drain_s, 6))
+        logger.info("router: lease %s drained (%s, %.2fs)", lease_id,
+                    "clean" if clean else "FORCED", drain_s)
+        return {"replica": key, "drained_clean": clean,
+                "drain_s": drain_s}
+
+    def held_leases(self) -> list[str]:
+        with self._lock:
+            return list(self._handles)

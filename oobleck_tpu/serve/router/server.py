@@ -1,0 +1,344 @@
+"""Router HTTP front door: one address in front of N serving replicas.
+
+Same stdlib ThreadingHTTPServer pattern as the replicas it fronts (and
+the master's /metrics endpoint): daemon thread, port 0 binds ephemeral,
+read ``.port`` after start.
+
+Endpoints:
+  POST /v1/generate    Same body the replicas take. The router tokenizes
+                       the prompt head for affinity, asks the policy for
+                       an ordered candidate list, and proxies down it:
+                       429 from a full replica SPILLS to the next
+                       candidate; a dead connection FAILS OVER (below);
+                       success returns the replica's payload annotated
+                       with "routed_to" and "route_reason". Every
+                       candidate full -> 429 with the soonest honest
+                       Retry-After any replica advertised. No replicas
+                       -> 503.
+  POST /v1/register    Replica handshake (registry.ROUTER_WIRE_V).
+  POST /v1/deregister  {"host", "port"} — clean replica exit.
+  GET  /healthz        Router + fleet summary (replica state counts,
+                       fleet weights span, fleet queue depth).
+  GET  /replicas       Full per-replica registry view.
+  GET  /metrics        Prometheus text for the router process.
+
+Failover is an incident, not a retry loop: a connection that dies
+mid-request marks the replica DOWN in the registry (which commits the
+obs incident under this request's trace id), flight-records the
+failover, and — only if the request is idempotent — retries ONCE (knob:
+``OOBLECK_ROUTER_RETRY``) on the next candidate. Non-idempotent
+requests get a fast 503 with the trace id instead of a silent
+double-execution; clients decide. A request is idempotent when greedy
+(temperature 0) or when the body says ``"idempotent": true/false``
+explicitly (the body wins — greedy-but-stateful callers exist).
+
+Every request carries one trace id end to end: the router injects it
+into the proxied body (replicas echo it and tag their server-side spans
+with it), records its own ``router.request`` span under it, and stamps
+it on any failover incident — so "what happened to request X" is one
+trace query even when X crossed three replicas.
+"""
+
+from __future__ import annotations
+
+import http.client
+import json
+import logging
+import os
+import threading
+import time
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+
+from oobleck_tpu.obs import spans
+from oobleck_tpu.utils import metrics
+from oobleck_tpu.utils.metrics import SERVE_LATENCY_BUCKETS
+
+logger = logging.getLogger("oobleck.router")
+
+ENV_PORT = "OOBLECK_ROUTER_PORT"
+ENV_RETRY = "OOBLECK_ROUTER_RETRY"
+
+DEFAULT_RETRY = 1          # failover retries per request (idempotent only)
+SHED_RETRY_AFTER_S = 5     # Retry-After floor when no replica advertised one
+
+
+def _env_int(name: str, default: int) -> int:
+    raw = os.environ.get(name, "")
+    try:
+        return int(raw) if raw else default
+    except ValueError:
+        return default
+
+
+class RouterHTTPServer:
+    """Routing proxy over a ReplicaRegistry + RoutingPolicy."""
+
+    def __init__(self, registry, policy, *, port: int | None = None,
+                 host: str = "0.0.0.0", proxy_timeout_s: float = 120.0,
+                 retry_max: int | None = None):
+        self.registry = registry
+        self.policy = policy
+        self.proxy_timeout_s = proxy_timeout_s
+        self.retry_max = retry_max if retry_max is not None \
+            else _env_int(ENV_RETRY, DEFAULT_RETRY)
+        reg = metrics.registry()
+        self.m_requests = reg.counter(
+            "oobleck_router_requests_total",
+            "Routed requests by outcome (finish_reason, shed, "
+            "failover_503, retries_exhausted, no_replicas, error)")
+        self.m_failovers = reg.counter(
+            "oobleck_router_failovers_total",
+            "Mid-request replica failures the router absorbed")
+        self.m_spills = reg.counter(
+            "oobleck_router_spills_total",
+            "Hops to a fallback replica because the pick returned 429")
+        self.m_ttft = reg.histogram(
+            "oobleck_router_ttft_seconds",
+            "Replica-reported TTFT as seen through the router",
+            buckets=SERVE_LATENCY_BUCKETS)
+        self.m_latency = reg.histogram(
+            "oobleck_router_request_seconds",
+            "Router-side end-to-end request latency",
+            buckets=SERVE_LATENCY_BUCKETS)
+        outer = self
+
+        class Handler(BaseHTTPRequestHandler):
+            def log_message(self, fmt, *args):  # keep test logs quiet
+                logger.debug("router http: " + fmt, *args)
+
+            def _reply(self, code: int, payload,
+                       ctype: str = "application/json",
+                       headers: dict | None = None) -> None:
+                body = json.dumps(payload).encode() \
+                    if ctype == "application/json" else payload
+                self.send_response(code)
+                self.send_header("Content-Type", ctype)
+                self.send_header("Content-Length", str(len(body)))
+                for k, v in (headers or {}).items():
+                    self.send_header(k, str(v))
+                self.end_headers()
+                self.wfile.write(body)
+
+            def do_GET(self):
+                try:
+                    path = self.path.split("?")[0]
+                    if path == "/healthz":
+                        self._reply(200, outer._health())
+                    elif path == "/replicas":
+                        self._reply(200, {
+                            "replicas": [
+                                r.as_dict(
+                                    cooled=outer.registry.is_cooled(r))
+                                for r in outer.registry.replicas()]})
+                    elif path == "/metrics":
+                        text = metrics.render_prometheus(
+                            [metrics.registry().snapshot()]).encode()
+                        self._reply(
+                            200, text,
+                            "text/plain; version=0.0.4; charset=utf-8")
+                    else:
+                        self.send_error(404)
+                except Exception:  # noqa: BLE001 — endpoint must never kill the router
+                    logger.exception("router GET failed")
+                    self.send_error(500)
+
+            def do_POST(self):
+                try:
+                    path = self.path.split("?")[0]
+                    length = int(self.headers.get("Content-Length") or 0)
+                    try:
+                        body = json.loads(self.rfile.read(length) or b"{}")
+                        if not isinstance(body, dict):
+                            raise ValueError("body must be a JSON object")
+                    except ValueError as e:
+                        self._reply(400, {"error": str(e)})
+                        return
+                    if path == "/v1/generate":
+                        code, payload, headers = outer._route(body)
+                        self._reply(code, payload, headers=headers)
+                    elif path == "/v1/register":
+                        try:
+                            self._reply(
+                                200,
+                                outer.registry.register(
+                                    body,
+                                    default_host=self.client_address[0]))
+                        except (ValueError, TypeError) as e:
+                            self._reply(400, {"error": str(e)})
+                    elif path == "/v1/deregister":
+                        ok = outer.registry.deregister(
+                            str(body.get("host") or self.client_address[0]),
+                            int(body.get("port") or 0))
+                        self._reply(200 if ok else 404, {"ok": ok})
+                    else:
+                        self.send_error(404)
+                except Exception:  # noqa: BLE001 — endpoint must never kill the router
+                    logger.exception("router POST failed")
+                    self.send_error(500)
+
+        self._server = ThreadingHTTPServer(
+            (host, port if port is not None else _env_int(ENV_PORT, 0)),
+            Handler)
+        self._server.daemon_threads = True
+        self.port = self._server.server_address[1]
+        self._thread = threading.Thread(
+            target=self._server.serve_forever, name="oobleck-router-http",
+            daemon=True)
+
+    # -- fleet summary ----------------------------------------------------- #
+
+    def _health(self) -> dict:
+        reps = self.registry.replicas()
+        states: dict[str, int] = {}
+        for r in reps:
+            state = r.as_dict(cooled=self.registry.is_cooled(r))["state"]
+            states[state] = states.get(state, 0) + 1
+        return {
+            "ok": any(not r.down and not r.draining for r in reps),
+            "replicas": len(reps),
+            "states": states,
+            "fleet_weights_step": self.registry.fleet_weights_step(),
+            "fleet_queue_depth": sum(
+                r.queue_depth for r in reps if not r.down),
+        }
+
+    # -- the routed request ------------------------------------------------ #
+
+    def _route(self, body: dict) -> tuple[int, dict, dict | None]:
+        t0 = time.time()
+        trace_id = body.get("trace_id")
+        if not isinstance(trace_id, str) or not trace_id:
+            trace_id = spans.new_trace_id()
+        body = dict(body)
+        body["trace_id"] = trace_id
+        tokens = self._head_tokens(body)
+        deadline_ms = body.get("deadline_ms")
+        try:
+            deadline_s = float(deadline_ms) / 1e3 if deadline_ms else None
+        except (TypeError, ValueError):
+            deadline_s = None
+        idempotent = bool(body.get(
+            "idempotent", float(body.get("temperature") or 0.0) <= 0.0))
+        order, reason = self.policy.plan(tokens, deadline_s)
+        if not order:
+            self.m_requests.inc(outcome="no_replicas")
+            return 503, {"error": "no routable replicas",
+                         "trace_id": trace_id}, None
+        failovers = 0
+        retry_afters: list[int] = []
+        for hop, rep in enumerate(order):
+            status, payload, err = self._proxy(rep, body)
+            if err is not None:
+                failovers += 1
+                self.m_failovers.inc()
+                self.registry.mark_down(rep.key, reason=f"proxy: {err}",
+                                        trace_id=trace_id)
+                metrics.flight_recorder().record(
+                    "router_failover", replica=rep.key, error=err,
+                    idempotent=idempotent, retry=failovers,
+                    trace_id=trace_id)
+                spans.span_recorder().record(
+                    "router.failover", t0, time.time(),
+                    trace_id=trace_id, replica=rep.key, error=err,
+                    idempotent=idempotent)
+                if not idempotent:
+                    # The replica may have executed side effects before
+                    # dying; replaying a non-idempotent request is the
+                    # router silently double-spending. Fail fast, tell
+                    # the client which trace to investigate.
+                    self.m_requests.inc(outcome="failover_503")
+                    return 503, {
+                        "error": f"replica {rep.key} failed mid-request; "
+                                 "request not idempotent, not retried",
+                        "trace_id": trace_id}, None
+                if failovers > self.retry_max:
+                    self.m_requests.inc(outcome="retries_exhausted")
+                    return 503, {
+                        "error": f"{failovers} replicas failed "
+                                 "mid-request; retries exhausted",
+                        "trace_id": trace_id}, None
+                continue
+            if status == 429:
+                # Replica full: spill down the plan, remember its honest
+                # Retry-After in case everyone is full.
+                self.m_spills.inc()
+                retry_afters.append(
+                    int((payload or {}).get("retry_after_s") or 0))
+                continue
+            route_reason = reason if hop == 0 else (
+                "failover" if failovers else "spill")
+            outcome = str(payload.get("finish_reason") or f"status_{status}") \
+                if status == 200 else f"status_{status}"
+            self.m_requests.inc(outcome=outcome)
+            if status == 200:
+                ttft_s = float(payload.get("ttft_ms") or 0.0) / 1e3
+                self.m_ttft.observe(ttft_s)
+                rep.observe_ttft(ttft_s)
+                payload["routed_to"] = rep.key
+                payload["route_reason"] = route_reason
+            self.m_latency.observe(time.time() - t0)
+            spans.span_recorder().record(
+                "router.request", t0, time.time(), trace_id=trace_id,
+                replica=rep.key, reason=route_reason, status=status,
+                hops=hop + 1, failovers=failovers)
+            return status, payload, None
+        # Every candidate admitted nothing: shed with the SOONEST honest
+        # Retry-After any replica advertised (first slot to free anywhere
+        # in the fleet is when retrying can succeed).
+        retry_after = min((ra for ra in retry_afters if ra > 0),
+                          default=SHED_RETRY_AFTER_S)
+        self.m_requests.inc(outcome="shed")
+        self.m_latency.observe(time.time() - t0)
+        return 429, {"error": "all replicas at capacity",
+                     "retry_after_s": retry_after,
+                     "trace_id": trace_id}, \
+            {"Retry-After": retry_after}
+
+    def _head_tokens(self, body: dict) -> list[int]:
+        """Prompt head as ints for the affinity fingerprint. Mirrors the
+        replica's tokenization (explicit ids, else byte-level prompt) but
+        never raises — malformed bodies route balanced and let the
+        replica produce the authoritative 400."""
+        tokens = body.get("tokens")
+        if isinstance(tokens, list) and all(
+                isinstance(t, int) and not isinstance(t, bool)
+                for t in tokens):
+            return tokens
+        prompt = body.get("prompt")
+        if isinstance(prompt, str):
+            return list(prompt.encode("utf-8"))
+        return []
+
+    def _proxy(self, rep, body: dict) \
+            -> tuple[int, dict, None] | tuple[None, None, str]:
+        """One proxied attempt: (status, payload, None) on any HTTP
+        response (429s and 4xx/5xx included — those are the replica
+        SPEAKING, not dead), (None, None, error) when the connection
+        refused, reset, or timed out."""
+        try:
+            conn = http.client.HTTPConnection(
+                rep.host, rep.port, timeout=self.proxy_timeout_s)
+            try:
+                conn.request("POST", "/v1/generate", json.dumps(body),
+                             {"Content-Type": "application/json"})
+                resp = conn.getresponse()
+                raw = resp.read()
+            finally:
+                conn.close()
+            payload = json.loads(raw) if raw else {}
+            if not isinstance(payload, dict):
+                payload = {}
+            return resp.status, payload, None
+        except (OSError, ValueError, http.client.HTTPException) as e:
+            return None, None, f"{type(e).__name__}: {e}"
+
+    # -- lifecycle --------------------------------------------------------- #
+
+    def start(self) -> "RouterHTTPServer":
+        self._thread.start()
+        logger.info("router http listening on :%d", self.port)
+        return self
+
+    def close(self) -> None:
+        self._server.shutdown()
+        self._server.server_close()

@@ -90,6 +90,20 @@ directives; each directive is ``action=arg[:qual][@ip]``:
                                 ``slow_host=10.0.0.1:2.5@3`` starts
                                 slowing on the 4th step poll (a healthy
                                 baseline first, then degradation)
+    kill_replica=8001           serving-replica death: the replica whose
+                                HTTP server listens on port 8001 dies at
+                                its next /v1/generate request — the
+                                in-flight connection aborts with no
+                                response and the port stops accepting.
+                                ``kill_replica=8001@3`` dies at its 3rd
+                                request instead (deterministic mid-
+                                traffic kill for router failover tests).
+                                One-shot: a dead replica cannot die again
+    hang_replica=8001:2         serving-replica hang: the replica on port
+                                8001 sleeps 2 s before answering its next
+                                request — alive-but-unresponsive, the
+                                case the router's liveness probes must
+                                flag without any TCP disconnect. One-shot
     traffic_wave=40:20          serve traffic wave: the open-loop load
                                 generator ramps its request rate in a
                                 triangle wave peaking at 40 req/s with a
@@ -130,7 +144,8 @@ _KNOWN_ACTIONS = ("delay_send", "drop_send", "stall_heartbeat", "kill_at",
                   "delay_at", "kill_stage", "flap_host", "kill_hosts",
                   "preempt_notice", "join_host", "join_hosts",
                   "spot_lifetime", "kill_master", "partition_master",
-                  "slow_host", "traffic_wave")
+                  "slow_host", "traffic_wave", "kill_replica",
+                  "hang_replica")
 
 
 @dataclass
@@ -237,6 +252,20 @@ def parse_spec(spec: str) -> list[Rule]:
                 raise ValueError(
                     f"traffic_wave needs a positive period: {directive!r}")
             int(rule.ip or 0)       # @segment = poll delay
+        elif action == "kill_replica":
+            if int(rule.arg) <= 0:  # kill_replica=<port>[@<req>]
+                raise ValueError(
+                    f"kill_replica needs a replica port: {directive!r}")
+            if int(rule.ip or 1) < 1:  # @segment = request ordinal
+                raise ValueError(
+                    f"kill_replica ordinal must be >= 1: {directive!r}")
+        elif action == "hang_replica":
+            if int(rule.arg) <= 0:  # hang_replica=<port>:<secs>
+                raise ValueError(
+                    f"hang_replica needs a replica port: {directive!r}")
+            if float(rule.qual or 0) <= 0:
+                raise ValueError(
+                    f"hang_replica needs positive seconds: {directive!r}")
         elif rule.qual is not None:
             int(rule.qual)
         rules.append(rule)
@@ -539,6 +568,62 @@ class Chaos:
                     "chaos_injection", action="traffic_wave",
                     peak_rps=peak, period_s=period)
             return float(r.arg), float(r.qual or 0)
+        return None
+
+    # -- serving-replica faults (router-plane) ------------------------------ #
+
+    def kill_replica_now(self, port: int) -> bool:
+        """True exactly once, on the request whose ordinal a kill_replica
+        rule for this port names (first request when no ``@<req>``): the
+        replica's HTTP server dies mid-request — the in-flight connection
+        aborts with no response and the port stops accepting, which is
+        the failover the router must absorb. Call per /v1/generate
+        request; counts requests per rule; consuming (a dead replica
+        cannot die again)."""
+        for r in self.rules:
+            if r.action != "kill_replica" or int(r.arg) != int(port):
+                continue
+            i = self.rules.index(r)
+            n = self._counts.get(i, 0)
+            if n < 0:
+                continue  # already fired
+            n += 1
+            ordinal = int(r.ip or 1)
+            if n < ordinal:
+                self._counts[i] = n
+                continue
+            self._counts[i] = -1
+            logger.warning("chaos: killing replica :%d at request %d",
+                           int(port), n)
+            from oobleck_tpu.utils import metrics
+
+            metrics.flight_recorder().record(
+                "chaos_injection", action="kill_replica", port=int(port),
+                request=n)
+            return True
+        return False
+
+    def hang_replica_secs(self, port: int) -> float | None:
+        """One-shot hang length (seconds) for the serving replica on
+        `port`, or None. The replica's handler sleeps that long before
+        answering — the alive-but-unresponsive replica a liveness probe
+        must flag without a TCP disconnect ever firing. Consuming."""
+        for r in self.rules:
+            if r.action != "hang_replica" or int(r.arg) != int(port):
+                continue
+            i = self.rules.index(r)
+            if self._counts.get(i, 0):
+                continue
+            self._counts[i] = 1
+            secs = float(r.qual or 0)
+            logger.warning("chaos: hanging replica :%d for %.2fs",
+                           int(port), secs)
+            from oobleck_tpu.utils import metrics
+
+            metrics.flight_recorder().record(
+                "chaos_injection", action="hang_replica", port=int(port),
+                seconds=secs)
+            return secs
         return None
 
     # -- named barriers ---------------------------------------------------- #

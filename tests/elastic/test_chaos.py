@@ -115,6 +115,14 @@ def test_parse_spec_outage_directives():
     "traffic_wave=40:0",      # non-positive period
     "traffic_wave=soon:20",   # non-numeric peak
     "traffic_wave=40:20@soon",        # non-integer poll delay
+    "kill_replica=0",         # non-positive replica port
+    "kill_replica=eight",     # non-numeric replica port
+    "kill_replica=8001@0",    # non-positive request ordinal
+    "kill_replica=8001@nth",  # non-integer request ordinal
+    "hang_replica=8001",      # no hang length
+    "hang_replica=0:2",       # non-positive replica port
+    "hang_replica=8001:0",    # non-positive hang length
+    "hang_replica=8001:long",  # non-numeric hang length
 ])
 def test_parse_spec_rejects_typos_eagerly(bad):
     # A typo'd injection spec must fail the run at parse time, not
@@ -280,6 +288,45 @@ def test_traffic_wave_activation_delay_and_persistence():
     assert now.traffic_wave() == (8.0, 5.0)
     # No wave directive at all: always None.
     assert Chaos("delay_send=0.1").traffic_wave() is None
+
+
+def test_parse_spec_replica_directives():
+    """Serving-replica faults (router plane): kill_replica=<port>[@<req>]
+    — the @ segment is a REQUEST ordinal, like join_host's step delay —
+    and hang_replica=<port>:<secs>."""
+    rules = parse_spec("kill_replica=8001, kill_replica=8002@3, "
+                       "hang_replica=8003:2.5")
+    assert [(r.action, r.arg, r.qual, r.ip) for r in rules] == [
+        ("kill_replica", "8001", None, None),
+        ("kill_replica", "8002", None, "3"),
+        ("hang_replica", "8003", "2.5", None),
+    ]
+
+
+def test_replica_directive_semantics():
+    """kill_replica fires on the named request ordinal for the named
+    port only, once (a dead replica cannot die again); hang_replica is
+    one-shot; both flight-record the injection."""
+    from oobleck_tpu.utils import metrics
+
+    c = Chaos("kill_replica=8001@2, hang_replica=8002:1.5")
+    assert c.hang_replica_secs(8001) is None          # port filter
+    assert not c.kill_replica_now(8002)
+    assert not c.kill_replica_now(8001)               # request 1 of 2
+    assert c.kill_replica_now(8001)                   # request 2: fires
+    assert not c.kill_replica_now(8001)               # consumed
+    assert c.hang_replica_secs(8002) == pytest.approx(1.5)
+    assert c.hang_replica_secs(8002) is None          # consumed
+    events = [e for e in metrics.flight_recorder().events()
+              if e["event"] == "chaos_injection"
+              and e.get("action") in ("kill_replica", "hang_replica")]
+    assert {e["action"] for e in events} == {"kill_replica",
+                                             "hang_replica"}
+    kill = [e for e in events if e["action"] == "kill_replica"][-1]
+    assert kill["port"] == 8001 and kill["request"] == 2
+    # No ordinal: the FIRST request to the port kills it.
+    first = Chaos("kill_replica=9001")
+    assert first.kill_replica_now(9001)
 
 
 def test_inactive_chaos_is_a_noop():

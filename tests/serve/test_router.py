@@ -1,0 +1,476 @@
+"""Router unit tests over stub replicas: affinity, deadlines, failover,
+skew cooling, wire compat, and honest shedding.
+
+Stub replicas are real HTTP servers (the router speaks sockets, so the
+tests do too) with scripted health and generate behavior — no JAX, no
+engines, so this file runs in milliseconds and exercises every routing
+decision the policy can make.
+"""
+
+import json
+import os
+import threading
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+
+import pytest
+
+from oobleck_tpu.serve.router import (
+    ROUTER_WIRE_V,
+    ReplicaRegistry,
+    RouterHTTPServer,
+    RoutingPolicy,
+)
+
+PAGE = 16
+
+
+class StubReplica:
+    """Scripted replica: normal 200s, 'full' (429 + retry_after_s), or
+    'legacy' (pre-router /healthz keys only, no wire version)."""
+
+    def __init__(self, *, step=5, queue=0.0, lanes=4, mode="ok",
+                 retry_after=2):
+        self.step, self.queue, self.lanes = step, queue, lanes
+        self.mode, self.retry_after = mode, retry_after
+        self.hits = 0
+        outer = self
+
+        class Handler(BaseHTTPRequestHandler):
+            def log_message(self, *a):
+                pass
+
+            def _json(self, code, payload):
+                body = json.dumps(payload).encode()
+                self.send_response(code)
+                self.send_header("Content-Length", str(len(body)))
+                self.end_headers()
+                self.wfile.write(body)
+
+            def do_GET(self):
+                if outer.mode == "legacy":
+                    self._json(200, {"ok": True, "step": outer.step,
+                                     "slots_active": 0,
+                                     "queue_depth": outer.queue})
+                else:
+                    self._json(200, {
+                        "ok": True, "v": 1, "weights_step": outer.step,
+                        "queue_depth": outer.queue, "slots_active": 0,
+                        "lanes": outer.lanes, "page_size": PAGE,
+                        "retry_after_s": outer.retry_after})
+
+            def do_POST(self):
+                n = int(self.headers.get("Content-Length") or 0)
+                body = json.loads(self.rfile.read(n) or b"{}")
+                outer.hits += 1
+                if outer.mode == "full":
+                    self._json(429, {"error": "queue full",
+                                     "retry_after_s": outer.retry_after})
+                    return
+                self._json(200, {
+                    "tokens": [1, 2], "finish_reason": "length",
+                    "ttft_ms": 4.0, "step": outer.step,
+                    "trace_id": body.get("trace_id")})
+
+        self.srv = ThreadingHTTPServer(("127.0.0.1", 0), Handler)
+        self.srv.daemon_threads = True
+        self.port = self.srv.server_address[1]
+        threading.Thread(target=self.srv.serve_forever, daemon=True).start()
+
+    @property
+    def key(self):
+        return f"127.0.0.1:{self.port}"
+
+    def register_payload(self):
+        if self.mode == "legacy":
+            return {"port": self.port}     # that's all old replicas sent
+        return {"v": 1, "host": "127.0.0.1", "port": self.port,
+                "lanes": self.lanes, "weights_step": self.step,
+                "page_size": PAGE}
+
+    def stop(self):
+        self.srv.shutdown()
+        self.srv.server_close()
+
+
+@pytest.fixture
+def fleet(request):
+    """(registry, policy, stubs, cleanup-registered router list)."""
+    registry = ReplicaRegistry(probe_s=0.1, skew_max=2)
+    stubs, routers = [], []
+    yield registry, stubs, routers
+    registry.stop()
+    for router in routers:
+        router.close()
+    for s in stubs:
+        try:
+            s.stop()
+        except OSError:
+            pass
+
+
+def _start_router(registry, routers, **kw):
+    policy = kw.pop("policy", None) or RoutingPolicy(registry, seed=0)
+    router = RouterHTTPServer(registry, policy, host="127.0.0.1",
+                              **kw).start()
+    routers.append(router)
+    return router
+
+
+def _post(port, body, path="/v1/generate"):
+    import http.client
+
+    conn = http.client.HTTPConnection("127.0.0.1", port, timeout=10)
+    conn.request("POST", path, json.dumps(body),
+                 {"Content-Type": "application/json"})
+    resp = conn.getresponse()
+    out = json.loads(resp.read() or b"{}")
+    headers = dict(resp.getheaders())
+    conn.close()
+    return resp.status, out, headers
+
+
+def _join_fleet(registry, stubs, n=3, **stub_kw):
+    for _ in range(n):
+        s = StubReplica(**stub_kw)
+        stubs.append(s)
+        registry.register(s.register_payload())
+    registry.probe_once()
+    return stubs
+
+
+# -- registry ------------------------------------------------------------- #
+
+
+def test_register_probe_refresh_and_versioned_ack(fleet):
+    registry, stubs, _ = fleet
+    s = StubReplica(step=7, queue=3.0)
+    stubs.append(s)
+    ack = registry.register(s.register_payload())
+    assert ack["ok"] and ack["v"] == ROUTER_WIRE_V
+    assert ack["replica"] == s.key
+    registry.probe_once()
+    rep = registry.get(s.key)
+    assert rep.weights_step == 7
+    assert rep.queue_depth == 3.0
+    assert rep.rtt_ewma_s is not None and rep.probe_failures == 0
+    fresh, cooled = registry.routable()
+    assert [r.key for r in fresh] == [s.key] and not cooled
+
+
+class _Healthz(BaseHTTPRequestHandler):
+    def log_message(self, *a):
+        pass
+
+    def do_GET(self):
+        body = json.dumps({"ok": True, "v": 1, "weights_step": 9,
+                           "queue_depth": 0, "slots_active": 0,
+                           "lanes": 4, "page_size": PAGE,
+                           "retry_after_s": 1}).encode()
+        self.send_response(200)
+        self.send_header("Content-Length", str(len(body)))
+        self.end_headers()
+        self.wfile.write(body)
+
+
+def test_consecutive_probe_failures_mark_down_then_self_heal(fleet):
+    registry, stubs, _ = fleet
+    s = _join_fleet(registry, stubs, n=1)[0]
+    port = s.port
+    s.stop()
+    registry.probe_once()          # failure 1: benign blip
+    assert not registry.get(s.key).down
+    registry.probe_once()          # failure 2: DOWN
+    rep = registry.get(s.key)
+    assert rep.down and "probe" in rep.down_reason
+    assert registry.routable() == ([], [])
+    # Same port comes back (replica restarted): next probe heals it —
+    # DOWN is a judgment, not a tombstone.
+    back = ThreadingHTTPServer(("127.0.0.1", port), _Healthz)
+    back.daemon_threads = True
+    threading.Thread(target=back.serve_forever, daemon=True).start()
+    try:
+        registry.probe_once()
+        healed = registry.get(s.key)
+        assert not healed.down and healed.weights_step == 9
+    finally:
+        back.shutdown()
+        back.server_close()
+
+
+# -- prefix affinity ------------------------------------------------------ #
+
+
+def test_affinity_is_sticky_and_beats_random(fleet):
+    """The acceptance property: routing by the prompt-head chain hash
+    lands repeat prefixes on the replica that saw them before at a rate
+    no random assignment can match."""
+    import random as random_mod
+
+    registry, stubs, _ = fleet
+    _join_fleet(registry, stubs, n=3)
+    policy = RoutingPolicy(registry, seed=0)
+    heads = [[(h * 31 + j) % 251 for j in range(2 * PAGE)]
+             for h in range(24)]
+    # Model each replica's prefix cache as the set of heads it served.
+    caches = {s.key: set() for s in stubs}
+    rng = random_mod.Random(0)
+    affine_hits = random_hits = total = 0
+    random_caches = {s.key: set() for s in stubs}
+    for _ in range(4):                      # each head re-requested
+        for head in heads:
+            key = policy.head_key(head)
+            order, reason = policy.plan(head)
+            assert reason == "affine"
+            pick = order[0].key
+            affine_hits += key in caches[pick]
+            caches[pick].add(key)
+            rpick = rng.choice(list(random_caches))
+            random_hits += key in random_caches[rpick]
+            random_caches[rpick].add(key)
+            total += 1
+    # Affinity: every repeat is a hit (3 of 4 rounds) = 72/96.
+    assert affine_hits == 3 * len(heads)
+    assert affine_hits > random_hits
+
+
+def test_affinity_remaps_minimally_on_replica_death(fleet):
+    """Rendezvous property: removing one replica moves only ITS keys."""
+    registry, stubs, _ = fleet
+    _join_fleet(registry, stubs, n=3)
+    policy = RoutingPolicy(registry, seed=0)
+    heads = [[(h * 17 + j) % 251 for j in range(2 * PAGE)]
+             for h in range(30)]
+    before = {tuple(h): policy.plan(h)[0][0].key for h in heads}
+    victim = stubs[0].key
+    registry.mark_down(victim, reason="test")
+    for h in heads:
+        after = policy.plan(h)[0][0].key
+        if before[tuple(h)] != victim:
+            assert after == before[tuple(h)]   # survivors keep their keys
+        else:
+            assert after != victim
+
+
+def test_short_prompt_routes_balanced(fleet):
+    registry, stubs, _ = fleet
+    _join_fleet(registry, stubs, n=3)
+    policy = RoutingPolicy(registry, seed=0)
+    order, reason = policy.plan(list(range(PAGE - 1)))  # < one full page
+    assert reason == "balanced" and len(order) == 3
+
+
+# -- deadlines ------------------------------------------------------------ #
+
+
+def test_deadline_spills_away_from_loaded_affine_replica(fleet):
+    registry, stubs, _ = fleet
+    _join_fleet(registry, stubs, n=3)
+    policy = RoutingPolicy(registry, seed=0)
+    head = list(range(2 * PAGE))
+    affine = policy.plan(head)[0][0]
+    # Pile queue onto the affine replica: est_wait ~ queue * 50 ms.
+    affine.queue_depth = 100.0
+    order, reason = policy.plan(head, deadline_s=0.5)
+    assert reason == "deadline_spill"
+    assert order[0].key != affine.key
+    # Without a deadline the warm cache still wins, load and all.
+    order, reason = policy.plan(head)
+    assert reason == "affine" and order[0].key == affine.key
+    # A deadline the affine replica can make doesn't spill.
+    affine.queue_depth = 0.0
+    order, reason = policy.plan(head, deadline_s=5.0)
+    assert reason == "affine" and order[0].key == affine.key
+
+
+# -- weights skew --------------------------------------------------------- #
+
+
+def test_weights_skew_cools_lagging_replica(fleet):
+    registry, stubs, _ = fleet
+    fresh_stub = StubReplica(step=10)
+    stale_stub = StubReplica(step=3)       # 7 reloads behind, skew_max=2
+    stubs.extend([fresh_stub, stale_stub])
+    for s in (fresh_stub, stale_stub):
+        registry.register(s.register_payload())
+    registry.probe_once()
+    fresh, cooled = registry.routable()
+    assert [r.key for r in fresh] == [fresh_stub.key]
+    assert [r.key for r in cooled] == [stale_stub.key]
+    policy = RoutingPolicy(registry, seed=0)
+    order, reason = policy.plan(list(range(2 * PAGE)))
+    assert order[-1].key == stale_stub.key      # last resort, not absent
+    # The fresh replica drains (still alive, still the fleet's newest
+    # step): the stale one is all that can take traffic — cooled beats
+    # nothing.
+    registry.mark_draining(fresh_stub.key)
+    order, reason = policy.plan(list(range(2 * PAGE)))
+    assert reason == "cooled_only"
+    assert [r.key for r in order] == [stale_stub.key]
+    # The fresh replica DIES: the stale replica now IS the fleet's
+    # newest step — nobody to lag behind, gate opens, normal routing.
+    registry.mark_down(fresh_stub.key, reason="test")
+    _, reason = policy.plan(list(range(2 * PAGE)))
+    assert reason == "affine"
+
+
+# -- wire compat ---------------------------------------------------------- #
+
+
+def test_legacy_replica_registers_probes_and_routes(fleet):
+    registry, stubs, routers = fleet
+    legacy = StubReplica(step=4, mode="legacy")
+    stubs.append(legacy)
+    ack = registry.register(legacy.register_payload())   # bare {"port"}
+    assert ack["ok"]
+    rep = registry.get(f"127.0.0.1:{legacy.port}")
+    assert rep.wire_v == 0 and rep.lanes == 1
+    registry.probe_once()
+    assert rep.weights_step == 4       # read from the legacy "step" key
+    assert not registry.is_cooled(rep)
+    router = _start_router(registry, routers)
+    status, out, _ = _post(router.port, {"tokens": list(range(40))})
+    assert status == 200 and out["routed_to"] == rep.key
+
+
+# -- failover ------------------------------------------------------------- #
+
+
+def test_failover_retries_idempotent_request_once(fleet, tmp_path,
+                                                  monkeypatch):
+    monkeypatch.setenv("OOBLECK_METRICS_DIR", str(tmp_path))
+    registry, stubs, routers = fleet
+    _join_fleet(registry, stubs, n=2)
+    policy = RoutingPolicy(registry, seed=0)
+    router = _start_router(registry, routers, policy=policy)
+    head = list(range(2 * PAGE))
+    victim = policy.plan(head)[0][0]
+    survivor = [s for s in stubs if s.key != victim.key][0]
+    [s for s in stubs if s.key == victim.key][0].stop()
+    failovers0 = router.m_failovers.value()
+    status, out, _ = _post(router.port, {"tokens": head,
+                                         "temperature": 0.0})
+    assert status == 200
+    assert out["routed_to"] == survivor.key
+    assert out["route_reason"] == "failover"
+    assert registry.get(victim.key).down
+    assert router.m_failovers.value() - failovers0 == 1
+    # The death was committed as an incident under this request's trace.
+    incidents = [p for p in os.listdir(tmp_path)
+                 if p.startswith("incident-")]
+    assert len(incidents) == 1
+    rec = json.loads((tmp_path / incidents[0]).read_text())
+    assert rec["lost_ip"] == victim.key
+    assert rec["cause"] == "serve_replica_down"
+    assert rec["trace_id"] == out["trace_id"]
+
+
+def test_non_idempotent_request_fails_fast_no_retry(fleet):
+    registry, stubs, routers = fleet
+    _join_fleet(registry, stubs, n=2)
+    policy = RoutingPolicy(registry, seed=0)
+    router = _start_router(registry, routers, policy=policy)
+    head = list(range(2 * PAGE))
+    victim = policy.plan(head)[0][0]
+    survivor = [s for s in stubs if s.key != victim.key][0]
+    [s for s in stubs if s.key == victim.key][0].stop()
+    before = survivor.hits
+    status, out, _ = _post(router.port, {"tokens": head,
+                                         "temperature": 0.8})
+    assert status == 503
+    assert "not idempotent" in out["error"]
+    assert out["trace_id"]
+    assert survivor.hits == before          # nothing was re-executed
+    # Explicit body flag overrides the temperature heuristic.
+    status, out, _ = _post(router.port, {"tokens": head,
+                                         "temperature": 0.8,
+                                         "idempotent": True})
+    assert status == 200 and out["routed_to"] == survivor.key
+
+
+def test_retries_exhausted_when_every_replica_dies(fleet):
+    registry, stubs, routers = fleet
+    _join_fleet(registry, stubs, n=2)
+    router = _start_router(registry, routers, retry_max=1)
+    for s in stubs:
+        s.stop()
+    status, out, _ = _post(router.port, {"tokens": list(range(40)),
+                                         "temperature": 0.0})
+    assert status == 503 and "retries exhausted" in out["error"]
+
+
+# -- spill and shed ------------------------------------------------------- #
+
+
+def test_full_replica_spills_to_next_candidate(fleet):
+    registry, stubs, routers = fleet
+    full = StubReplica(mode="full", retry_after=7)
+    ok = StubReplica()
+    stubs.extend([full, ok])
+    registry.register(full.register_payload())
+    registry.register(ok.register_payload())
+    registry.probe_once()
+    policy = RoutingPolicy(registry, seed=0)
+    router = _start_router(registry, routers, policy=policy)
+    # Find a head affine to the FULL replica so the spill is exercised.
+    for h in range(50):
+        head = [(h * 13 + j) % 251 for j in range(2 * PAGE)]
+        if policy.plan(head)[0][0].key == full.key:
+            break
+    else:
+        pytest.fail("no head mapped to the full replica")
+    spills0 = router.m_spills.value()
+    status, out, _ = _post(router.port, {"tokens": head})
+    assert status == 200
+    assert out["routed_to"] == ok.key
+    assert out["route_reason"] == "spill"
+    assert router.m_spills.value() - spills0 == 1
+
+
+def test_all_full_sheds_with_soonest_honest_retry_after(fleet):
+    registry, stubs, routers = fleet
+    slow = StubReplica(mode="full", retry_after=9)
+    soon = StubReplica(mode="full", retry_after=3)
+    stubs.extend([slow, soon])
+    registry.register(slow.register_payload())
+    registry.register(soon.register_payload())
+    registry.probe_once()
+    router = _start_router(registry, routers)
+    status, out, headers = _post(router.port, {"tokens": list(range(40))})
+    assert status == 429
+    assert out["retry_after_s"] == 3            # soonest slot anywhere
+    assert headers["Retry-After"] == "3"
+    assert status == 429 and out["trace_id"]
+
+
+def test_no_replicas_is_503(fleet):
+    registry, _, routers = fleet
+    router = _start_router(registry, routers)
+    status, out, _ = _post(router.port, {"tokens": list(range(40))})
+    assert status == 503 and "no routable" in out["error"]
+
+
+# -- router observability ------------------------------------------------- #
+
+
+def test_healthz_replicas_and_metrics_endpoints(fleet):
+    import http.client
+
+    registry, stubs, routers = fleet
+    _join_fleet(registry, stubs, n=2)
+    router = _start_router(registry, routers)
+    _post(router.port, {"tokens": list(range(40))})
+    conn = http.client.HTTPConnection("127.0.0.1", router.port, timeout=10)
+    conn.request("GET", "/healthz")
+    health = json.loads(conn.getresponse().read())
+    assert health["ok"] and health["replicas"] == 2
+    assert health["states"] == {"up": 2}
+    assert health["fleet_weights_step"] == 5
+    conn.request("GET", "/replicas")
+    view = json.loads(conn.getresponse().read())
+    assert {r["state"] for r in view["replicas"]} == {"up"}
+    assert all(r["wire_v"] == 1 for r in view["replicas"])
+    conn.request("GET", "/metrics")
+    text = conn.getresponse().read().decode()
+    conn.close()
+    assert "oobleck_router_requests_total" in text
+    assert "oobleck_router_replicas" in text

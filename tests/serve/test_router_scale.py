@@ -1,0 +1,226 @@
+"""Pool-driven elasticity at the router: fleet pressure -> borrow,
+lease grant -> new routable replica, reclaim -> zero-drop drain.
+
+The FleetPressureMonitor inherits pool/pressure.py's entire verdict and
+debt model — these tests pin that ONLY the raw reads changed (router
+aggregates in, same POOL_BORROW payload out). The ReplicaScaler tests
+use a stub replica factory: the lease-to-replica contract is `.port` +
+`.stop()`, which is exactly what a ServingPlane launcher provides in
+production and what a stub provides here.
+"""
+
+import json
+import threading
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+
+import pytest
+
+from oobleck_tpu.serve.router import (
+    FleetPressureMonitor,
+    ReplicaRegistry,
+    ReplicaScaler,
+)
+from oobleck_tpu.utils import metrics
+
+
+class StubHandle:
+    """What a replica factory returns: a listening port and a stop()."""
+
+    def __init__(self, *, queue=0.0, slots_active=0, step=5):
+        self.queue, self.slots_active, self.step = queue, slots_active, step
+        self.lanes, self.weights_step, self.page_size = 4, step, 16
+        self.stopped = False
+        outer = self
+
+        class Handler(BaseHTTPRequestHandler):
+            def log_message(self, *a):
+                pass
+
+            def do_GET(self):
+                body = json.dumps({
+                    "ok": True, "v": 1, "weights_step": outer.step,
+                    "queue_depth": outer.queue,
+                    "slots_active": outer.slots_active,
+                    "lanes": 4, "page_size": 16,
+                    "retry_after_s": 1}).encode()
+                self.send_response(200)
+                self.send_header("Content-Length", str(len(body)))
+                self.end_headers()
+                self.wfile.write(body)
+
+        self.srv = ThreadingHTTPServer(("127.0.0.1", 0), Handler)
+        self.srv.daemon_threads = True
+        self.port = self.srv.server_address[1]
+        threading.Thread(target=self.srv.serve_forever, daemon=True).start()
+
+    def stop(self):
+        self.stopped = True
+        self.srv.shutdown()
+        self.srv.server_close()
+
+
+# -- fleet pressure -------------------------------------------------------- #
+
+
+def test_fleet_pressure_reads_router_aggregates():
+    """Same verdict machinery, router-side raw reads: the monitor sees
+    the fleet queue gauge, the router TTFT histogram, and the router
+    deadline_queued outcome — not the per-replica serve metrics."""
+    reg = metrics.Registry()
+    t = [0.0]
+    mon = FleetPressureMonitor(registry=reg, clock=lambda: t[0],
+                               queue_high=8.0, ttft_slo_s=2.0,
+                               hysteresis=2)
+    # Idle fleet: no pressure.
+    assert mon.sample()["score"] == 0.0
+    # Fleet-wide queue spike + SLO-busting TTFT, visible only through
+    # the router aggregates.
+    reg.gauge("oobleck_router_fleet_queue_depth", "").set(24.0)
+    for _ in range(100):
+        reg.histogram("oobleck_router_ttft_seconds", "").observe(4.0)
+    reg.counter("oobleck_router_requests_total", "").inc(
+        outcome="deadline_queued")
+    t[0] = 1.0
+    s = mon.sample()
+    assert s["queue_depth"] == 24.0
+    assert s["ttft_p99_s"] is not None and s["ttft_p99_s"] > 2.0
+    assert s["deadline_queued_rate"] > 0
+    assert s["score"] > 0
+    assert not mon.pressured            # hysteresis: one sample is noise
+    t[0] = 2.0
+    mon.sample()
+    assert mon.pressured                # two consecutive: verdict flips
+    payload = mon.as_payload(horizon_s=30.0)
+    assert payload["pressured"] and payload["slo_debt_s"] > 0
+
+
+def test_fleet_pressure_ignores_single_replica_serve_metrics():
+    """One hot replica is a routing problem, not a capacity problem:
+    the serve-side metrics the base monitor reads must NOT leak into
+    the fleet verdict."""
+    reg = metrics.Registry()
+    mon = FleetPressureMonitor(registry=reg, queue_high=8.0)
+    reg.gauge("oobleck_serve_queue_depth", "").set(100.0)
+    reg.counter("oobleck_serve_requests_total", "").inc(
+        outcome="deadline_queued")
+    assert mon.sample()["score"] == 0.0
+
+
+# -- replica scaler -------------------------------------------------------- #
+
+
+@pytest.fixture
+def registry():
+    r = ReplicaRegistry(probe_s=0.05, skew_max=2)
+    yield r
+    r.stop()
+
+
+def test_lease_grant_becomes_routable_replica(registry):
+    handles = []
+
+    def factory(lease):
+        assert lease["lease_id"] == "lease-1"
+        h = StubHandle()
+        handles.append(h)
+        return h
+
+    scaler = ReplicaScaler(registry, factory, poll_s=0.01)
+    handle = scaler.scale_out({"lease_id": "lease-1"}, timeout_s=10.0)
+    assert handle is handles[0]
+    rep = registry.get(f"127.0.0.1:{handle.port}")
+    assert rep is not None and not rep.down
+    assert rep.last_probe_t is not None     # probed, not just promised
+    fresh, _ = registry.routable()
+    assert rep in fresh
+    assert scaler.held_leases() == ["lease-1"]
+    # The flight recorder is a bounded ring that may be at capacity in
+    # a full-suite run, so match the event by lease id, not by index.
+    outs = [e for e in metrics.flight_recorder().events()
+            if e["event"] == "router_scale_out"
+            and e.get("lease_id") == "lease-1"]
+    assert outs and outs[-1]["replica"] == f"127.0.0.1:{handle.port}"
+
+
+def test_reclaim_drains_clean_and_stops_replica(registry):
+    handle_box = []
+
+    def factory(lease):
+        h = StubHandle(queue=0.0, slots_active=0)
+        handle_box.append(h)
+        return h
+
+    scaler = ReplicaScaler(registry, factory, poll_s=0.01)
+    scaler.scale_out({"lease_id": "lease-2"}, timeout_s=10.0)
+    handle = handle_box[0]
+    key = f"127.0.0.1:{handle.port}"
+    out = scaler.drain("lease-2", timeout_s=5.0)
+    assert out["drained_clean"] is True
+    assert out["replica"] == key
+    assert registry.get(key) is None        # deregistered
+    assert handle.stopped
+    assert scaler.held_leases() == []
+    drains = [e for e in metrics.flight_recorder().events()
+              if e["event"] == "router_drain"
+              and e.get("lease_id") == "lease-2"]
+    assert drains and drains[-1]["drained_clean"] is True
+
+
+def test_reclaim_drain_waits_for_inflight_work(registry):
+    """A replica holding queued work is NOT stopped until it empties:
+    the drain polls the probed state and only then deregisters."""
+    handle_box = []
+
+    def factory(lease):
+        h = StubHandle(queue=3.0, slots_active=2)
+        handle_box.append(h)
+        return h
+
+    scaler = ReplicaScaler(registry, factory, poll_s=0.01)
+    scaler.scale_out({"lease_id": "lease-3"}, timeout_s=10.0)
+    handle = handle_box[0]
+
+    def finish_work():
+        # The replica works off its queue while the drain polls.
+        import time as time_mod
+
+        time_mod.sleep(0.15)
+        handle.queue, handle.slots_active = 0.0, 0
+
+    worker = threading.Thread(target=finish_work, daemon=True)
+    worker.start()
+    out = scaler.drain("lease-3", timeout_s=5.0)
+    worker.join(5)
+    assert out["drained_clean"] is True
+    assert out["drain_s"] >= 0.1           # actually waited for the work
+    assert handle.stopped
+
+
+def test_reclaim_drain_timeout_is_flagged_forced(registry):
+    def factory(lease):
+        return StubHandle(queue=5.0, slots_active=1)   # never empties
+
+    scaler = ReplicaScaler(registry, factory, poll_s=0.01)
+    scaler.scale_out({"lease_id": "lease-4"}, timeout_s=10.0)
+    out = scaler.drain("lease-4", timeout_s=0.2)
+    assert out["drained_clean"] is False   # drop risk, says so
+    drains = [e for e in metrics.flight_recorder().events()
+              if e["event"] == "router_drain"
+              and e.get("lease_id") == "lease-4"]
+    assert drains and drains[-1]["drained_clean"] is False
+
+
+def test_scale_out_timeout_stops_the_half_joined_replica(registry):
+    class DeadHandle:
+        port = 1                            # nothing listens here
+        stopped = False
+
+        def stop(self):
+            self.stopped = True
+
+    dead = DeadHandle()
+    scaler = ReplicaScaler(registry, lambda lease: dead, poll_s=0.01)
+    with pytest.raises(TimeoutError):
+        scaler.scale_out({"lease_id": "lease-5"}, timeout_s=0.3)
+    assert dead.stopped                     # no leaked half-replica
+    assert scaler.held_leases() == []
