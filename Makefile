@@ -2,7 +2,7 @@
 
 PY ?= python
 
-.PHONY: lint analyze gen-registry test test-slow tier1 bench bench-diff trace-report ckpt-bench serve-bench pipeline-bench degrade-bench policy-bench sim-bench grow-bench overlap-bench master-bench goodput-bench pool-bench router-bench
+.PHONY: lint analyze gen-registry test test-slow tier1 bench bench-diff trace-report ckpt-bench serve-bench spec-bench pipeline-bench degrade-bench policy-bench sim-bench grow-bench overlap-bench master-bench goodput-bench pool-bench router-bench
 
 # Lint = the project-native analyzer (always available, stdlib-only)
 # plus ruff (config in pyproject.toml). Ruff degrades to a skip when not
@@ -69,6 +69,12 @@ ckpt-bench:
 # key).
 serve-bench:
 	JAX_PLATFORMS=cpu $(PY) -m oobleck_tpu.serve.bench
+
+# Speculative-decode microbench: lookup-draft + multi-token verify vs the
+# k=0 one-token baseline on an acceptance-friendly workload
+# (oobleck_tpu/serve/spec_bench.py; also under bench.py's "spec" key).
+spec-bench:
+	JAX_PLATFORMS=cpu OOBLECK_METRICS_DIR= $(PY) -m oobleck_tpu.serve.spec_bench
 
 # Pipeline-schedule microbench: 1F1B vs interleaved tokens/sec and
 # schedule-replay bubble on 2 virtual CPU devices (also under bench.py's

@@ -18,6 +18,10 @@ Budget layout (wall-clock caps, enforced with subprocess timeouts):
   serve   : 150 s CPU subprocess       -> serving microbench under "serve"
                                           (never on the TPU relay: its
                                           multi-threaded dispatch wedges it)
+  spec    : 150 s CPU subprocess       -> speculative-decode microbench
+                                          under "spec" (lookup draft +
+                                          multi-token verify vs the k=0
+                                          baseline; same CPU-only rule)
   pipeline: 120 s CPU subprocess       -> 1F1B vs interleaved schedule
                                           comparison under "pipeline" (2
                                           virtual CPU devices; same
@@ -630,6 +634,37 @@ def _serve_summary() -> dict:
         return {"error": f"unparseable serve bench output: {exc}"}
 
 
+SPEC_BENCH_TIMEOUT_S = 150
+
+
+def _spec_summary() -> dict:
+    """Speculative-decode microbench (oobleck_tpu/serve/spec_bench.py) in
+    a throwaway CPU subprocess — same never-on-the-relay rule as the
+    serve bench (it drives the same multi-threaded serving stack).
+    Headline: `speedup_vs_k0` (>= 1.5x gate on the acceptance-friendly
+    workload), plus acceptance_rate / tokens_per_step (higher-better)
+    and draft_overhead (lower-better)."""
+    env = dict(os.environ)
+    env.update({"JAX_PLATFORMS": "cpu", "PALLAS_AXON_POOL_IPS": "",
+                "OOBLECK_METRICS_DIR": ""})
+    env.pop(_INNER_ENV, None)
+    proc = subprocess.Popen(
+        [sys.executable, "-m", "oobleck_tpu.serve.spec_bench"],
+        stdout=subprocess.PIPE, stderr=subprocess.PIPE, text=True, env=env)
+    try:
+        out, err = proc.communicate(timeout=SPEC_BENCH_TIMEOUT_S)
+    except subprocess.TimeoutExpired:
+        proc.kill()
+        return {"error": f"spec bench hung >{SPEC_BENCH_TIMEOUT_S}s"}
+    if proc.returncode != 0:
+        tail = (err or "").strip().splitlines()[-1:] or ["no stderr"]
+        return {"error": f"spec bench exit {proc.returncode}: {tail[0][:160]}"}
+    try:
+        return json.loads(out.strip())
+    except Exception as exc:  # noqa: BLE001
+        return {"error": f"unparseable spec bench output: {exc}"}
+
+
 def _metrics_sink_summary() -> dict | None:
     """Summary of the OOBLECK_METRICS_DIR JSONL sink, or None when the dir is
     unset/empty. Counters and histograms in the sink are per-process
@@ -904,6 +939,12 @@ def _emit(result: dict) -> None:
         result["serve"] = _serve_summary()
     except Exception as exc:  # noqa: BLE001 — emit must never fail
         result["serve"] = {"error": f"{type(exc).__name__}: {exc}"}
+    # Speculative decode (lookup draft + multi-token verify vs the k=0
+    # baseline): CPU subprocess, bounded, best-effort — see _spec_summary.
+    try:
+        result["spec"] = _spec_summary()
+    except Exception as exc:  # noqa: BLE001 — emit must never fail
+        result["spec"] = {"error": f"{type(exc).__name__}: {exc}"}
     # Schedule comparison (1F1B vs interleaved bubble + throughput): CPU
     # subprocess, bounded, best-effort — see _pipeline_summary.
     try:

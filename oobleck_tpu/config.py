@@ -303,13 +303,26 @@ class ServeArguments:
     #                               (slots * max_seq / page_size — the same
     #                               HBM budget the dense cache would take)
     lanes: int = 0                # paged decode batch width; 0 = auto
+    # Speculative multi-token decode (serve/speculative.py; paged only).
+    # "off" keeps the one-token step; "lookup" = model-free prompt-lookup
+    # drafting; "draft" = second-checkpoint draft model (needs
+    # spec_draft_root, falls back to lookup without one).
+    speculation: str = "off"
+    spec_k: int = 4               # max draft tokens per lane per step
+    spec_min_accept: float = 0.25  # acceptance EWMA below this -> k=0
+    spec_ngram: int = 3           # lookup drafter max n-gram
+    spec_probe_every: int = 32    # k=1 probe period for collapsed lanes
+    spec_draft_root: str = ""     # draft-model checkpoint root
 
     def apply_serve_env_overrides(self) -> None:
         """Deployment-property overrides, same contract as the durable
         plane's: OOBLECK_SERVE_PORT, OOBLECK_SERVE_SLOTS,
         OOBLECK_SERVE_RELOAD_SECS, OOBLECK_SERVE_KV_CACHE,
         OOBLECK_SERVE_PAGE_SIZE, OOBLECK_SERVE_KV_PAGES,
-        OOBLECK_SERVE_LANES are settable without editing job yaml."""
+        OOBLECK_SERVE_LANES, OOBLECK_SERVE_SPEC, OOBLECK_SERVE_SPEC_K,
+        OOBLECK_SERVE_SPEC_MIN_ACCEPT, OOBLECK_SERVE_SPEC_NGRAM,
+        OOBLECK_SERVE_SPEC_PROBE_EVERY, OOBLECK_SERVE_SPEC_DRAFT_ROOT
+        are settable without editing job yaml."""
         import os
 
         v = os.environ.get("OOBLECK_SERVE_PORT")
@@ -333,6 +346,24 @@ class ServeArguments:
         v = os.environ.get("OOBLECK_SERVE_LANES")
         if v:
             self.lanes = int(v)
+        v = os.environ.get("OOBLECK_SERVE_SPEC")
+        if v:
+            self.speculation = v
+        v = os.environ.get("OOBLECK_SERVE_SPEC_K")
+        if v:
+            self.spec_k = int(v)
+        v = os.environ.get("OOBLECK_SERVE_SPEC_MIN_ACCEPT")
+        if v:
+            self.spec_min_accept = float(v)
+        v = os.environ.get("OOBLECK_SERVE_SPEC_NGRAM")
+        if v:
+            self.spec_ngram = int(v)
+        v = os.environ.get("OOBLECK_SERVE_SPEC_PROBE_EVERY")
+        if v:
+            self.spec_probe_every = int(v)
+        v = os.environ.get("OOBLECK_SERVE_SPEC_DRAFT_ROOT")
+        if v:
+            self.spec_draft_root = v
 
 
 @dataclass

@@ -670,6 +670,71 @@ class GPTModel:
         logits = self.head(params["head"], x[:, None, :])[:, 0]
         return logits, {"k": k_new, "v": v_new}
 
+    def _paged_verify_sublayer(self, p, x, k_pool, v_pool, block_tables,
+                               pos, n_live):
+        """_paged_decode_sublayer for T speculative tokens per lane: write
+        all T candidates' K/V through the block table (padding past
+        n_live lands on the garbage page), then ragged multi-query
+        attention where row i attends keys < pos + 1 + i. x [B, T, E];
+        pools [N, H, page, D]; block_tables [B, P]; pos/n_live [B]."""
+        c = self.config
+        dt = c.dtype
+        from oobleck_tpu.ops.attention import alibi_slopes
+        from oobleck_tpu.ops.paged_attention import (
+            paged_cache_write_multi, paged_verify_attention)
+
+        h = _layer_norm(x, p["ln1"]["scale"], p["ln1"]["bias"], c.layer_norm_epsilon)
+        wqkv = p["attn"]["wqkv"].astype(dt)                             # [E,3,H,D]
+        qkv = jnp.einsum("bse,ethd->tbshd", h, wqkv) \
+            + p["attn"]["bqkv"].astype(dt)[:, None, None]               # [3,B,T,H,D]
+        k_pool = paged_cache_write_multi(
+            k_pool, qkv[1], block_tables, pos, n_live)
+        v_pool = paged_cache_write_multi(
+            v_pool, qkv[2], block_tables, pos, n_live)
+        slopes = alibi_slopes(c.num_heads) if c.position_embedding == "alibi" else None
+        attn = paged_verify_attention(
+            qkv[0], k_pool, v_pool, block_tables, pos + 1,
+            alibi_slopes=slopes, impl=self._paged_impl())
+        out = jnp.einsum("bthd,hde->bte", attn, p["attn"]["wo"].astype(dt))
+        out = out + p["attn"]["bo"].astype(dt)
+        return x + out, k_pool, v_pool
+
+    def forward_verify_paged(self, params, tokens: jax.Array, kv_cache,
+                             block_tables: jax.Array, pos: jax.Array,
+                             n_live: jax.Array):
+        """One speculative verify step over all lanes: tokens [B, T] (lane
+        b's last emitted token followed by its k = T-1 draft candidates;
+        columns past n_live[b] are bucket padding), pos [B] (absolute
+        position of column 0), block_tables [B, P]. Column i embeds and
+        attends at absolute position pos + i (wpe / ALiBi true distance),
+        and its K/V is written through the table exactly as a sequential
+        decode would have. Returns (logits [B, T, V] f32, updated pool);
+        row i scores the token for position pos + i + 1, so row 0 of a
+        T=1 call reproduces forward_decode_paged. Padded columns write to
+        the garbage page and score garbage harmlessly."""
+        c = self.config
+        t_len = tokens.shape[-1]
+        pe = params["embed"]
+        x = pe["wte"][tokens]                                           # [B,T,E]
+        if c.position_embedding == "learned":
+            # Clip: a padded column of a near-max_seq lane may index past
+            # the table; its output is garbage (and masked) either way.
+            pos_abs = jnp.clip(
+                pos[:, None] + jnp.arange(t_len), 0, pe["wpe"].shape[0] - 1)
+            x = x + pe["wpe"][pos_abs]
+        x = x.astype(c.dtype)
+
+        def body(x, sl):
+            bp, kp, vp = sl
+            x, kp, vp = self._paged_verify_sublayer(
+                bp, x, kp, vp, block_tables, pos, n_live)
+            return self.mlp_sublayer(bp, x), (kp, vp)
+
+        x, (k_new, v_new) = lax.scan(
+            body, x, (params["blocks"], kv_cache["k"], kv_cache["v"]))
+        logits = self.head(params["head"], x)
+        return logits, {"k": k_new, "v": v_new}
+
     # ------------------------------------------------------------------ #
     # sharding + gradient-reduction rules                                 #
     # ------------------------------------------------------------------ #

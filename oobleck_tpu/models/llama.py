@@ -122,6 +122,21 @@ def _rope_one(x: jax.Array, pos: jax.Array, theta: float) -> jax.Array:
     return jnp.stack([out1, out2], axis=-1).reshape(x.shape).astype(x.dtype)
 
 
+def _rope_multi(x: jax.Array, pos: jax.Array, theta: float) -> jax.Array:
+    """Rotary embedding for T tokens per batch row at per-lane absolute
+    positions (speculative verify). x: [B, H, T, D]; pos: [B, T] — the
+    same phases `_rope`/`_rope_one` apply at these positions, so cached
+    keys and verify queries agree with a sequential decode."""
+    d = x.shape[-1]
+    freqs = 1.0 / (theta ** (jnp.arange(0, d, 2, dtype=jnp.float32) / d))
+    angles = pos[..., None].astype(jnp.float32) * freqs      # [B, T, D/2]
+    cos, sin = jnp.cos(angles)[:, None], jnp.sin(angles)[:, None]
+    x1, x2 = x[..., 0::2], x[..., 1::2]
+    out1 = x1 * cos - x2 * sin
+    out2 = x2 * cos + x1 * sin
+    return jnp.stack([out1, out2], axis=-1).reshape(x.shape).astype(x.dtype)
+
+
 def _maybe(fn, x, axis, *a):
     return fn(x, axis, *a) if axis else x
 
@@ -524,6 +539,52 @@ class LlamaModel:
         x, (k_new, v_new) = jax.lax.scan(
             body, x, (params["blocks"], kv_cache["k"], kv_cache["v"]))
         logits = self.head(params["head"], x[:, None, :])[:, 0]
+        return logits, {"k": k_new, "v": v_new}
+
+    def _paged_verify_sublayer(self, p, x, k_pool, v_pool, block_tables,
+                               pos, n_live):
+        """_paged_decode_sublayer for T speculative tokens per lane (see
+        GPTModel._paged_verify_sublayer): queries and keys rotate at their
+        true absolute positions pos + i, K/V for all T candidates scatter
+        through the block table (padding to the garbage page), and GQA
+        folds query heads inside paged_verify_attention."""
+        c = self.config
+        dt = c.dtype
+        from oobleck_tpu.ops.paged_attention import (
+            paged_cache_write_multi, paged_verify_attention)
+
+        h = _rms_norm(x, p["ln1"]["scale"], c.rms_norm_eps)             # [B,T,E]
+        q = jnp.einsum("bte,ehd->bhtd", h, p["attn"]["wq"].astype(dt))
+        kv = jnp.einsum("bte,ekhd->kbhtd", h, p["attn"]["wkv"].astype(dt))
+        t_len = x.shape[1]
+        pos_abs = pos[:, None] + jnp.arange(t_len)                      # [B,T]
+        q = _rope_multi(q, pos_abs, c.rope_theta)
+        k = _rope_multi(kv[0], pos_abs, c.rope_theta)
+        k_pool = paged_cache_write_multi(
+            k_pool, k.transpose(0, 2, 1, 3), block_tables, pos, n_live)
+        v_pool = paged_cache_write_multi(
+            v_pool, kv[1].transpose(0, 2, 1, 3), block_tables, pos, n_live)
+        attn = paged_verify_attention(
+            q.transpose(0, 2, 1, 3), k_pool, v_pool, block_tables, pos + 1,
+            impl=self._paged_impl())
+        out = jnp.einsum("bthd,hde->bte", attn, p["attn"]["wo"].astype(dt))
+        return x + out, k_pool, v_pool
+
+    def forward_verify_paged(self, params, tokens, kv_cache, block_tables,
+                             pos, n_live):
+        """Same contract as GPTModel.forward_verify_paged (T candidate
+        tokens per lane at absolute positions, post-RoPE keys cached)."""
+        x = params["embed"]["wte"][tokens].astype(self.config.dtype)
+
+        def body(x, sl):
+            bp, kp, vp = sl
+            x, kp, vp = self._paged_verify_sublayer(
+                bp, x, kp, vp, block_tables, pos, n_live)
+            return self.mlp_sublayer(bp, x), (kp, vp)
+
+        x, (k_new, v_new) = jax.lax.scan(
+            body, x, (params["blocks"], kv_cache["k"], kv_cache["v"]))
+        logits = self.head(params["head"], x)
         return logits, {"k": k_new, "v": v_new}
 
     # ---- sharding ----

@@ -129,6 +129,25 @@ class ServingPlane:
         return PagedDecodeEngine(model, lanes=lanes, max_seq=max_seq,
                                  page_size=page, num_pages=num_pages)
 
+    def _build_spec(self):
+        """Speculative-decode controller from the serve args; None when
+        speculation is off or the engine has no multi-token verify path
+        (dense engines). Warms the fixed-width verify program so the
+        first drafting request doesn't pay a compile."""
+        a = self.args
+        if a.speculation == "off" \
+                or not getattr(self.engine, "supports_verify", False):
+            return None
+        from oobleck_tpu.serve.speculative import SpecConfig, build_controller
+
+        spec = build_controller(SpecConfig(
+            mode=a.speculation, k=a.spec_k, min_accept=a.spec_min_accept,
+            ngram=a.spec_ngram, probe_every=a.spec_probe_every,
+            draft_root=a.spec_draft_root))
+        if spec is not None:
+            self.engine.warmup_verify(spec.config.k + 1)
+        return spec
+
     def start(self) -> "ServingPlane":
         step, payload = self._wait_for_checkpoint()
         model = self._resolve_model(payload)
@@ -142,9 +161,11 @@ class ServingPlane:
             self.engine.stage_params(params_from_payload(model, payload)),
             step)
         self.engine.warmup()
+        spec = self._build_spec()
         self.batcher = ContinuousBatcher(
             self.engine, max_queue=self.args.max_queue,
-            default_max_tokens=self.args.max_tokens_default).start()
+            default_max_tokens=self.args.max_tokens_default,
+            spec=spec).start()
         self.watcher = CheckpointWatcher(
             self.root, model, self.engine, self.batcher,
             poll_secs=self.args.reload_secs, current_step=step,

@@ -7,7 +7,10 @@ One scheduler thread owns the DecodeEngine. Each loop iteration:
      KV state, nothing is dropped);
   2. admits queued requests into free slots (one prefill each);
   3. runs ONE decode step over all slots and feeds each active slot its
-     sampled token.
+     sampled token — or, with a speculative controller attached
+     (serve/speculative.py), ONE draft->verify->accept/rollback step
+     that can advance a lane by up to k+1 tokens while keeping greedy
+     output byte-identical to the one-token path.
 
 Admission is a bounded queue — when it is full `submit` rejects
 immediately (backpressure to the client as HTTP 429) instead of
@@ -54,11 +57,17 @@ class GenRequest:
 
     def __init__(self, tokens: list[int], *, max_tokens: int,
                  temperature: float = 0.0, deadline_s: float | None = None,
-                 eos_token: int | None = None, trace_id: str | None = None):
+                 eos_token: int | None = None, trace_id: str | None = None,
+                 speculation: str | None = None):
         self.id = next(self._ids)
         self.tokens = list(tokens)
         self.max_tokens = int(max_tokens)
         self.temperature = float(temperature)
+        # Per-request speculation mode (off|lookup|draft); None = the
+        # serving plane's default. Resolved against what the plane has
+        # enabled — a request can narrow but never force speculation on.
+        self.speculation = speculation
+        self.admit_ordinal = 0  # admission order (chaos @<req> targeting)
         self.submitted = time.monotonic()
         self.deadline = (self.submitted + deadline_s) if deadline_s else None
         self.eos_token = eos_token
@@ -89,9 +98,14 @@ class ContinuousBatcher:
 
     def __init__(self, engine, *, max_queue: int = 64,
                  default_max_tokens: int = 64, idle_sleep: float = 0.002,
-                 seed: int = 0):
+                 seed: int = 0, spec=None):
         self.engine = engine
         self.default_max_tokens = default_max_tokens
+        # Speculative-decode controller (serve/speculative.SpecController);
+        # None, or an engine without a verify path, keeps the classic
+        # one-token decode step.
+        self.spec = spec if getattr(engine, "supports_verify", False) else None
+        self._admit_seq = 0
         self._queue: queue.Queue[GenRequest] = queue.Queue(maxsize=max_queue)
         # Requests pulled off the queue but not yet admittable (paged
         # engines: waiting for pages). FIFO — no head-of-line skip — and
@@ -127,7 +141,8 @@ class ContinuousBatcher:
             buckets=SERVE_LATENCY_BUCKETS)
         self.m_step = reg.histogram(
             "oobleck_serve_token_latency_seconds",
-            "Per-decode-step latency (one token per active slot)",
+            "Per-TOKEN decode latency: step wall time normalized by tokens "
+            "emitted per active slot (speculative steps emit up to k+1)",
             buckets=SERVE_LATENCY_BUCKETS)
         self.m_reload_pause = reg.histogram(
             "oobleck_serve_reload_pause_seconds",
@@ -305,6 +320,8 @@ class ContinuousBatcher:
         self._slots[i] = None
         if self._lane_release is not None:
             self._lane_release(i)
+        if self.spec is not None:
+            self.spec.reset_lane(i)  # acceptance history is per-request
 
     def _pull_waiting(self) -> None:
         # A small peek-buffer (capped at the lane count) so FIFO order
@@ -352,6 +369,8 @@ class ContinuousBatcher:
             if req is None:
                 break
             req.t_admit_wall = time.time()
+            self._admit_seq += 1
+            req.admit_ordinal = self._admit_seq
             try:
                 with background.device_work("serve_prefill"):
                     if self._can_admit is not None:
@@ -390,6 +409,132 @@ class ContinuousBatcher:
             if self._emit(req, token, now):
                 self._free_lane(i)
 
+    # -- speculative decode (draft -> verify -> accept/rollback) ---------- #
+
+    def _collect_drafts(self) -> dict[int, list[int]]:
+        """Ask the controller for each lane's draft this step. Lanes at
+        k=0 (collapsed, sampled, or nearly done) stay out of the dict and
+        ride the verify batch as plain one-token rows."""
+        drafts: dict[int, list[int]] = {}
+        for i, req in enumerate(self._slots):
+            if req is None:
+                continue
+            mode = self.spec.mode_for(req.speculation)
+            remaining = req.max_tokens - len(req.out_tokens)
+            k = self.spec.k_for(i, mode=mode, temperature=req.temperature,
+                                remaining=remaining)
+            if k <= 0:
+                continue
+            d = self.spec.draft(i, req.tokens + req.out_tokens, k, mode,
+                                req.admit_ordinal)
+            if d:
+                drafts[i] = d
+        return drafts
+
+    def _spec_step(self) -> None:
+        """One speculative decode step over all lanes.
+
+        Each drafting lane feeds [last_token, draft_1..draft_k] at
+        positions pos..pos+k through ONE verify forward; verify row j of
+        a lane is exactly the logits sequential decode would produce
+        there, so emitting each row's sample until it disagrees with the
+        next draft token keeps greedy output byte-identical to the
+        non-speculative path. Rejected draft positions get their KV
+        write cursor rewound (engine.rollback) so the prefix cache can
+        never serve a poisoned page. With no drafts this step, falls
+        through to the classic one-token path — k=0 everywhere IS
+        today's decode."""
+        t_draft0 = time.perf_counter()
+        t_draft_wall0 = time.time()
+        drafts = self._collect_drafts()
+        if not drafts:
+            self._decode_step()
+            return
+        draft_s = time.perf_counter() - t_draft0
+        self.spec.m_draft_s.observe(draft_s)
+        spans.span_recorder().record(
+            "serve.spec.draft", t_draft_wall0, time.time(),
+            lanes=len(drafts),
+            tokens=sum(len(d) for d in drafts.values()))
+        t0 = time.perf_counter()
+
+        # Fixed verify width (k_max + 1) regardless of per-lane draft
+        # lengths: ONE compiled program for the life of the server, not a
+        # retrace per draft-length combination. Columns past a lane's
+        # n_live scatter KV to the garbage page and compute junk logits
+        # nobody reads.
+        t_wide = 1 + self.spec.config.k
+        tokens = np.zeros((len(self._slots), t_wide), np.int32)
+        tokens[:, 0] = self._token
+        live = np.zeros(len(self._slots), np.int32)
+        for i, req in enumerate(self._slots):
+            if req is None:
+                continue
+            d = drafts.get(i, ())
+            tokens[i, 1:1 + len(d)] = d
+            live[i] = 1 + len(d)
+
+        tv0 = time.perf_counter()
+        t_wall0 = time.time()
+        with background.device_work("serve_verify"):
+            logits = self.engine.verify(tokens, self._pos, live)
+        verify_s = time.perf_counter() - tv0
+        self.spec.m_verify_s.observe(verify_s)
+
+        now = time.monotonic()
+        active = emitted_total = 0
+        for i, req in enumerate(self._slots):
+            if req is None:
+                continue
+            active += 1
+            d = drafts.get(i, [])
+            k = len(d)
+            p0 = int(self._pos[i])
+            matched = 0
+            finished = False
+            for j in range(k + 1):
+                token = self._sample(logits[i, j], req.temperature)
+                self._pos[i] = p0 + j + 1
+                self._token[i] = token
+                emitted_total += 1
+                if self._emit(req, token, now):
+                    # eos/max_tokens/deadline cut mid-acceptance: stop
+                    # HERE — tokens past the cut are never emitted even
+                    # if the draft would have matched them.
+                    finished = True
+                    break
+                if j < k and token == d[j]:
+                    matched += 1
+                    continue
+                break
+            if k > 0:
+                self.spec.m_tokens_step.observe(matched + 1)
+                self.spec.observe(i, drafted=k, matched=matched)
+                if matched < k:
+                    # Columns matched+1..k hold rejected drafts' KV at
+                    # positions p0+matched+1..p0+k: rewind before anyone
+                    # (prefix cache, next allocation) can see the pages.
+                    self.spec.m_rollbacks.inc()
+                    tr0 = time.time()
+                    with background.device_work("serve_rollback"):
+                        self.engine.rollback(i, p0 + matched + 1, p0 + k)
+                    spans.span_recorder().record(
+                        "serve.spec.rollback", tr0, time.time(),
+                        trace_id=req.trace_id, request_id=req.id,
+                        rejected=k - matched)
+            if finished:
+                self._free_lane(i)
+        spans.span_recorder().record(
+            "serve.spec.verify", t_wall0, time.time(), lanes=active,
+            t_wide=t_wide, tokens_emitted=emitted_total,
+            draft_s=draft_s, verify_s=verify_s)
+        # m_step keeps its per-TOKEN meaning: step wall time divided by
+        # tokens emitted per active slot (reduces to the classic
+        # observation when every lane emits exactly one).
+        elapsed = draft_s + (time.perf_counter() - t0)
+        if emitted_total:
+            self.m_step.observe(elapsed * active / emitted_total)
+
     def _update_gauges(self) -> None:
         self.m_queue.set(self.queue_depth)
         self.m_active.set(self.slots_active)
@@ -413,7 +558,10 @@ class ContinuousBatcher:
                 self._maybe_swap()
                 self._admit()
                 if self.slots_active:
-                    self._decode_step()
+                    if self.spec is not None:
+                        self._spec_step()
+                    else:
+                        self._decode_step()
                 else:
                     time.sleep(self._idle_sleep)
                 self._update_gauges()

@@ -432,3 +432,74 @@ class PagedDecodeEngine(_EngineBase):
             self.params, self.cache, jnp.asarray(token, jnp.int32),
             jnp.asarray(self.tables), jnp.asarray(pos, jnp.int32))
         return np.asarray(logits)
+
+    # -- speculative multi-token verify (batcher thread only) ------------- #
+
+    @property
+    def supports_verify(self) -> bool:
+        return hasattr(self.model, "forward_verify_paged")
+
+    def _get_verify_fn(self):
+        fn = getattr(self, "_verify_fn", None)
+        if fn is None:
+            fn = self._verify_fn = jax.jit(
+                lambda p, cache, tokens, tables, pos, live:
+                    self.model.forward_verify_paged(
+                        p, tokens, cache, tables, pos, live),
+                donate_argnums=(1,))
+        return fn
+
+    def warmup_verify(self, t: int) -> None:
+        """Compile the T-wide verify program up front (one program per
+        distinct T; the batcher uses a fixed T = k_max + 1, so this is
+        one compile). No-op for T <= 1 — that's the plain decode path."""
+        if t <= 1 or not self.supports_verify:
+            return
+        assert self.params is not None, "set_params before warmup"
+        tokens = np.zeros((self.lanes, t), np.int32)
+        pos = np.zeros((self.lanes,), np.int32)
+        live = np.zeros((self.lanes,), np.int32)
+        (logits, self.cache) = self._classified(
+            lambda: self._get_verify_fn()(
+                self.params, self.cache, jnp.asarray(tokens),
+                jnp.asarray(self.tables), jnp.asarray(pos),
+                jnp.asarray(live)))
+        logger.info("paged serve warmup: verify program T=%d compiled", t)
+
+    def verify(self, tokens: np.ndarray, pos: np.ndarray,
+               n_live: np.ndarray) -> np.ndarray:
+        """One multi-token verify step over ALL lanes.
+
+        `tokens[b]` is [last emitted token, draft_1..draft_{T-1}] fed at
+        absolute positions pos[b]..pos[b]+T-1; only the first n_live[b]
+        columns are real — the rest scatter their KV to the garbage page
+        and compute junk logits the caller ignores. Returns logits
+        [lanes, T, V] on host; row j of lane b is exactly what
+        sequential decode would produce after emitting tokens[b, :j+1],
+        which is what makes greedy acceptance byte-exact."""
+        logits, self.cache = self._get_verify_fn()(
+            self.params, self.cache, jnp.asarray(tokens, jnp.int32),
+            jnp.asarray(self.tables), jnp.asarray(pos, jnp.int32),
+            jnp.asarray(n_live, jnp.int32))
+        return np.asarray(logits)
+
+    def rollback(self, lane: int, first_pos: int, last_pos: int) -> None:
+        """Rewind a lane's KV write cursor after verify rejected the draft
+        suffix at positions [first_pos, last_pos]. The allocator evicts
+        any prefix registration on the touched pages and CoWs shared ones
+        (serve/kv_blocks.rewind_span); the device copies owed for a CoW
+        use the same .at[].set pattern as prefill's defensive copy. The
+        rejected bytes themselves stay in place for the OWNING lane —
+        masked by every ragged length until the next accepted token
+        overwrites them."""
+        copies = self.allocator.rewind_span(
+            self._lane_pages[lane], first_pos, last_pos)
+        for src, dst in copies:
+            self.cache = {
+                "k": self.cache["k"].at[:, dst].set(self.cache["k"][:, src]),
+                "v": self.cache["v"].at[:, dst].set(self.cache["v"][:, src]),
+            }
+        if copies:
+            table = self._lane_pages[lane]
+            self.tables[lane, :len(table)] = table
+        self._set_page_gauges()

@@ -149,6 +149,43 @@ class BlockAllocator:
         self.cow_copies += 1
         return src, dst
 
+    def rewind_span(self, pages: list[int],
+                    first_pos: int, last_pos: int) -> list[tuple[int, int]]:
+        """Tail-page write-cursor rewind after speculative rejection.
+
+        Positions [first_pos, last_pos] of this chain hold KV written for
+        draft tokens the verify step rejected. The bytes themselves need
+        no device work for the OWNING request — they sit past its write
+        cursor, are masked by every ragged-attention length, and the next
+        accepted token overwrites position first_pos — but the pages they
+        landed on must never be SERVED to anyone else:
+
+          * any prefix registration on a touched page is evicted (in the
+            natural flow generated-token pages are never registered —
+            register_chain covers full PROMPT pages only — so this is a
+            defensive invariant, not a hot path);
+          * a touched page shared with another chain (refcount > 1) is
+            copied out via `make_writable`, exactly like prefill's
+            defensive CoW, so the neighbor keeps the clean bytes.
+
+        Returns the (src, dst) device copies the caller owes, in table
+        order. No-op (empty list) when the span is empty."""
+        out: list[tuple[int, int]] = []
+        if last_pos < first_pos:
+            return out
+        for idx in range(first_pos // self.page_size,
+                         last_pos // self.page_size + 1):
+            if idx >= len(pages):
+                break
+            page = pages[idx]
+            if page == GARBAGE_PAGE:
+                continue
+            self._evict_registration(page)
+            moved = self.make_writable(pages, idx)
+            if moved is not None:
+                out.append(moved)
+        return out
+
     # -- prefix cache ---------------------------------------------------- #
 
     def _chain_hashes(self, tokens: Sequence[int]) -> list[int]:

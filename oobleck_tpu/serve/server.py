@@ -218,11 +218,18 @@ class ServeHTTPServer:
         trace_id = body.get("trace_id")
         if trace_id is not None and not isinstance(trace_id, str):
             raise ValueError("trace_id must be a string")
+        # Per-request speculative-decode mode; None defers to the serving
+        # plane's default, and a request can only narrow (off) or pick
+        # among the drafters the plane enabled.
+        speculation = body.get("speculation")
+        if speculation is not None and speculation not in ("off", "lookup",
+                                                           "draft"):
+            raise ValueError("speculation must be one of off|lookup|draft")
         req = GenRequest(
             tokens, max_tokens=max_tokens,
             temperature=float(body.get("temperature", 0.0)),
             deadline_s=(float(deadline_ms) / 1e3) if deadline_ms else None,
-            eos_token=eos, trace_id=trace_id)
+            eos_token=eos, trace_id=trace_id, speculation=speculation)
         try:
             self.batcher.submit(req)
         except QueueFull as e:

@@ -1,0 +1,343 @@
+"""Speculative multi-token decode: drafters and the acceptance controller.
+
+The serve hot path is decode-bound: one ~1ms device step per token per
+batch, dominated by weight reads, not FLOPs. Speculative decoding buys
+back that bandwidth by guessing k tokens cheaply on the host (or with a
+small draft model), then verifying all k in ONE batched forward through
+the real model (`forward_verify_paged` — same weights read once for k+1
+positions). Greedy acceptance keeps the output BYTE-IDENTICAL to plain
+greedy decode: the verify logits at row j are exactly what step-by-step
+decode would have produced at that position, so emitting the argmax of
+each row until it disagrees with the next draft token reproduces the
+non-speculative stream token for token — speculation changes latency,
+never content.
+
+Three pieces live here:
+
+  * `Drafter` — the proposal seam. `LookupDrafter` (the default) is
+    model-free prompt-lookup: the last n-gram of the context is matched
+    against earlier occurrences and the tokens that followed are
+    proposed. Zero extra weights, wins on repetitive continuations
+    (code, quoted spans, structured output) and costs ~nothing when it
+    misses. `ModelDrafter` runs a second, smaller checkpoint greedily
+    for k steps — real drafting quality at real (small) compute cost.
+  * `SpecController` — per-lane acceptance EWMAs that ADAPT k: lanes
+    whose drafts keep matching run at k_max, lanes that keep missing
+    collapse to k=0 (exactly today's one-token path, no verify overhead)
+    with a periodic k=1 probe so a lane can recover when its tail turns
+    repetitive. Also the injection point for the `spec_misdraft` chaos
+    directive (deliberately wrong draft tokens, to exercise rollback).
+  * Spec metrics — acceptance rate, tokens/step, draft/verify time
+    split — all under `oobleck_serve_spec_*`.
+
+The batcher owns the loop: draft -> verify -> accept/rollback; see
+`ContinuousBatcher._spec_step`.
+"""
+
+from __future__ import annotations
+
+import logging
+import time
+
+import numpy as np
+
+from oobleck_tpu.utils import metrics
+from oobleck_tpu.utils.chaos import chaos
+
+logger = logging.getLogger("oobleck.serve")
+
+SPEC_MODES = ("off", "lookup", "draft")
+
+
+class Drafter:
+    """Proposal seam: guess up to k continuation tokens for a context.
+
+    `propose` may return FEWER than k tokens (or none) — the controller
+    verifies whatever came back. It must never raise on short contexts.
+    """
+
+    name = "base"
+
+    def propose(self, ctx, k: int) -> list[int]:  # pragma: no cover
+        raise NotImplementedError
+
+
+class LookupDrafter(Drafter):
+    """Model-free prompt-lookup (n-gram) drafting.
+
+    Finds the most recent EARLIER occurrence of the context's trailing
+    n-gram (longest n first, `max_ngram` down to `min_ngram`) and
+    proposes the tokens that followed it. The bet: generation that
+    re-enters previously seen material — quoting the prompt, repeating
+    structure, cycling — continues the same way it did last time.
+    """
+
+    name = "lookup"
+
+    def __init__(self, max_ngram: int = 3, min_ngram: int = 1):
+        if min_ngram < 1 or max_ngram < min_ngram:
+            raise ValueError("need 1 <= min_ngram <= max_ngram")
+        self.max_ngram = int(max_ngram)
+        self.min_ngram = int(min_ngram)
+
+    def propose(self, ctx, k: int) -> list[int]:
+        n_ctx = len(ctx)
+        if k <= 0 or n_ctx < self.min_ngram + 1:
+            return []
+        for n in range(min(self.max_ngram, n_ctx - 1),
+                       self.min_ngram - 1, -1):
+            suffix = tuple(ctx[n_ctx - n:])
+            # j is the exclusive end of a candidate match window; j < n_ctx
+            # keeps it strictly earlier than the suffix itself.
+            for j in range(n_ctx - 1, n - 1, -1):
+                if tuple(ctx[j - n:j]) == suffix:
+                    cont = [int(t) for t in ctx[j:j + k]]
+                    if cont:
+                        return cont
+        return []
+
+
+class ModelDrafter(Drafter):
+    """Draft with a second (smaller) model run greedily for k steps.
+
+    Full-context forwards on the draft model — it keeps no KV state, so
+    it composes with lane swaps and rollback trivially. Worth it only
+    when the draft model is much smaller than the target; the seam
+    exists so a real deployment can plug one in from a second
+    checkpoint root (`OOBLECK_SERVE_SPEC_DRAFT_ROOT`).
+    """
+
+    name = "draft"
+
+    def __init__(self, model, params, *, max_ctx: int = 0):
+        import jax.numpy as jnp
+        self._jnp = jnp
+        self.model = model
+        self.params = params
+        # 0 = no clamp; otherwise feed only the trailing max_ctx tokens
+        # (positions shift, but a DRAFT only has to be plausible —
+        # verification is what guarantees correctness).
+        self.max_ctx = int(max_ctx)
+
+    @classmethod
+    def from_checkpoint(cls, root: str, *, model=None):
+        """Load the newest complete checkpoint under `root` as the draft
+        model. `model` overrides discovery when the caller already built
+        one; otherwise the checkpoint's meta names the architecture the
+        same way the serving plane resolves its target model. Returns
+        None (drafting falls back to lookup) when nothing loads."""
+        from oobleck_tpu.ckpt.restore import load_latest
+        from oobleck_tpu.serve.reload import params_from_payload
+
+        loaded = load_latest(root)
+        if loaded is None:
+            logger.warning("spec: no checkpoint under %r; draft model "
+                           "unavailable", root)
+            return None
+        _step, payload = loaded
+        if model is None:
+            from oobleck_tpu.models import build_model
+            meta = payload.get("meta", {}) or {}
+            name = meta.get("model")
+            if not name:
+                logger.warning("spec: checkpoint under %r has no model "
+                               "meta; draft model unavailable", root)
+                return None
+            model = build_model(name, meta.get("model_args", {}))
+        params = params_from_payload(model, payload)
+        return cls(model, params)
+
+    def propose(self, ctx, k: int) -> list[int]:
+        if k <= 0 or not len(ctx):
+            return []
+        toks = [int(t) for t in ctx]
+        out: list[int] = []
+        for _ in range(k):
+            feed = toks[-self.max_ctx:] if self.max_ctx else toks
+            logits = self.model.forward(
+                self.params, self._jnp.asarray(feed, self._jnp.int32)[None])
+            nxt = int(np.argmax(np.asarray(logits[0, -1])))
+            out.append(nxt)
+            toks.append(nxt)
+        return out
+
+
+class SpecConfig:
+    """Knobs for the speculative path (serve-plane defaults; per-request
+    `speculation` picks the mode within what the plane enables)."""
+
+    def __init__(self, *, mode: str = "off", k: int = 4,
+                 min_accept: float = 0.25, ngram: int = 3,
+                 probe_every: int = 32, ewma_alpha: float = 0.3,
+                 draft_root: str = ""):
+        if mode not in SPEC_MODES:
+            raise ValueError(f"speculation mode {mode!r} not in {SPEC_MODES}")
+        if k < 1:
+            raise ValueError("spec k must be >= 1")
+        if not 0.0 <= min_accept <= 1.0:
+            raise ValueError("spec min_accept must be in [0, 1]")
+        self.mode = mode
+        self.k = int(k)
+        self.min_accept = float(min_accept)
+        self.ngram = int(ngram)
+        self.probe_every = int(probe_every)
+        self.ewma_alpha = float(ewma_alpha)
+        self.draft_root = draft_root
+
+
+class _LaneState:
+    __slots__ = ("ewma", "steps_at_zero")
+
+    def __init__(self):
+        self.ewma = 1.0        # optimistic: first steps draft at full k
+        self.steps_at_zero = 0
+
+
+# Tokens emitted per spec step land in [1, k+1]; integer-edge buckets so
+# the histogram reads directly as a tokens/step distribution.
+_TOKENS_PER_STEP_BUCKETS = (1.0, 2.0, 3.0, 4.0, 6.0, 8.0, 12.0, 16.0)
+
+
+class SpecController:
+    """Per-lane draft policy: who drafts, how many tokens, and how the
+    acceptance feedback adapts k.
+
+    Greedy acceptance is only exact at temperature 0, so sampled
+    (temperature > 0) requests always run k=0 — they still ride in the
+    same verify batch (a T=1 row IS the decode row), so mixed batches
+    cost nothing extra.
+    """
+
+    def __init__(self, config: SpecConfig, drafters: dict[str, Drafter],
+                 *, seed: int = 0):
+        self.config = config
+        self.drafters = drafters
+        self._lanes: dict[int, _LaneState] = {}
+        self._misdraft_rng = np.random.default_rng(seed)
+
+        reg = metrics.registry()
+        self.m_accept = reg.gauge(
+            "oobleck_serve_spec_acceptance_rate",
+            "Draft-token acceptance rate (EWMA across drafting lanes)")
+        self.m_tokens_step = reg.histogram(
+            "oobleck_serve_spec_tokens_per_step",
+            "Tokens emitted per lane per speculative step (1 = no draft "
+            "accepted; k+1 = full acceptance plus bonus token)",
+            buckets=_TOKENS_PER_STEP_BUCKETS)
+        self.m_draft_s = reg.histogram(
+            "oobleck_serve_spec_draft_seconds",
+            "Host/draft-model time proposing tokens per spec step",
+            buckets=metrics.SERVE_LATENCY_BUCKETS)
+        self.m_verify_s = reg.histogram(
+            "oobleck_serve_spec_verify_seconds",
+            "Device time in the batched multi-token verify forward",
+            buckets=metrics.SERVE_LATENCY_BUCKETS)
+        self.m_drafted = reg.counter(
+            "oobleck_serve_spec_drafted_tokens_total",
+            "Draft tokens submitted to verification")
+        self.m_accepted = reg.counter(
+            "oobleck_serve_spec_accepted_tokens_total",
+            "Draft tokens accepted by verification")
+        self.m_rollbacks = reg.counter(
+            "oobleck_serve_spec_rollbacks_total",
+            "KV rollbacks after a rejected draft suffix")
+
+    # -- lane lifecycle --------------------------------------------------- #
+
+    def reset_lane(self, lane: int) -> None:
+        """Called at admit/free: acceptance history is per-REQUEST."""
+        self._lanes.pop(lane, None)
+
+    def _state(self, lane: int) -> _LaneState:
+        st = self._lanes.get(lane)
+        if st is None:
+            st = self._lanes[lane] = _LaneState()
+        return st
+
+    # -- policy ----------------------------------------------------------- #
+
+    def mode_for(self, req_mode: str | None) -> str:
+        """Resolve a request's speculation mode against the plane's: a
+        request can only narrow (off) or pick among enabled drafters."""
+        if self.config.mode == "off":
+            return "off"
+        if req_mode is None:
+            return self.config.mode
+        if req_mode == "draft" and "draft" not in self.drafters:
+            return "lookup"
+        return req_mode
+
+    def k_for(self, lane: int, *, mode: str, temperature: float,
+              remaining: int) -> int:
+        """Draft length for this lane this step. 0 = plain decode row."""
+        if mode == "off" or temperature > 0.0 or remaining <= 1:
+            return 0
+        st = self._state(lane)
+        if st.ewma < self.config.min_accept:
+            # Collapsed lane: k=0 except a periodic k=1 probe so a tail
+            # that turns repetitive can climb back out.
+            st.steps_at_zero += 1
+            if self.config.probe_every > 0 \
+                    and st.steps_at_zero % self.config.probe_every == 0:
+                return 1
+            return 0
+        k = int(round(self.config.k * st.ewma))
+        return max(1, min(k, self.config.k, remaining - 1))
+
+    def draft(self, lane: int, ctx, k: int, mode: str,
+              request_ordinal: int = 0) -> list[int]:
+        """Propose up to k tokens; applies the spec_misdraft chaos
+        directive (deliberately wrong tokens) before returning."""
+        drafter = self.drafters.get(mode)
+        if drafter is None or k <= 0:
+            return []
+        draft = drafter.propose(ctx, k)[:k]
+        if draft:
+            rate = chaos().spec_misdraft_rate(request_ordinal)
+            if rate:
+                vocab_guess = max(max(draft), max(int(t) for t in ctx)) + 2
+                for i, t in enumerate(draft):
+                    if self._misdraft_rng.random() < rate:
+                        draft[i] = (t + 1) % vocab_guess
+            self.m_drafted.inc(len(draft))
+        return draft
+
+    def observe(self, lane: int, *, drafted: int, matched: int) -> None:
+        """Feed one lane-step's acceptance back into its EWMA."""
+        if drafted <= 0:
+            return
+        st = self._state(lane)
+        rate = matched / drafted
+        a = self.config.ewma_alpha
+        st.ewma = (1.0 - a) * st.ewma + a * rate
+        if st.ewma >= self.config.min_accept:
+            st.steps_at_zero = 0
+        self.m_accepted.inc(matched)
+        if self._lanes:
+            self.m_accept.set(
+                sum(s.ewma for s in self._lanes.values()) / len(self._lanes))
+
+
+def build_controller(config: SpecConfig, *, seed: int = 0,
+                     draft_model=None) -> SpecController | None:
+    """Wire drafters for `config`; None when speculation is off.
+
+    "draft" mode needs a second checkpoint root (or an explicit
+    `draft_model`); when neither loads, the plane falls back to lookup
+    drafting rather than silently serving without speculation.
+    """
+    if config.mode == "off":
+        return None
+    drafters: dict[str, Drafter] = {
+        "lookup": LookupDrafter(max_ngram=config.ngram)}
+    if config.mode == "draft" or config.draft_root:
+        md = draft_model
+        if md is None and config.draft_root:
+            md = ModelDrafter.from_checkpoint(config.draft_root)
+        if md is not None:
+            drafters["draft"] = md
+        elif config.mode == "draft":
+            logger.warning("spec: draft model unavailable; falling back "
+                           "to lookup drafting")
+            config.mode = "lookup"
+    return SpecController(config, drafters, seed=seed)

@@ -115,6 +115,18 @@ directives; each directive is ``action=arg[:qual][@ip]``:
                                 baseline for 3 polls first. NON-consuming
                                 after activation; activation is
                                 flight-recorded once
+    spec_misdraft=0.5           speculative-decode fault: each draft
+                                token the serve-plane drafter proposes
+                                is replaced with a deliberately wrong
+                                one with probability 0.5 — acceptance
+                                collapses and the verify/rollback path
+                                runs hot, but the OUTPUT must stay
+                                byte-identical (greedy acceptance
+                                discards the junk, rollback rewinds its
+                                KV). ``spec_misdraft=0.5@3`` poisons
+                                only requests from admission ordinal 3
+                                on. NON-consuming after activation;
+                                activation is flight-recorded once
 
 Barriers are explicit calls (``chaos().barrier("step_end", ip=...)``)
 placed at recovery-relevant points: worker start, step start/end, and
@@ -145,7 +157,7 @@ _KNOWN_ACTIONS = ("delay_send", "drop_send", "stall_heartbeat", "kill_at",
                   "preempt_notice", "join_host", "join_hosts",
                   "spot_lifetime", "kill_master", "partition_master",
                   "slow_host", "traffic_wave", "kill_replica",
-                  "hang_replica")
+                  "hang_replica", "spec_misdraft")
 
 
 @dataclass
@@ -266,6 +278,14 @@ def parse_spec(spec: str) -> list[Rule]:
             if float(rule.qual or 0) <= 0:
                 raise ValueError(
                     f"hang_replica needs positive seconds: {directive!r}")
+        elif action == "spec_misdraft":
+            rate = float(rule.arg)  # spec_misdraft=<rate>[@<req>]
+            if not 0.0 < rate <= 1.0:
+                raise ValueError(
+                    f"spec_misdraft rate must be in (0, 1]: {directive!r}")
+            if int(rule.ip or 1) < 1:  # @segment = request ordinal
+                raise ValueError(
+                    f"spec_misdraft ordinal must be >= 1: {directive!r}")
         elif rule.qual is not None:
             int(rule.qual)
         rules.append(rule)
@@ -624,6 +644,40 @@ class Chaos:
                 "chaos_injection", action="hang_replica", port=int(port),
                 seconds=secs)
             return secs
+        return None
+
+    # -- speculative-decode faults (serve hot path) ------------------------- #
+
+    def spec_misdraft_rate(self, request_ordinal: int = 1) -> float | None:
+        """Probability each DRAFT token is replaced with a deliberately
+        wrong one, once a spec_misdraft rule applies to this request —
+        else None. ``@<req>`` restricts the fault to requests with
+        admission ordinal >= req (default 1 = every request), so a run
+        can serve clean traffic first and then misdraft. NON-consuming
+        after activation — every subsequent draft stays poisoned (the
+        rollback path must hold up under sustained rejection, not one
+        bad step); the activation is flight-recorded once. Correctness
+        must be unaffected: greedy acceptance discards the wrong tokens
+        and rollback rewinds their KV, so the OUTPUT stays byte-identical
+        — only acceptance-rate/goodput metrics should move."""
+        for r in self.rules:
+            if r.action != "spec_misdraft":
+                continue
+            if int(request_ordinal) < int(r.ip or 1):
+                return None
+            i = self.rules.index(r)
+            rate = float(r.arg)
+            if self._counts.get(i, 0) >= 0:
+                self._counts[i] = -1  # active from here on
+                logger.warning(
+                    "chaos: misdrafting %.0f%% of speculative draft tokens "
+                    "from request %d", rate * 100.0, int(request_ordinal))
+                from oobleck_tpu.utils import metrics
+
+                metrics.flight_recorder().record(
+                    "chaos_injection", action="spec_misdraft", rate=rate,
+                    request=int(request_ordinal))
+            return rate
         return None
 
     # -- named barriers ---------------------------------------------------- #

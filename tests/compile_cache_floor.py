@@ -1,0 +1,39 @@
+"""Shared persistent-cache floor for compile-heavy test directories.
+
+The root conftest points JAX's persistent compilation cache at the
+shared dir (utils/compile_cache.py), but JAX only PERSISTS programs
+whose compile took >= jax_persistent_cache_min_compile_time_secs
+(default 1.0 s). The compile-bound test dirs (tests/execution,
+tests/serve, tests/ops) JIT fleets of tiny CPU programs that almost all
+compile in 50-900 ms — so warm reruns recompiled nearly everything and
+the tier-1 870 s budget eroded with every new jitted program.
+
+Dropping the threshold to 0 makes every compile cacheable, which is
+exactly right for a test corpus whose programs repeat byte-for-byte
+across runs. min_entry_size stays 0 (its default): tiny entries are
+still wins here because the corpus is ALL tiny entries.
+
+Each directory's conftest calls `apply_compile_cache_floor()` instead of
+duplicating the config poke (the PR 17/19 copies drifted one docstring
+apart before this hoist). Opt out with OOBLECK_TEST_COMPILE_CACHE=0
+(e.g. when bisecting a suspected poisoned-cache hang — see the root
+conftest's scrub notes); OOBLECK_JAX_CC=0 still disables the cache
+wholesale, which makes the floor moot.
+"""
+
+import os
+
+
+def apply_compile_cache_floor() -> bool:
+    """Make every jitted program persistable (threshold 0) when the
+    persistent compile cache is enabled. Returns True when applied.
+    Idempotent — safe for several directory conftests to call in one
+    pytest session."""
+    import jax
+
+    if os.environ.get("OOBLECK_TEST_COMPILE_CACHE", "1") == "0":
+        return False
+    if not jax.config.jax_compilation_cache_dir:
+        return False
+    jax.config.update("jax_persistent_cache_min_compile_time_secs", 0.0)
+    return True

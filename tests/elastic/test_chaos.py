@@ -123,6 +123,12 @@ def test_parse_spec_outage_directives():
     "hang_replica=0:2",       # non-positive replica port
     "hang_replica=8001:0",    # non-positive hang length
     "hang_replica=8001:long",  # non-numeric hang length
+    "spec_misdraft=",          # no rate
+    "spec_misdraft=0",         # rate must be positive
+    "spec_misdraft=1.5",       # rate capped at 1.0
+    "spec_misdraft=often",     # non-numeric rate
+    "spec_misdraft=0.5@0",     # non-positive request ordinal
+    "spec_misdraft=0.5@nth",   # non-integer request ordinal
 ])
 def test_parse_spec_rejects_typos_eagerly(bad):
     # A typo'd injection spec must fail the run at parse time, not
@@ -327,6 +333,36 @@ def test_replica_directive_semantics():
     # No ordinal: the FIRST request to the port kills it.
     first = Chaos("kill_replica=9001")
     assert first.kill_replica_now(9001)
+
+
+def test_parse_spec_misdraft_grammar():
+    """Speculative-decode fault: spec_misdraft=<rate>[@<req>] — the @
+    segment is an admission-ordinal threshold, not a host ip."""
+    rules = parse_spec("spec_misdraft=0.5, spec_misdraft=1.0@3")
+    assert [(r.action, r.arg, r.qual, r.ip) for r in rules] == [
+        ("spec_misdraft", "0.5", None, None),
+        ("spec_misdraft", "1.0", None, "3"),
+    ]
+
+
+def test_spec_misdraft_semantics():
+    """The rate applies from the named admission ordinal on, is
+    NON-consuming after activation (sustained rejection, not one bad
+    step), and flight-records the activation exactly once."""
+    from oobleck_tpu.utils import metrics
+
+    c = Chaos("spec_misdraft=0.75@2")
+    assert c.spec_misdraft_rate(1) is None            # below threshold
+    assert c.spec_misdraft_rate(2) == pytest.approx(0.75)
+    assert c.spec_misdraft_rate(3) == pytest.approx(0.75)  # stays on
+    events = [e for e in metrics.flight_recorder().events()
+              if e["event"] == "chaos_injection"
+              and e.get("action") == "spec_misdraft"]
+    assert len(events) == 1
+    assert events[-1]["rate"] == pytest.approx(0.75)
+    assert events[-1]["request"] == 2
+    # No ordinal: every request misdrafts from the first.
+    assert Chaos("spec_misdraft=1.0").spec_misdraft_rate(1) == 1.0
 
 
 def test_inactive_chaos_is_a_noop():

@@ -2,27 +2,13 @@
 
 The execution tests are the suite's compile-bound peak: every engine/
 pipeline/reconfigure case JITs fresh XLA CPU programs over the 8 virtual
-devices. The root conftest already points JAX's persistent compilation
-cache at the shared dir (utils/compile_cache.py), but JAX only PERSISTS
-programs whose compile took >= jax_persistent_cache_min_compile_time_secs
-(default 1.0 s) — and almost every program here compiles in 50-900 ms, so
-warm reruns recompiled nearly everything anyway.
-
-Dropping the threshold to 0 for this directory makes every compile
-cacheable, which is exactly right for a test corpus whose programs repeat
-byte-for-byte across runs. min_entry_size stays 0 (its default): tiny
-entries are still wins here because the corpus is all tiny entries.
-
-Opt out with OOBLECK_TEST_COMPILE_CACHE=0 (e.g. when bisecting a
-suspected poisoned-cache hang — see the root conftest's scrub notes);
-OOBLECK_JAX_CC=0 still disables the cache wholesale, which makes this
-threshold moot.
+devices, almost all under JAX's 1.0 s persistence threshold — so warm
+reruns recompiled nearly everything. The shared floor
+(tests/compile_cache_floor.py) makes every compile cacheable, which is
+exactly right for a corpus whose programs repeat byte-for-byte across
+runs.
 """
 
-import os
+from tests.compile_cache_floor import apply_compile_cache_floor
 
-import jax
-
-if (os.environ.get("OOBLECK_TEST_COMPILE_CACHE", "1") != "0"
-        and jax.config.jax_compilation_cache_dir):
-    jax.config.update("jax_persistent_cache_min_compile_time_secs", 0.0)
+apply_compile_cache_floor()

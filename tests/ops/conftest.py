@@ -1,0 +1,12 @@
+"""Persistent-cache tuning for the ops-dir kernel-parity tests.
+
+Every pallas-interpret vs XLA parity case here jits a handful of small
+programs per geometry (ragged / GQA / ALiBi / verify widths); all of
+them compile under JAX's 1.0 s persistence threshold, so warm CPU reruns
+would recompile the lot without the shared floor
+(tests/compile_cache_floor.py).
+"""
+
+from tests.compile_cache_floor import apply_compile_cache_floor
+
+apply_compile_cache_floor()

@@ -1,21 +1,14 @@
 """Persistent-cache tuning for the serve-dir compile hump.
 
-Same trick as tests/execution/conftest.py, same reasoning: the serve
-tests JIT fresh prefill/decode programs per engine geometry (dense and
-paged, several bucket widths), almost all of which compile well under
-JAX's 1.0 s persistence threshold — so warm reruns recompiled nearly
-everything. Threshold 0 makes every program cacheable; the corpus
-repeats byte-for-byte across runs, so each is a guaranteed future hit.
-
-Opt out with OOBLECK_TEST_COMPILE_CACHE=0 (e.g. when bisecting a
-suspected poisoned-cache hang — see the root conftest's scrub notes);
-OOBLECK_JAX_CC=0 still disables the cache wholesale.
+The serve tests JIT fresh prefill/decode/verify programs per engine
+geometry (dense and paged, several bucket widths, speculative verify
+widths), almost all of which compile well under JAX's 1.0 s persistence
+threshold — so warm reruns recompiled nearly everything. The shared
+floor (tests/compile_cache_floor.py) makes every program cacheable; the
+corpus repeats byte-for-byte across runs, so each is a guaranteed
+future hit.
 """
 
-import os
+from tests.compile_cache_floor import apply_compile_cache_floor
 
-import jax
-
-if (os.environ.get("OOBLECK_TEST_COMPILE_CACHE", "1") != "0"
-        and jax.config.jax_compilation_cache_dir):
-    jax.config.update("jax_persistent_cache_min_compile_time_secs", 0.0)
+apply_compile_cache_floor()
